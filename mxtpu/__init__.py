@@ -1,0 +1,52 @@
+"""mxtpu — a TPU-native deep-learning framework with the capabilities of Apache MXNet.
+
+Built from scratch on JAX/XLA/Pallas/pjit (SURVEY.md is the blueprint): the reference's
+dependency engine, graph passes, and CUDA kernels collapse into XLA; the NCCL/ps-lite
+KVStore becomes a collectives layer over ICI/DCN; the user-facing capability surface
+(NDArray eager ops, autograd, Gluon-style modules, Module.fit, KVStore, data pipelines,
+model zoo) is preserved.
+
+Top-level layout mirrors the ``mx.*`` namespaces:
+
+* ``mxtpu.nd`` — imperative NDArray ops (mx.nd)
+* ``mxtpu.autograd`` — record/backward (mx.autograd)
+* ``mxtpu.gluon`` — Block/HybridBlock/Trainer/data/model_zoo (mx.gluon)
+* ``mxtpu.mod`` — Module API (mx.mod)
+* ``mxtpu.io`` — data iterators (mx.io)
+* ``mxtpu.kv`` — KVStore (mx.kvstore)
+* ``mxtpu.parallel`` — device meshes, collectives, sharded training (TPU-first, new)
+"""
+
+from .base import __version__
+from . import base
+from . import context
+from .context import Context, cpu, cpu_pinned, current_context, device_mesh, gpu, num_devices, num_gpus, num_tpus, tpu
+from . import rng
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .ndarray import NDArray
+
+# subsystem imports (populated as the build proceeds; see SURVEY.md §7 build order)
+import importlib as _importlib
+
+_SUBSYSTEMS = ["initializer", "optimizer", "lr_scheduler", "metric", "callback",
+               "io", "recordio", "kvstore", "gluon", "module", "parallel",
+               "profiler", "test_utils", "model", "image", "visualization"]
+for _name in _SUBSYSTEMS:
+    try:
+        globals()[_name] = _importlib.import_module(f".{_name}", __name__)
+    except ModuleNotFoundError as _e:
+        if f"mxtpu.{_name}" not in str(_e):
+            raise
+
+if "kvstore" in globals():
+    kv = globals()["kvstore"]
+if "module" in globals():
+    mod = globals()["module"]
+    Module = mod.Module
+if "model" in globals():
+    save_checkpoint = model.save_checkpoint
+    load_checkpoint = model.load_checkpoint

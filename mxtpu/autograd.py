@@ -1,0 +1,364 @@
+"""Imperative autograd — record/pause scopes, tape, backward.
+
+Capability parity with the reference autograd (``src/imperative/imperative.cc`` +
+``python/mxnet/autograd.py``: record/pause/train_mode/predict_mode scopes, MarkVariables,
+Backward, custom Function), redesigned for JAX:
+
+* The reference's tape is a dynamic NNVM graph with per-node ``AGInfo`` and hand-written
+  ``FGradient`` rules. Here the tape is a list of nodes, each holding a **pure
+  JAX-traceable closure** of the op it recorded; ``backward()`` walks the tape in
+  reverse and gets each node's input cotangents from ``jax.vjp`` — no per-op gradient
+  registrations exist anywhere in the framework.
+* Hybridized blocks record as a SINGLE node whose closure is the whole compiled
+  step (mirroring CachedOp being one node in the reference's graph,
+  src/imperative/cached_op.cc Backward :1046).
+* ``Function`` (user-defined forward/backward, autograd.py:332-509) records a node with
+  an explicit backward callable instead of a vjp.
+
+The scopes also carry the thread-local ``is_training`` flag consumed by Dropout/BatchNorm
+(`MXAutogradSetIsTraining` parity).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "backward", "grad",
+           "mark_variables", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    st = _st()
+    prev, st.recording = st.recording, flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    st = _st()
+    prev, st.training = st.training, flag
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._prev
+        return False
+
+
+def record(train_mode: bool = True) -> _Scope:
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class _VariableEntry:
+    """A gradient sink created by attach_grad (MarkVariables parity)."""
+
+    __slots__ = ("handle", "grad_req")
+
+    def __init__(self, handle, grad_req: str):
+        self.handle = handle
+        self.grad_req = grad_req
+
+
+class _TapeNode:
+    __slots__ = ("pure_fn", "raw_inputs", "parent_entries", "n_outputs",
+                 "backward_fn", "saved")
+
+    def __init__(self, pure_fn, raw_inputs, parent_entries, n_outputs,
+                 backward_fn=None, saved=None):
+        self.pure_fn = pure_fn            # raw_in -> raw_out(s); None if backward_fn set
+        self.raw_inputs = raw_inputs      # list of jax arrays captured at record time
+        self.parent_entries = parent_entries  # per input: entry | None
+        self.n_outputs = n_outputs
+        self.backward_fn = backward_fn    # explicit: (saved, out_grads) -> in_grads
+        self.saved = saved
+
+
+def _mark_variable(handle, grad_req: str = "write"):
+    from .ndarray.ndarray import NDArray
+    entry = _VariableEntry(handle, grad_req)
+    handle._grad_entry = entry
+    handle._grad = NDArray(jnp.zeros_like(handle._data))
+
+
+def mark_variables(variables, gradients=None, grad_reqs="write"):
+    """Parity with mx.autograd.mark_variables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, req in zip(variables, grad_reqs):
+        _mark_variable(v, req)
+
+
+def _record(op, args, kwargs, nd_in, outs):
+    """Called by ops.registry.invoke while recording (RecordOp parity)."""
+    positions = [i for i, _ in nd_in]
+    raw_inputs = [a.data for _, a in nd_in]
+    parent_entries = [a._grad_entry for _, a in nd_in]
+    template = list(args)
+    fixed_kwargs = dict(kwargs)
+    fn = op.fn
+
+    def pure_fn(*raw):
+        full = list(template)
+        for p, r in zip(positions, raw):
+            full[p] = r
+        full = [a.data if hasattr(a, "data") and hasattr(a, "_grad_entry") else a
+                for a in full]
+        return fn(*full, **fixed_kwargs)
+
+    node = _TapeNode(pure_fn, raw_inputs, parent_entries, len(outs))
+    for j, o in enumerate(outs):
+        o._grad_entry = (node, j)
+    _st().tape.append(node)
+
+
+def record_custom_node(pure_fn, input_handles, outputs, backward_fn=None, saved=None):
+    """Record one node for a composite computation (CachedOp / custom Function)."""
+    node = _TapeNode(pure_fn, [h.data for h in input_handles],
+                     [h._grad_entry for h in input_handles], len(outputs),
+                     backward_fn=backward_fn, saved=saved)
+    for j, o in enumerate(outputs):
+        o._grad_entry = (node, j)
+    _st().tape.append(node)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _entry_key(entry):
+    if isinstance(entry, _VariableEntry):
+        return ("var", id(entry))
+    node, j = entry
+    return ("out", id(node), j)
+
+
+def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
+                  collect_vars=None):
+    st = _st()
+    tape: List[_TapeNode] = st.tape
+    grads: dict = {}
+
+    if not tape and any(isinstance(h._grad_entry, tuple) for h in heads):
+        raise RuntimeError(
+            "backward: the recorded graph has been freed (backward already ran "
+            "without retain_graph=True, or recording never happened)")
+
+    for i, h in enumerate(heads):
+        entry = h._grad_entry
+        if entry is None:
+            continue
+        hg = None if head_grads is None else head_grads[i]
+        cot = jnp.ones_like(h.data) if hg is None else jnp.asarray(
+            hg.data if hasattr(hg, "data") and hasattr(hg, "_grad_entry") else hg,
+            dtype=h.data.dtype)
+        k = _entry_key(entry)
+        grads[k] = grads[k] + cot if k in grads else cot
+
+    for node in reversed(tape):
+        out_keys = [("out", id(node), j) for j in range(node.n_outputs)]
+        if not any(k in grads for k in out_keys):
+            continue
+        if node.backward_fn is not None:
+            out_grads = [grads.get(k) for k in out_keys]
+            out_grads = [g if g is not None else jnp.zeros_like(_out_like(node, j))
+                         for j, (g, k) in enumerate(zip(out_grads, out_keys))]
+            in_grads = node.backward_fn(node.saved, out_grads)
+        else:
+            outs, vjp_fn = jax.vjp(node.pure_fn, *node.raw_inputs)
+            multi = isinstance(outs, (tuple, list))
+            if multi:
+                cots = tuple(
+                    grads.get(k, None) if grads.get(k, None) is not None
+                    else jnp.zeros_like(o)
+                    for k, o in zip(out_keys, outs))
+            else:
+                cots = grads[out_keys[0]]
+            in_grads = vjp_fn(cots)
+        for entry, g in zip(node.parent_entries, in_grads):
+            if entry is None or g is None:
+                continue
+            k = _entry_key(entry)
+            grads[k] = grads[k] + g if k in grads else g
+
+    # flush into variable .grad buffers / collect for grad()
+    from .ndarray.ndarray import NDArray
+    results = None
+    if collect_vars is not None:
+        results = []
+        for v in collect_vars:
+            entry = v._grad_entry
+            k = _entry_key(entry) if isinstance(entry, _VariableEntry) else None
+            g = grads.get(k) if k else None
+            results.append(NDArray(g if g is not None else jnp.zeros_like(v._data)))
+    else:
+        seen = set()
+        for node in tape:
+            for entry in node.parent_entries:
+                if isinstance(entry, _VariableEntry) and id(entry) not in seen:
+                    seen.add(id(entry))
+                    k = _entry_key(entry)
+                    if k not in grads:
+                        continue
+                    h = entry.handle
+                    if entry.grad_req == "add" and h._grad is not None:
+                        h._grad._set_data(h._grad._data + grads[k])
+                    elif entry.grad_req != "null":
+                        if h._grad is None:
+                            h._grad = NDArray(jnp.zeros_like(h._data))
+                        h._grad._set_data(jnp.asarray(grads[k], dtype=h._data.dtype))
+        # heads that are themselves marked variables
+        for i, h in enumerate(heads):
+            entry = h._grad_entry
+            if isinstance(entry, _VariableEntry):
+                k = _entry_key(entry)
+                if k in grads and entry.grad_req != "null":
+                    h._grad._set_data(jnp.asarray(grads[k], dtype=h._data.dtype))
+
+    if not retain_graph:
+        st.tape = []
+    return results
+
+
+def _out_like(node, j):
+    outs = node.pure_fn(*node.raw_inputs) if node.pure_fn else node.saved["outs"][j]
+    if isinstance(outs, (tuple, list)):
+        return outs[j]
+    return outs
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True):
+    """mx.autograd.backward parity: accumulate into attach_grad'ed ``.grad`` buffers."""
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    _run_backward(list(heads), head_grads, retain_graph, train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode: bool = True):
+    """mx.autograd.grad parity: return grads w.r.t. ``variables``.
+
+    ``create_graph=True`` (grad-of-grad through the imperative tape) is not supported in
+    this round — use the functional ``mxtpu.jit.grad`` transform for higher-order
+    differentiation (jax.grad composes arbitrarily).
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use mxtpu.jit.grad (functional transform) for "
+            "higher-order gradients")
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    variables = variables if isinstance(variables, (list, tuple)) else [variables]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    retain = bool(retain_graph) if retain_graph is not None else False
+    return _run_backward(list(heads), head_grads, retain, train_mode,
+                         collect_vars=list(variables))
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        "autograd.get_symbol: the recorded graph is jaxpr-based; use "
+        "mxtpu.jit.trace to export StableHLO instead")
+
+
+# ---------------------------------------------------------------------------
+# custom Function (mx.autograd.Function parity, python/mxnet/autograd.py:332-509)
+# ---------------------------------------------------------------------------
+
+
+class Function:
+    """User-defined differentiable function with explicit backward.
+
+    Subclass and implement ``forward(self, *inputs)`` and ``backward(self,
+    *output_grads)`` operating on NDArrays; ``save_for_backward`` stashes tensors.
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn = self
+
+            def backward_fn(saved, out_grads):
+                gs = fn.backward(*[NDArray(g) for g in out_grads])
+                gs = [gs] if not isinstance(gs, (tuple, list)) else gs
+                return [g._data if isinstance(g, NDArray) else g for g in gs]
+
+            record_custom_node(None, list(inputs), outs, backward_fn=backward_fn,
+                               saved={"outs": [o._data for o in outs]})
+        return outs[0] if single else tuple(outs)

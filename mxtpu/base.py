@@ -1,0 +1,154 @@
+"""Base types and small utilities shared across the framework.
+
+Plays the role of the reference's ``include/mxnet/base.h`` + the pieces of dmlc-core the
+Python frontend leans on (``dmlc::GetEnv`` env-var access, string/dtype utilities,
+``registry.py`` generic registries — see SURVEY.md §2.7). No C ABI is needed at this
+layer: the frontend talks to XLA through JAX directly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Type
+
+import jax.numpy as jnp
+import numpy as np
+
+__version__ = "0.1.0"
+
+# ---------------------------------------------------------------------------
+# dtype handling
+# ---------------------------------------------------------------------------
+
+#: Canonical dtype name → jnp dtype. Mirrors the reference's supported dtype set
+#: (mshadow type enum used by ``infer_type``) plus bfloat16, which is the native
+#: TPU compute dtype and therefore first-class here.
+_DTYPE_MAP: Dict[str, Any] = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+_DTYPE_ID = {  # stable ids for serialization (matches mshadow enum where it exists)
+    "float32": 0, "float64": 1, "float16": 2, "uint8": 3, "int32": 4,
+    "int8": 5, "int64": 6, "bfloat16": 12, "bool": 7,
+}
+_ID_DTYPE = {v: k for k, v in _DTYPE_ID.items()}
+
+
+def dtype_np(dtype) -> np.dtype:
+    """Normalize a user dtype spec to a numpy dtype (bfloat16 via ml_dtypes)."""
+    if dtype is None:
+        return np.dtype("float32")
+    if isinstance(dtype, str) and dtype in _DTYPE_MAP:
+        return np.dtype(_DTYPE_MAP[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name if not isinstance(dtype, str) else dtype
+
+
+def dtype_id(dtype) -> int:
+    return _DTYPE_ID[dtype_name(dtype)]
+
+
+def dtype_from_id(tid: int) -> str:
+    return _ID_DTYPE[tid]
+
+
+# ---------------------------------------------------------------------------
+# environment variable catalog (dmlc::GetEnv equivalent; docs/faq/env_var.md parity)
+# ---------------------------------------------------------------------------
+
+_ENV_PREFIX = "MXTPU_"
+_ENV_CATALOG: Dict[str, str] = {}
+
+
+def getenv(name: str, default, doc: str = ""):
+    """Read a framework env var (``MXTPU_*``), recording it in the catalog.
+
+    The reference scatters ``dmlc::GetEnv("MXNET_…")`` at use sites and documents them in
+    docs/faq/env_var.md; here every read self-registers so ``env_catalog()`` is always
+    complete.
+    """
+    key = name if name.startswith(_ENV_PREFIX) else _ENV_PREFIX + name
+    if doc:
+        _ENV_CATALOG[key] = doc
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def env_catalog() -> Dict[str, str]:
+    return dict(_ENV_CATALOG)
+
+
+# ---------------------------------------------------------------------------
+# generic name→object registry (python/mxnet/registry.py equivalent)
+# ---------------------------------------------------------------------------
+
+class Registry:
+    """Name → class/function registry with alias support.
+
+    Replaces both dmlc-core's C++ registry and ``python/mxnet/registry.py``'s
+    ``get_register_func``/``get_create_func`` pattern with one small class.
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._registry: Dict[str, Any] = {}
+
+    def register(self, obj=None, *, name: Optional[str] = None, aliases: tuple = ()):
+        def _do(o):
+            key = (name or getattr(o, "__name__", None) or str(o)).lower()
+            self._registry[key] = o
+            for a in aliases:
+                self._registry[a.lower()] = o
+            return o
+
+        return _do if obj is None else _do(obj)
+
+    def get(self, name: str):
+        key = name.lower()
+        if key not in self._registry:
+            raise KeyError(f"{self.kind} {name!r} is not registered; known: {sorted(self._registry)}")
+        return self._registry[key]
+
+    def create(self, spec, **kwargs):
+        """Create from a name, a (name, kwargs) pair, or pass through an instance."""
+        if isinstance(spec, str):
+            return self.get(spec)(**kwargs)
+        return spec
+
+    def __contains__(self, name: str) -> bool:
+        return isinstance(name, str) and name.lower() in self._registry
+
+    def keys(self):
+        return sorted(self._registry)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+class MXTPUError(RuntimeError):
+    """Framework-level error (the reference surfaces dmlc::Error through MXGetLastError)."""
+
+
+def check(cond: bool, msg: str = "check failed"):
+    if not cond:
+        raise MXTPUError(msg)

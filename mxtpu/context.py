@@ -1,0 +1,174 @@
+"""Device context abstraction for the TPU-native framework.
+
+Capability parity with the reference's ``Context`` (``include/mxnet/base.h:133-203``:
+``kCPU``/``kGPU``/``kCPUPinned``/``kCPUShared`` plus ``mx.context.Context`` stack in
+``python/mxnet/context.py``), re-designed for TPU: a ``Context`` names a logical device
+(``tpu(i)``, ``cpu(i)``) backed by a ``jax.Device``, and the module also exposes pod-slice
+mesh helpers (``device_mesh``) that the reference has no equivalent of — on TPU the device
+topology (ICI) is a first-class axis of the programming model rather than an opaque set of
+GPU ordinals.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+
+__all__ = [
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "cpu_pinned",
+    "current_context",
+    "num_devices",
+    "num_gpus",
+    "num_tpus",
+    "device_mesh",
+]
+
+
+class Context:
+    """A logical device context.
+
+    Unlike the reference (where Context is a (device-type, device-id) pair routing into
+    per-device engine worker pools, ``src/engine/threaded_engine_perdevice.cc``), here a
+    Context resolves to a ``jax.Device`` and placement is delegated to XLA: there is no
+    user-visible stream or worker pool because XLA's async dispatch plays that role.
+
+    ``Context`` is usable as a ``with``-target to set the thread-local default device,
+    mirroring ``mx.Context.__enter__`` (python/mxnet/context.py).
+    """
+
+    # device type codes kept for serialization parity with the reference enum
+    # (include/mxnet/base.h:139-146)
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 5}
+
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in self.devstr2type:
+            raise ValueError(
+                f"unknown device type {device_type!r}; expected one of {sorted(self.devstr2type)}"
+            )
+        self.device_type = device_type
+        self.device_id = int(device_id)
+        self._old_ctx: Optional["Context"] = None
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def device_typeid(self) -> int:
+        return self.devstr2type[self.device_type]
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self) -> str:
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- jax binding ------------------------------------------------------
+    @property
+    def jax_device(self) -> jax.Device:
+        """Resolve to the backing ``jax.Device``.
+
+        ``tpu``/``gpu`` map onto the accelerator backend if present; ``cpu`` and
+        ``cpu_pinned`` map onto host devices. When the named backend is absent the
+        context degrades to the default backend (so code written for ``tpu(0)`` runs
+        unmodified under the CPU simulator used in tests).
+        """
+        want = {"cpu": "cpu", "cpu_pinned": "cpu", "gpu": None, "tpu": None}[self.device_type]
+        devices = jax.devices() if want is None else _backend_devices(want)
+        if not devices:
+            devices = jax.devices()
+        return devices[self.device_id % len(devices)]
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default_ctx, "value", None)
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        Context._default_ctx.value = self._old_ctx
+        return False
+
+    # convenience mirrors of the reference API (python/mxnet/context.py:empty_cache etc.)
+    def empty_cache(self):
+        """No-op: XLA owns the device allocator; there is no framework pool to trim."""
+
+
+def _backend_devices(platform: str):
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        return []
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Accepted for API parity; on this stack it aliases the accelerator backend."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    """The first-class TPU context (the reference has no accelerator beyond CUDA gpu())."""
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    ctx = getattr(Context._default_ctx, "value", None)
+    if ctx is None:
+        # default to the accelerator if one exists, else cpu — unlike the reference
+        # (which defaults to cpu(0)), a TPU-native framework should land tensors on
+        # the chip by default.
+        ctx = tpu(0) if jax.default_backend() not in ("cpu",) else cpu(0)
+    return ctx
+
+
+def num_devices(platform: Optional[str] = None) -> int:
+    devs = jax.devices() if platform is None else _backend_devices(platform)
+    return len(devs)
+
+
+def num_gpus() -> int:
+    """Parity shim for ``mx.context.num_gpus`` — counts accelerator devices."""
+    n = num_devices()
+    return 0 if jax.default_backend() == "cpu" else n
+
+
+def num_tpus() -> int:
+    return 0 if jax.default_backend() == "cpu" else num_devices()
+
+
+def device_mesh(shape: Sequence[int], axis_names: Sequence[str]) -> "jax.sharding.Mesh":
+    """Build a ``jax.sharding.Mesh`` over the available devices.
+
+    This is the TPU-native replacement for the reference's flat device lists
+    (``DataParallelExecutorGroup`` context lists, executor_group.py:143): parallelism is
+    expressed as named mesh axes consumed by pjit shardings and shard_map collectives.
+    """
+    import numpy as np
+
+    devices = np.asarray(jax.devices())
+    need = int(np.prod(shape))
+    if need > devices.size:
+        raise ValueError(f"mesh shape {tuple(shape)} needs {need} devices, have {devices.size}")
+    return jax.sharding.Mesh(devices[:need].reshape(shape), tuple(axis_names))

@@ -1,0 +1,75 @@
+"""``mx.nd``-equivalent namespace.
+
+The reference autogenerates ``mx.nd.*`` wrappers from the C-API op registry at import
+time (python/mxnet/ndarray/register.py); here the wrappers are generated from the
+in-process op registry. Sub-namespaces ``linalg``/``random``/``contrib`` mirror
+``mx.nd.linalg`` etc.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from typing import Optional
+
+from ..context import Context
+from ..ops import registry as _reg
+from .ndarray import (NDArray, array, concatenate, empty, from_dlpack, from_numpy,
+                      load, save, to_dlpack, waitall)
+
+_this = sys.modules[__name__]
+
+
+def _make_wrapper(key: str):
+    op = _reg.get_op(key)
+
+    def _fn(*args, **kwargs):
+        ctx: Optional[Context] = kwargs.pop("ctx", None)
+        out = _reg.invoke(op, *args, **kwargs)
+        if ctx is not None:
+            import jax
+            if isinstance(out, tuple):
+                out = tuple(NDArray(jax.device_put(o._data, ctx.jax_device)) for o in out)
+            else:
+                out = NDArray(jax.device_put(out._data, ctx.jax_device))
+        return out
+
+    _fn.__name__ = op.name
+    _fn.__doc__ = op.doc
+    return _fn
+
+
+def _populate(namespace: str, module):
+    for name in _reg.list_ops(namespace):
+        key = f"{namespace}.{name}" if namespace else name
+        if not hasattr(module, name):
+            setattr(module, name, _make_wrapper(key))
+
+
+_populate("", _this)
+
+linalg = types.ModuleType(__name__ + ".linalg")
+random = types.ModuleType(__name__ + ".random")
+contrib = types.ModuleType(__name__ + ".contrib")
+_populate("linalg", linalg)
+_populate("random", random)
+_populate("contrib", contrib)
+sys.modules[linalg.__name__] = linalg
+sys.modules[random.__name__] = random
+sys.modules[contrib.__name__] = contrib
+
+# reference-name conveniences
+def moveaxis(a, source, destination):
+    import jax.numpy as jnp
+    return NDArray(jnp.moveaxis(a._data, source, destination))
+
+
+def add_n(*args):
+    """mx.nd.add_n / ElementWiseSum."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+ElementWiseSum = add_n

@@ -1,0 +1,544 @@
+"""NDArray — the imperative tensor handle.
+
+Capability parity with the reference NDArray (``include/mxnet/ndarray.h:82``,
+``src/ndarray/``): eager ops with async semantics, device placement, in-place mutation,
+views, autograd attachment, serialization. The re-design (SURVEY.md §7 hard-parts):
+
+* The reference pairs every NDArray with an engine variable for dependency tracking;
+  ops are closures pushed onto the ThreadedEngine. **JAX's dispatch already is that
+  engine** — ops on ``jax.Array`` values are issued asynchronously and ordered by data
+  dependence, so ``NDArray`` is a thin *mutable handle* over an immutable ``jax.Array``.
+* Mutation (``+=``, ``x[i] = v``, ``out=`` kwargs, optimizer updates) is modeled by
+  swapping the handle's underlying buffer (functionally updated via ``.at[]``); views
+  (``Slice/Reshape``, ndarray.h views) write through to their base handle the same way.
+  WAR/WAW hazards cannot occur because buffers are immutable — the handle swap is the
+  only "write", and it happens on the issuing (Python) thread in program order.
+* ``WaitToRead``/``WaitToWrite`` (ndarray.h:315-323) collapse to
+  ``jax.block_until_ready``; ``asnumpy`` is the implicit sync point exactly like the
+  reference.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype_np, dtype_name
+from ..context import Context, cpu, current_context
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "empty", "concatenate", "waitall", "save", "load",
+           "from_numpy", "from_dlpack", "to_dlpack"]
+
+
+def _wrap_out(raw) -> "NDArray":
+    return NDArray(raw)
+
+
+class NDArray:
+    """Mutable tensor handle over an immutable ``jax.Array``."""
+
+    __slots__ = ("_data", "_grad", "_grad_entry", "_base", "_index", "_version",
+                 "_base_version_seen", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None,
+                 _base: Optional["NDArray"] = None, _index=None):
+        if isinstance(data, NDArray):
+            data = data.data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(np.asarray(data), dtype=dtype_np(dtype) if dtype else None)
+        elif dtype is not None:
+            data = data.astype(dtype_np(dtype))
+        if ctx is not None:
+            data = jax.device_put(data, ctx.jax_device)
+        self._data = data
+        self._grad: Optional["NDArray"] = None
+        self._grad_entry = None  # autograd: VariableEntry | (TapeNode, out_index)
+        self._base = _base       # view support: immediate parent handle
+        self._index = _index     # view support: index into the parent
+        self._version = 0
+        self._base_version_seen = _base._version if _base is not None else 0
+
+    # -- buffer access ----------------------------------------------------
+    @property
+    def data(self):
+        """Current buffer; views re-slice lazily if the base was mutated since."""
+        self._sync()
+        return self._data
+
+    def _sync(self):
+        if self._base is not None:
+            self._base._sync()
+            if self._base_version_seen != self._base._version:
+                self._data = self._base._data[self._index]
+                self._base_version_seen = self._base._version
+
+    def _set_data(self, new_data):
+        """The single mutation point (handle swap). Views write through to the
+        parent chain, which composes chained-view indices correctly."""
+        if self._base is not None:
+            self._base._sync()
+            self._base._set_data(self._base._data.at[self._index].set(
+                jnp.asarray(new_data, dtype=self._base._data.dtype)))
+            self._data = self._base._data[self._index]
+            self._base_version_seen = self._base._version
+        else:
+            self._data = new_data
+        self._version += 1
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        try:
+            dev = self._data.devices().pop()
+            plat = dev.platform
+        except Exception:
+            return cpu(0)
+        kind = {"cpu": "cpu", "gpu": "gpu", "tpu": "tpu"}.get(plat, "tpu")
+        return Context(kind, dev.id)
+
+    ctx = context
+
+    @property
+    def stype(self) -> str:
+        return "default"  # dense; sparse (row_sparse/csr) handled by sparse module
+
+    # -- sync -------------------------------------------------------------
+    def wait_to_read(self):
+        jax.block_until_ready(self.data)
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> np.ndarray:
+        out = np.asarray(jax.device_get(self.data))
+        return out
+
+    def asscalar(self):
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype else a
+
+    def __dlpack__(self, **kwargs):
+        return self.data.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self.data.__dlpack_device__()
+
+    # -- conversions / movement ------------------------------------------
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        return NDArray(self.data.astype(dtype_np(dtype)))
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        """Parity with NDArray::CopyFromTo (src/ndarray/ndarray.cc:1096)."""
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self.data, other.jax_device))
+        other._set_data(jnp.asarray(self._data, dtype=other.dtype).reshape(other.shape))
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        return NDArray(jax.device_put(self.data, ctx.jax_device))
+
+    as_in_ctx = as_in_context
+
+    def copy(self) -> "NDArray":
+        return NDArray(self.data)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self.data)
+        return out
+
+    # -- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        from .. import autograd
+        autograd._mark_variable(self, grad_req)
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    def backward(self, out_grad=None, retain_graph: bool = False,
+                 train_mode: bool = True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops (view-producing in the reference; functional here) ------
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _reg.invoke(_reg.get_op("reshape"), self, shape=shape,
+                           reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other) -> "NDArray":
+        return _reg.invoke(_reg.get_op("reshape_like"), self, other)
+
+    def flatten(self) -> "NDArray":
+        return _reg.invoke(_reg.get_op("flatten"), self)
+
+    def expand_dims(self, axis) -> "NDArray":
+        return _reg.invoke(_reg.get_op("expand_dims"), self, axis=axis)
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return _reg.invoke(_reg.get_op("squeeze"), self, axis=axis)
+
+    def transpose(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _reg.invoke(_reg.get_op("transpose"), self, axes=axes or None)
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    def swapaxes(self, dim1, dim2) -> "NDArray":
+        return _reg.invoke(_reg.get_op("swapaxes"), self, dim1=dim1, dim2=dim2)
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return _reg.invoke(_reg.get_op("broadcast_to"), self, shape=shape)
+
+    def broadcast_like(self, other) -> "NDArray":
+        return _reg.invoke(_reg.get_op("broadcast_like"), self, other)
+
+    def tile(self, reps) -> "NDArray":
+        return _reg.invoke(_reg.get_op("tile"), self, reps=reps)
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return _reg.invoke(_reg.get_op("repeat"), self, repeats=repeats, axis=axis)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _reg.invoke(_reg.get_op("split"), self, num_outputs=num_outputs,
+                           axis=axis, squeeze_axis=squeeze_axis)
+
+    def slice(self, begin, end, step=()):
+        return _reg.invoke(_reg.get_op("slice"), self, begin=begin, end=end, step=step)
+
+    def slice_axis(self, axis, begin, end):
+        return _reg.invoke(_reg.get_op("slice_axis"), self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _reg.invoke(_reg.get_op("take"), self, indices, axis=axis, mode=mode)
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return _reg.invoke(_reg.get_op("pick"), self, index, axis=axis, keepdims=keepdims)
+
+    def one_hot(self, depth, **kw):
+        return _reg.invoke(_reg.get_op("one_hot"), self, depth=depth, **kw)
+
+    def clip(self, a_min, a_max):
+        return _reg.invoke(_reg.get_op("clip"), self, a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return _reg.invoke(_reg.get_op("abs"), self)
+
+    def sign(self):
+        return _reg.invoke(_reg.get_op("sign"), self)
+
+    def sqrt(self):
+        return _reg.invoke(_reg.get_op("sqrt"), self)
+
+    def square(self):
+        return _reg.invoke(_reg.get_op("square"), self)
+
+    def exp(self):
+        return _reg.invoke(_reg.get_op("exp"), self)
+
+    def log(self):
+        return _reg.invoke(_reg.get_op("log"), self)
+
+    def relu(self):
+        return _reg.invoke(_reg.get_op("relu"), self)
+
+    def sigmoid(self):
+        return _reg.invoke(_reg.get_op("sigmoid"), self)
+
+    def tanh(self):
+        return _reg.invoke(_reg.get_op("tanh"), self)
+
+    def softmax(self, axis=-1):
+        return _reg.invoke(_reg.get_op("softmax"), self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return _reg.invoke(_reg.get_op("log_softmax"), self, axis=axis)
+
+    def astype_like(self, other):
+        return self.astype(other.dtype)
+
+    # -- reductions --------------------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return _reg.invoke(_reg.get_op("sum"), self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return _reg.invoke(_reg.get_op("mean"), self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return _reg.invoke(_reg.get_op("prod"), self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return _reg.invoke(_reg.get_op("max"), self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return _reg.invoke(_reg.get_op("min"), self, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None):
+        return _reg.invoke(_reg.get_op("argmax"), self, axis=axis)
+
+    def argmin(self, axis=None):
+        return _reg.invoke(_reg.get_op("argmin"), self, axis=axis)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _reg.invoke(_reg.get_op("norm"), self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _reg.invoke(_reg.get_op("dot"), self, other,
+                           transpose_a=transpose_a, transpose_b=transpose_b)
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of 0-d NDArray")
+        return self.shape[0]
+
+    def __bool__(self) -> bool:
+        if self.size != 1:
+            raise ValueError("truth value of multi-element NDArray is ambiguous")
+        return bool(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __repr__(self) -> str:
+        return f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- indexing ----------------------------------------------------------
+    def _norm_index(self, key):
+        if isinstance(key, NDArray):
+            return key.data.astype(jnp.int32)
+        if isinstance(key, tuple):
+            return tuple(self._norm_index(k) for k in key)
+        return key
+
+    def __getitem__(self, key) -> "NDArray":
+        idx = self._norm_index(key)
+        if _is_basic_index(idx):
+            # basic slicing returns a *view* (reference Slice semantics, ndarray.h
+            # Slice/At): chained views parent-chain, so writes compose through
+            # _set_data recursion and reads re-sync via _sync().
+            return NDArray(self.data[idx], _base=self, _index=idx)
+        return NDArray(self.data[idx])
+
+    def __setitem__(self, key, value):
+        idx = self._norm_index(key)
+        if isinstance(value, NDArray):
+            value = value.data
+        self._sync()
+        self._set_data(self._data.at[idx].set(
+            jnp.asarray(value, dtype=self._data.dtype)
+            if not isinstance(value, jax.Array) else value.astype(self._data.dtype)))
+
+    # -- arithmetic --------------------------------------------------------
+    def _binop(self, name, other, reverse=False):
+        op = _reg.get_op(name)
+        if reverse:
+            return _reg.invoke(op, other, self)
+        return _reg.invoke(op, self, other)
+
+    def __add__(self, o):
+        return self._binop("add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop("subtract", o)
+
+    def __rsub__(self, o):
+        return self._binop("subtract", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binop("multiply", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop("divide", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("divide", o, reverse=True)
+
+    def __mod__(self, o):
+        return self._binop("mod", o)
+
+    def __rmod__(self, o):
+        return self._binop("mod", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._binop("power", o)
+
+    def __rpow__(self, o):
+        return self._binop("power", o, reverse=True)
+
+    def __neg__(self):
+        return _reg.invoke(_reg.get_op("negative"), self)
+
+    def __abs__(self):
+        return _reg.invoke(_reg.get_op("abs"), self)
+
+    def __eq__(self, o):
+        return self._binop("equal", o)
+
+    def __ne__(self, o):
+        return self._binop("not_equal", o)
+
+    def __gt__(self, o):
+        return self._binop("greater", o)
+
+    def __ge__(self, o):
+        return self._binop("greater_equal", o)
+
+    def __lt__(self, o):
+        return self._binop("lesser", o)
+
+    def __le__(self, o):
+        return self._binop("lesser_equal", o)
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: swap the handle's buffer (the reference mutates the chunk through
+    # engine write-deps; here program order on the issuing thread gives the same
+    # serialization for free).
+    def _iop(self, name, other):
+        res = self._binop(name, other)
+        self._set_data(res._data.astype(self._data.dtype))
+        return self
+
+    def __iadd__(self, o):
+        return self._iop("add", o)
+
+    def __isub__(self, o):
+        return self._iop("subtract", o)
+
+    def __imul__(self, o):
+        return self._iop("multiply", o)
+
+    def __itruediv__(self, o):
+        return self._iop("divide", o)
+
+
+def _is_basic_index(idx) -> bool:
+    basic = (int, slice, type(None), type(Ellipsis))
+    if isinstance(idx, basic):
+        return True
+    if isinstance(idx, tuple):
+        return all(isinstance(i, basic) for i in idx)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# creation / io helpers
+# ---------------------------------------------------------------------------
+
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source, NDArray):
+        return NDArray(source.data, ctx=ctx, dtype=dtype)
+    keep_dtype = isinstance(source, (np.ndarray, jax.Array)) or np.isscalar(source)
+    arr = np.asarray(source, dtype=dtype_np(dtype) if dtype else None)
+    if dtype is None and (arr.dtype == np.float64 or not keep_dtype):
+        # reference semantics (python/mxnet/ndarray/utils.py array): python lists
+        # default to float32; numpy arrays keep their dtype (float64 narrowed).
+        arr = arr.astype(np.float32)
+    return NDArray(arr, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(jnp.zeros(shape if not isinstance(shape, int) else (shape,),
+                             dtype_np(dtype)), ctx=ctx)
+
+
+def from_numpy(a: np.ndarray, zero_copy: bool = False) -> NDArray:
+    return NDArray(jnp.asarray(a))
+
+
+def from_dlpack(ext) -> NDArray:
+    """Accepts any object implementing the dlpack protocol (dlpack parity, §2.7)."""
+    return NDArray(jnp.from_dlpack(ext))
+
+
+def to_dlpack(arr: NDArray):
+    """Return the dlpack-capable device array (consumers call __dlpack__ on it)."""
+    return arr.data
+
+
+def concatenate(arrays: Sequence[NDArray], axis: int = 0) -> NDArray:
+    return _reg.invoke(_reg.get_op("concat"), *arrays, dim=axis)
+
+
+def waitall():
+    """Parity with mx.nd.waitall — drain all outstanding async work."""
+    jax.effects_barrier()
+
+
+# ---------------------------------------------------------------------------
+# serialization — reference NDArray::Save/Load capability (ndarray.cc:1537,1650)
+# with a format native to this framework (npz container, names preserved).
+# ---------------------------------------------------------------------------
+
+
+def save(fname: str, data):
+    """Save an NDArray, list of NDArrays, or dict of name→NDArray (mx.nd.save parity)."""
+    if isinstance(data, NDArray):
+        payload, names = {"arr_0": data.asnumpy()}, None
+    elif isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+        names = list(data)
+    elif isinstance(data, (list, tuple)):
+        payload = {f"arr_{i}": v.asnumpy() for i, v in enumerate(data)}
+        names = None
+    else:
+        raise TypeError(f"cannot save {type(data)}")
+    with open(fname, "wb") as f:
+        np.savez(f, **payload)
+
+
+def load(fname: str):
+    """Load from ``save``; returns dict if named, else list (mx.nd.load parity)."""
+    with open(fname, "rb") as f:
+        with np.load(f, allow_pickle=False) as z:
+            keys = list(z.keys())
+            if all(k.startswith("arr_") for k in keys):
+                return [NDArray(z[f"arr_{i}"]) for i in range(len(keys))]
+            return {k: NDArray(z[k]) for k in keys}

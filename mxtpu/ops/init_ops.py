@@ -1,0 +1,60 @@
+"""Creation ops — parity with ``src/operator/tensor/init_op.cc`` (zeros/ones/arange/eye…).
+
+These take no array inputs; ``ctx`` placement is applied by the NDArray wrapper layer
+(creation lands on the current default device; explicit ``ctx=`` triggers a device_put).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register
+
+
+@register("zeros", differentiable=False)
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(tuple(shape) if not isinstance(shape, int) else (shape,), dtype_np(dtype))
+
+
+@register("ones", differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(tuple(shape) if not isinstance(shape, int) else (shape,), dtype_np(dtype))
+
+
+@register("full", differentiable=False)
+def _full(shape=(), val: float = 0.0, dtype="float32"):
+    return jnp.full(tuple(shape) if not isinstance(shape, int) else (shape,), val, dtype_np(dtype))
+
+
+@register("zeros_like", differentiable=False)
+def _zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like", differentiable=False)
+def _ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("full_like", differentiable=False)
+def _full_like(data, fill_value: float = 0.0):
+    return jnp.full_like(data, fill_value)
+
+
+@register("arange", differentiable=False)
+def _arange(start=0, stop=None, step: float = 1.0, repeat: int = 1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("linspace", differentiable=False)
+def _linspace(start=0.0, stop=1.0, num: int = 50, endpoint: bool = True, dtype="float32"):
+    return jnp.linspace(start, stop, num, endpoint=endpoint, dtype=dtype_np(dtype))
+
+
+@register("eye", differentiable=False)
+def _eye(N: int, M: int = 0, k: int = 0, dtype="float32"):
+    return jnp.eye(N, M if M else None, k=k, dtype=dtype_np(dtype))

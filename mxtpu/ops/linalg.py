@@ -1,0 +1,140 @@
+"""Linear-algebra ops — parity with ``src/operator/tensor/la_op.{h,cc}`` (LAPACK wrappers).
+
+The reference wraps LAPACK/cuSOLVER behind ``linalg_*`` ops; here they are
+jax.numpy.linalg / lax.linalg calls, which XLA lowers to MXU-friendly blocked kernels on
+TPU. Registered under the ``linalg`` namespace (``mx.nd.linalg.*``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+NS = "linalg"
+
+
+@register("gemm", namespace=NS)
+def _gemm(A, B, C, transpose_a: bool = False, transpose_b: bool = False,
+          alpha: float = 1.0, beta: float = 1.0, axis: int = -2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("gemm2", namespace=NS)
+def _gemm2(A, B, transpose_a: bool = False, transpose_b: bool = False, alpha: float = 1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("potrf", namespace=NS)
+def _potrf(A):
+    """Cholesky factor L with A = L Lᵀ (la_op.cc linalg_potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("potri", namespace=NS)
+def _potri(A):
+    """Inverse from Cholesky factor: given L, compute (L Lᵀ)⁻¹."""
+    ident = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = lax.linalg.triangular_solve(A, ident, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("trsm", namespace=NS)
+def _trsm(A, B, transpose: bool = False, rightside: bool = False, lower: bool = True,
+          alpha: float = 1.0):
+    out = lax.linalg.triangular_solve(A, alpha * B, left_side=not rightside,
+                                      lower=lower, transpose_a=transpose)
+    return out
+
+
+@register("trmm", namespace=NS)
+def _trmm(A, B, transpose: bool = False, rightside: bool = False, lower: bool = True,
+          alpha: float = 1.0):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("syrk", namespace=NS)
+def _syrk(A, transpose: bool = False, alpha: float = 1.0):
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register("sumlogdiag", namespace=NS)
+def _sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("extractdiag", namespace=NS)
+def _extractdiag(A, offset: int = 0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("makediag", namespace=NS)
+def _makediag(A, offset: int = 0):
+    if offset == 0:
+        return jnp.apply_along_axis(jnp.diag, -1, A) if A.ndim > 1 else jnp.diag(A)
+    n = A.shape[-1] + abs(offset)
+    base = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+    return base.at[..., r, c].set(A)
+
+
+@register("extracttrian", namespace=NS)
+def _extracttrian(A, offset: int = 0, lower: bool = True):
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register("maketrian", namespace=NS)
+def _maketrian(A, offset: int = 0, lower: bool = True):
+    m = A.shape[-1]
+    # solve n(n+1)/2 (+ offset corrections) ≈ m for n
+    import math
+    n = int((math.isqrt(8 * m + 1) - 1) // 2) + abs(offset)
+    rows, cols = (jnp.tril_indices(n, k=offset) if lower else jnp.triu_indices(n, k=offset))
+    base = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    return base.at[..., rows, cols].set(A)
+
+
+@register("inverse", namespace=NS)
+def _inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("det", namespace=NS)
+def _det(A):
+    return jnp.linalg.det(A)
+
+
+@register("slogdet", namespace=NS, num_outputs=2)
+def _slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("svd", namespace=NS, num_outputs=3)
+def _svd(A):
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return u, s, vt
+
+
+@register("eigh", namespace=NS, num_outputs=2)
+def _eigh(A):
+    w, v = jnp.linalg.eigh(A)
+    return w, v
+
+
+@register("qr", namespace=NS, num_outputs=2)
+def _qr(A):
+    q, r = jnp.linalg.qr(A)
+    return q, r

@@ -1,0 +1,369 @@
+"""Matrix / shape-manipulation / indexing ops.
+
+Parity targets: ``src/operator/tensor/matrix_op-inl.h`` (reshape/transpose/slice/concat/
+tile/repeat/pad/flip/depth-space), ``dot-inl.h`` (dot/batch_dot with transpose flags),
+``indexing_op.h`` (take/batch_take/one_hot/gather_nd/scatter_nd/pick/Embedding-gather).
+The MXU note: ``dot``/``batch_dot`` lower to ``lax.dot_general``, which is exactly what
+the systolic array wants — keep operands large and let callers pick bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# dot family
+# ---------------------------------------------------------------------------
+
+
+@register("dot")
+def _dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    """Reference ``dot`` (dot-inl.h): contract lhs's last axis with rhs's first.
+
+    For 2-D this is matmul with optional operand transposes; for >2-D it reduces the
+    last axis of lhs against the first of rhs (tensordot semantics), matching
+    mx.nd.dot.
+    """
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2) if lhs.ndim >= 2 else lhs
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, 0, 1) if rhs.ndim >= 2 else rhs
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    """Batched matmul over leading batch dims (dot-inl.h batch_dot) → lax.dot_general."""
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    """Column-wise Khatri-Rao product (reference contrib/krprod.cc)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reshape & friends
+# ---------------------------------------------------------------------------
+
+
+def _mx_reshape_shape(data_shape: Tuple[int, ...], spec) -> Tuple[int, ...]:
+    """Implement the reference's reshape special codes (matrix_op-inl.h ReshapeParam):
+
+    0 = copy this dim; -1 = infer; -2 = copy all remaining dims; -3 = merge two
+    consecutive input dims; -4 = split one input dim into the next two spec values.
+    """
+    out = []
+    src = list(data_shape)
+    i = 0  # index into src
+    j = 0  # index into spec
+    spec = list(spec)
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            cur = src[i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(int(s)); i += 1
+        j += 1
+    # resolve single -1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(data_shape)) if data_shape else 1
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+@register("reshape", aliases=("Reshape",))
+def _reshape(data, shape=None, reverse: bool = False):
+    tgt = _mx_reshape_shape(tuple(data.shape)[::-1] if reverse else tuple(data.shape),
+                            tuple(shape)[::-1] if reverse else tuple(shape))
+    if reverse:
+        tgt = tgt[::-1]
+    return jnp.reshape(data, tgt)
+
+
+@register("reshape_like")
+def _reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("flatten", aliases=("Flatten",))
+def _flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(data, axes=None):
+    return jnp.transpose(data, axes if axes else None)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(data, dim1: int = 0, dim2: int = 0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def _expand_dims(data, axis: int = 0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def _squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register("broadcast_to")
+def _broadcast_to(data, shape):
+    # reference: 0 in target shape means keep source dim
+    tgt = tuple(s if t == 0 else t for s, t in zip(data.shape, shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def _broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(data, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("cast", aliases=("Cast",), differentiable=False)
+def _cast(data, dtype="float32"):
+    from ..base import dtype_np
+    return data.astype(dtype_np(dtype))
+
+
+@register("stop_gradient", aliases=("BlockGrad",), differentiable=False)
+def _stop_gradient(data):
+    return lax.stop_gradient(data)
+
+
+@register("identity", aliases=("_copy",))
+def _identity(data):
+    return jnp.asarray(data)
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def _size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# concat / split / stack / slice
+# ---------------------------------------------------------------------------
+
+
+@register("concat", aliases=("Concat", "concatenate"))
+def _concat(*data, dim: int = 1):
+    """NB: reference default axis is 1 (Concat op), not 0."""
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("stack")
+def _stack(*data, axis: int = 0):
+    return jnp.stack(data, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",), num_outputs=-1)
+def _split(data, num_outputs: int = 1, axis: int = 1, squeeze_axis: bool = False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("slice", aliases=("crop",))
+def _slice(data, begin=(), end=(), step=()):
+    """Reference slice op (matrix_op-inl.h SliceParam): None-able begin/end per axis."""
+    nd = data.ndim
+    begin = tuple(begin) + (None,) * (nd - len(begin))
+    end = tuple(end) + (None,) * (nd - len(end))
+    step = tuple(step) + (None,) * (nd - len(step)) if step else (None,) * nd
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis")
+def _slice_axis(data, axis: int = 0, begin: int = 0, end: Optional[int] = None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(data, shape_like, axes=()):
+    axes = axes or tuple(range(shape_like.ndim))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("reverse", aliases=("flip",))
+def _reverse(data, axis=0):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axis)
+
+
+@register("tile")
+def _tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("repeat")
+def _repeat(data, repeats: int = 1, axis: Optional[int] = None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def _pad(data, mode: str = "constant", pad_width=(), constant_value: float = 0.0):
+    """Reference Pad op (pad.cc): pad_width is a flat (before,after) list per axis."""
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    while len(pw) < data.ndim:
+        pw.append((0, 0))
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register("depth_to_space")
+def _depth_to_space(data, block_size: int):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _space_to_depth(data, block_size: int):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+
+@register("take")
+def _take(a, indices, axis: int = 0, mode: str = "clip"):
+    jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+
+
+@register("batch_take")
+def _batch_take(a, indices):
+    return jnp.take_along_axis(a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("pick")
+def _pick(data, index, axis: int = -1, keepdims: bool = False, mode: str = "clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis)
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(indices, depth: int, on_value: float = 1.0, off_value: float = 0.0,
+             dtype="float32"):
+    from ..base import dtype_np
+    eye = jnp.equal(indices.astype(jnp.int32)[..., None],
+                    jnp.arange(depth, dtype=jnp.int32))
+    return jnp.where(eye, on_value, off_value).astype(dtype_np(dtype))
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[idx].add(data)
+
+
+@register("where")
+def _where(condition, x, y):
+    return jnp.where(condition.astype(bool) if hasattr(condition, "astype") else condition, x, y)
+
+
+@register("Embedding", aliases=("embedding",))
+def _embedding(data, weight, input_dim: int = 0, output_dim: int = 0, dtype="float32",
+               sparse_grad: bool = False):
+    """Embedding lookup (src/operator/tensor/indexing_op.cc Embedding): a gather.
+
+    On TPU the MXU-friendly formulation for small vocabularies would be one-hot matmul,
+    but XLA lowers gather efficiently; sparse_grad is accepted for API parity (gradients
+    are dense — the row-sparse path is a kvstore concern, SURVEY.md §7 hard-parts).
+    """
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("diag")
+def _diag(data, k: int = 0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("ravel_multi_index", differentiable=False)
+def _ravel_multi_index(data, shape):
+    idx = tuple(data.astype(jnp.int32))
+    return jnp.asarray(jnp.ravel_multi_index(idx, tuple(shape), mode="clip"),
+                       dtype=jnp.float32)
+
+
+@register("unravel_index", differentiable=False)
+def _unravel_index(data, shape):
+    out = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    return jnp.stack(out).astype(jnp.float32)

@@ -1,0 +1,57 @@
+"""Ordering ops — parity with ``src/operator/tensor/ordering_op-inl.h`` (topk/sort/argsort).
+
+TPU note: XLA's sort is a bitonic network on the VPU; top-k uses ``lax.top_k`` which is
+substantially cheaper than a full sort for small k.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("sort", differentiable=False)
+def _sort(data, axis: Optional[int] = -1, is_ascend: bool = True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis if axis is not None else 0)
+    return out
+
+
+@register("argsort", differentiable=False)
+def _argsort(data, axis: Optional[int] = -1, is_ascend: bool = True, dtype="float32"):
+    from ..base import dtype_np
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis if axis is not None else 0)
+    return out.astype(dtype_np(dtype))
+
+
+@register("topk", differentiable=False)
+def _topk(data, axis: Optional[int] = -1, k: int = 1, ret_typ: str = "indices",
+          is_ascend: bool = False, dtype="float32"):
+    """Reference topk (ordering_op-inl.h): ret_typ ∈ {value, indices, mask, both}."""
+    from ..base import dtype_np
+    ax = axis if axis is not None else data.ndim - 1
+    moved = jnp.moveaxis(data, ax, -1)
+    src = -moved if is_ascend else moved
+    vals, idx = lax.top_k(src, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxf = jnp.moveaxis(idx, -1, ax).astype(dtype_np(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxf
+    if ret_typ == "mask":
+        mask = jnp.zeros_like(moved).at[
+            tuple(jnp.indices(idx.shape))[:-1] + (idx,)].set(1)
+        return jnp.moveaxis(mask, -1, ax)
+    if ret_typ == "both":
+        return vals, idxf
+    raise ValueError(f"unknown ret_typ {ret_typ!r}")

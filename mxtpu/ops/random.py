@@ -1,0 +1,151 @@
+"""Random sampling ops — parity with ``src/operator/random/`` (SURVEY.md §2.2).
+
+The reference's samplers run on a per-device counter-based PRNG resource
+(kParallelRandom); JAX's threefry keys ARE that design, so each op draws a key from
+``mxtpu.rng`` (trace-aware — see rng.py). Registered in the ``random`` namespace and
+also exposed as ``nd.random_*`` aliases for reference-name parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .. import rng
+from .registry import register
+
+NS = "random"
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+@register("uniform", namespace=NS, differentiable=False, aliases=("random_uniform",))
+def _uniform(low: float = 0.0, high: float = 1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    return jax.random.uniform(k, _shape(shape), dtype_np(dtype), low, high)
+
+
+@register("normal", namespace=NS, differentiable=False,
+          aliases=("random_normal", "randn"))
+def _normal(loc: float = 0.0, scale: float = 1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    return loc + scale * jax.random.normal(k, _shape(shape), dtype_np(dtype))
+
+
+@register("gamma", namespace=NS, differentiable=False, aliases=("random_gamma",))
+def _gamma(alpha: float = 1.0, beta: float = 1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    return beta * jax.random.gamma(k, alpha, _shape(shape), dtype_np(dtype))
+
+
+@register("exponential", namespace=NS, differentiable=False,
+          aliases=("random_exponential",))
+def _exponential(lam: float = 1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    return jax.random.exponential(k, _shape(shape), dtype_np(dtype)) / lam
+
+
+@register("poisson", namespace=NS, differentiable=False, aliases=("random_poisson",))
+def _poisson(lam: float = 1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    return jax.random.poisson(k, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("negative_binomial", namespace=NS, differentiable=False,
+          aliases=("random_negative_binomial",))
+def _negative_binomial(k: int = 1, p: float = 1.0, shape=None, dtype="float32", key=None):
+    kk = key if key is not None else rng.next_key()
+    k1, k2 = jax.random.split(kk)
+    # NB(k,p) = Poisson(Gamma(k, (1-p)/p))
+    lam = jax.random.gamma(k1, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("generalized_negative_binomial", namespace=NS, differentiable=False,
+          aliases=("random_generalized_negative_binomial",))
+def _gen_negative_binomial(mu: float = 1.0, alpha: float = 1.0, shape=None,
+                           dtype="float32", key=None):
+    kk = key if key is not None else rng.next_key()
+    k1, k2 = jax.random.split(kk)
+    if alpha == 0:
+        return jax.random.poisson(k1, mu, _shape(shape)).astype(dtype_np(dtype))
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("randint", namespace=NS, differentiable=False, aliases=("random_randint",))
+def _randint(low: int = 0, high: int = 1, shape=None, dtype="int32", key=None):
+    k = key if key is not None else rng.next_key()
+    return jax.random.randint(k, _shape(shape), low, high, dtype_np(dtype))
+
+
+@register("multinomial", namespace=NS, differentiable=False,
+          aliases=("sample_multinomial",))
+def _multinomial(data, shape=None, get_prob: bool = False, dtype="int32", key=None):
+    """Sample indices from (batched) probability rows (sample_multinomial_op.h)."""
+    k = key if key is not None else rng.next_key()
+    n = 1 if shape is None else int(jnp.prod(jnp.asarray(_shape(shape))))
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(k, logits, shape=(n,))
+        out = out if shape is not None else out[0]
+    else:
+        out = jax.random.categorical(k, logits[:, None, :].repeat(n, 1), axis=-1)
+        out = out if shape is not None else out[:, 0]
+    out = out.astype(dtype_np(dtype))
+    if get_prob:
+        logp = jnp.log(jnp.take_along_axis(
+            data if data.ndim > 1 else data[None, :],
+            jnp.atleast_2d(out).astype(jnp.int32), axis=-1)).reshape(jnp.shape(out))
+        return out, logp
+    return out
+
+
+@register("shuffle", namespace=NS, differentiable=False, aliases=("_shuffle",))
+def _random_shuffle(data, key=None):
+    k = key if key is not None else rng.next_key()
+    return jax.random.permutation(k, data, axis=0)
+
+
+@register("bernoulli", namespace=NS, differentiable=False)
+def _bernoulli(p: float = 0.5, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    return jax.random.bernoulli(k, p, _shape(shape)).astype(dtype_np(dtype))
+
+
+# sample_* variants: per-element distribution parameters given as arrays
+# (src/operator/random/sample_op.cc sample_uniform etc.)
+
+@register("sample_uniform", namespace=NS, differentiable=False)
+def _sample_uniform(low, high, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    s = _shape(shape)
+    u = jax.random.uniform(k, jnp.shape(low) + s, dtype_np(dtype))
+    return low.reshape(low.shape + (1,) * len(s)) + u * (high - low).reshape(
+        high.shape + (1,) * len(s))
+
+
+@register("sample_normal", namespace=NS, differentiable=False)
+def _sample_normal(mu, sigma, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    s = _shape(shape)
+    z = jax.random.normal(k, jnp.shape(mu) + s, dtype_np(dtype))
+    return mu.reshape(mu.shape + (1,) * len(s)) + z * sigma.reshape(sigma.shape + (1,) * len(s))
+
+
+@register("sample_gamma", namespace=NS, differentiable=False)
+def _sample_gamma(alpha, beta, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    s = _shape(shape)
+    g = jax.random.gamma(k, alpha.reshape(alpha.shape + (1,) * len(s)),
+                         jnp.shape(alpha) + s, dtype_np(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(s))

@@ -1,0 +1,93 @@
+"""Reduction ops — parity with ``src/operator/tensor/broadcast_reduce_op_*`` families.
+
+The reference's reduce kernels (broadcast_reduce-inl.h) take ``axis``/``keepdims``/
+``exclude`` attrs; ``exclude=True`` reduces over all axes NOT listed — preserved here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+def _norm_axis(axis, ndim, exclude):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _make_reduce(jfn, name, aliases=(), differentiable=True, int_out=False):
+    def _fn(data, axis=None, keepdims: bool = False, exclude: bool = False):
+        ax = _norm_axis(axis, jnp.ndim(data), exclude)
+        return jfn(data, axis=ax, keepdims=keepdims)
+
+    _fn.__name__ = name
+    _fn.__doc__ = f"Reduce-{name} over ``axis`` (exclude inverts the axis set)."
+    register(name, aliases=aliases, differentiable=differentiable)(_fn)
+    return _fn
+
+
+_make_reduce(jnp.sum, "sum", aliases=("sum_axis",))
+_make_reduce(jnp.mean, "mean")
+_make_reduce(jnp.prod, "prod")
+_make_reduce(jnp.nansum, "nansum")
+_make_reduce(jnp.nanprod, "nanprod")
+_make_reduce(jnp.max, "max", aliases=("max_axis",))
+_make_reduce(jnp.min, "min", aliases=("min_axis",))
+_make_reduce(jnp.all, "all", differentiable=False)
+_make_reduce(jnp.any, "any", differentiable=False)
+
+
+@register("argmax", differentiable=False)
+def _argmax(data, axis=None, keepdims: bool = False):
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)  # reference returns float indices (argmax.cc)
+
+
+@register("argmin", differentiable=False)
+def _argmin(data, axis=None, keepdims: bool = False):
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(data):
+    """argmax over axis 1 (the reference's SoftmaxOutput companion)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("norm")
+def _norm(data, ord: int = 2, axis=None, keepdims: bool = False):
+    """L1/L2 norm reduction (reference norm op, tensor/broadcast_reduce_op_value.cc)."""
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@register("L2Normalization", aliases=("l2_normalization",))
+def _l2_normalization(data, eps: float = 1e-10, mode: str = "instance"):
+    """Reference src/operator/l2_normalization-inl.h: normalize by L2 norm.
+
+    mode: 'instance' (per sample over all dims), 'channel' (axis 1), 'spatial'
+    (per-channel over trailing spatial dims).
+    """
+    if mode == "instance":
+        axes = tuple(range(1, jnp.ndim(data)))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, jnp.ndim(data)))
+    else:
+        raise ValueError(f"unknown L2Normalization mode {mode!r}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm

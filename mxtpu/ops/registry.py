@@ -1,0 +1,150 @@
+"""Operator registry — the NNVM-registry equivalent, collapsed to its useful core.
+
+The reference registers ~205 ops via ``NNVM_REGISTER_OP`` with attr functors
+(``FCompute``/``FInferShape``/``FGradient``/…, ``include/mxnet/op_attr_types.h``) because its
+executor needs shape/type inference, storage dispatch, and hand-written gradients as separate
+graph passes. On this stack a registered op is just a **pure JAX-traceable function**:
+
+* shape/dtype inference  → free from jax tracing (``jax.eval_shape``),
+* gradients              → free from ``jax.vjp`` (no ``FGradient``/``_backward_*`` twins),
+* kernel dispatch        → XLA (with Pallas overrides for hot ops),
+* async scheduling       → JAX's dispatch (no dependency engine).
+
+What we keep from the registry idea: a **name → op table** (drives ``mx.nd.*`` wrapper
+generation and alias parity with the reference op names), per-op metadata (number of
+outputs, differentiability), and an imperative ``invoke`` entry point that unwraps
+``NDArray`` handles, runs the function, wraps results, and notifies the autograd tape —
+the collapsed analogue of ``MXImperativeInvokeEx → Imperative::Invoke``
+(src/c_api/c_api_ndarray.cc:81-143, src/imperative/imperative.cc:87).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "alias"]
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "num_outputs", "differentiable", "aliases", "doc",
+                 "namespace", "resolve_kwargs")
+
+    def __init__(self, name: str, fn: Callable, num_outputs: int = 1,
+                 differentiable: bool = True, aliases: Sequence[str] = (),
+                 namespace: str = "", resolve_kwargs: Optional[Callable] = None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.aliases = tuple(aliases)
+        self.doc = fn.__doc__
+        self.namespace = namespace  # "" (nd root), "linalg", "random", "contrib", "image"
+        # Ops with implicit state (RNG keys, training flag) resolve it to concrete
+        # kwargs at invoke time so the recorded tape closure replays identically
+        # under jax.vjp (the reference has no replay — its backward kernels read
+        # saved state; here determinism must be captured in the closure).
+        self.resolve_kwargs = resolve_kwargs
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+_OPS: Dict[str, OpDef] = {}
+
+
+def register(name: Optional[str] = None, *, num_outputs: int = 1,
+             differentiable: bool = True, aliases: Sequence[str] = (),
+             namespace: str = "", resolve_kwargs: Optional[Callable] = None):
+    """Register a pure JAX function as a framework op.
+
+    The function receives raw ``jax.Array``/scalar positional inputs plus keyword attrs
+    and must be jit-traceable (static attrs only in kwargs). ``num_outputs`` may be -1
+    for ops whose output count depends on attrs (e.g. ``split``).
+    """
+
+    def _wrap(fn: Callable):
+        opname = name or fn.__name__
+        op = OpDef(opname, fn, num_outputs, differentiable, aliases, namespace,
+                   resolve_kwargs)
+        key = f"{namespace}.{opname}" if namespace else opname
+        if key in _OPS:
+            raise ValueError(f"duplicate op registration: {key}")
+        _OPS[key] = op
+        for a in aliases:
+            akey = f"{namespace}.{a}" if namespace else a
+            _OPS.setdefault(akey, op)
+        return fn
+
+    return _wrap
+
+
+def alias(existing: str, *names: str, namespace: str = ""):
+    """Register extra reference-parity names for an already-registered op."""
+    op = get_op(existing)
+    for n in names:
+        key = f"{namespace}.{n}" if namespace else n
+        _OPS.setdefault(key, op)
+
+
+def get_op(name: str) -> OpDef:
+    if name not in _OPS:
+        raise KeyError(f"op {name!r} not registered")
+    return _OPS[name]
+
+
+def list_ops(namespace: Optional[str] = None) -> List[str]:
+    if namespace is None:
+        return sorted(_OPS)
+    prefix = f"{namespace}." if namespace else ""
+    out = []
+    for k in _OPS:
+        if namespace == "" and "." not in k:
+            out.append(k)
+        elif prefix and k.startswith(prefix):
+            out.append(k[len(prefix):])
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# imperative invoke
+# ---------------------------------------------------------------------------
+
+def invoke(op: OpDef, *args, out=None, **kwargs):
+    """Run an op imperatively on NDArray/scalar inputs.
+
+    Collapsed version of the reference call stack (SURVEY.md §3.1): no SetShapeType /
+    DispatchMode / engine push — JAX traces, compiles (op-by-op eager → XLA), and
+    schedules asynchronously. Autograd recording mirrors ``Imperative::RecordOp``
+    (src/imperative/imperative.cc:183): if the thread-local tape is live, the op, its
+    NDArray inputs, and the produced outputs are appended so ``backward()`` can replay
+    VJPs.
+    """
+    from ..ndarray.ndarray import NDArray, _wrap_out
+
+    if op.resolve_kwargs is not None:
+        kwargs = op.resolve_kwargs(dict(kwargs))
+
+    raw = [a.data if isinstance(a, NDArray) else a for a in args]
+    result = op.fn(*raw, **kwargs)
+
+    multi = isinstance(result, (tuple, list))
+    outs = [_wrap_out(r) for r in result] if multi else [_wrap_out(result)]
+
+    if out is not None:
+        # reference in-place `out=` convention (mx.nd op out= kwarg): overwrite the
+        # destination handles' buffers; the destinations become the op outputs so a
+        # live tape records onto the handles the caller keeps.
+        targets = out if isinstance(out, (tuple, list)) else [out]
+        for t, o in zip(targets, outs):
+            t._set_data(o._data)
+        outs = list(targets)
+
+    from .. import autograd
+    if autograd.is_recording() and op.differentiable:
+        nd_in = [(i, a) for i, a in enumerate(args) if isinstance(a, NDArray)]
+        if nd_in:
+            autograd._record(op, args, kwargs, nd_in, outs)
+
+    if out is not None:
+        return out
+    return tuple(outs) if multi else outs[0]

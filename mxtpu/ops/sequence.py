@@ -1,0 +1,55 @@
+"""Sequence ops — parity with ``src/operator/sequence_{mask,last,reverse}-inl.h``.
+
+Layout follows the reference: sequence axis 0, batch axis 1 (TNC). These are the
+building blocks for variable-length RNN/attention batches (with bucketing at the
+iterator/module layer, SURVEY.md §5 long-context notes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _steps(data):
+    return jnp.arange(data.shape[0])[:, None]  # (T,1) broadcast against (B,)
+
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def _sequence_mask(data, sequence_length=None, use_sequence_length: bool = False,
+                   value: float = 0.0, axis: int = 0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    if axis == 1:
+        data_t = jnp.swapaxes(data, 0, 1)
+        out = _sequence_mask(data_t, sequence_length, True, value, 0)
+        return jnp.swapaxes(out, 0, 1)
+    mask = _steps(data) < sequence_length[None, :].astype(jnp.int32)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceLast", aliases=("sequence_last",))
+def _sequence_last(data, sequence_length=None, use_sequence_length: bool = False,
+                   axis: int = 0):
+    if axis == 1:
+        data = jnp.swapaxes(data, 0, 1)
+    if not use_sequence_length or sequence_length is None:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1).clip(0)
+    return jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def _sequence_reverse(data, sequence_length=None, use_sequence_length: bool = False,
+                      axis: int = 0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    lens = sequence_length.astype(jnp.int32)[None, :]  # (1,B)
+    t = _steps(data)  # (T,1)
+    src = jnp.where(t < lens, lens - 1 - t, t)  # reverse within length, keep tail
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)

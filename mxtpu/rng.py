@@ -1,0 +1,77 @@
+"""Framework PRNG state — the TPU-native take on the reference's random resources.
+
+The reference gives ops per-device PRNG resources (``ResourceManager`` kRandom /
+kParallelRandom, include/mxnet/resource.h:38-46) seeded by ``mx.random.seed``. JAX PRNG
+is explicit-key, counter-based (threefry) — already the "parallel random" design — so the
+framework keeps ONE global key per process and splits from it for every stochastic op.
+
+Two modes:
+
+* **Eager**: ``next_key()`` splits the global key — each imperative random op draws a
+  fresh, reproducible stream.
+* **Traced** (inside ``CachedOp``/hybridize tracing): a *key provider* is installed so
+  ``next_key()`` yields keys split from a traced key argument. The trace counts how many
+  keys it consumed; every subsequent call of the compiled function feeds a fresh key, so
+  dropout/sampling differ per step exactly like the reference's random resource — without
+  impure ops inside jit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+_state = threading.local()
+
+
+def _global():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+    return _state
+
+
+def seed(seed_state: int):
+    """Parity with ``mx.random.seed`` (python/mxnet/random.py)."""
+    _global().key = jax.random.key(int(seed_state))
+
+
+class _TraceProvider:
+    """Splits keys deterministically from one traced base key."""
+
+    def __init__(self, base_key):
+        self.base = base_key
+        self.count = 0
+
+    def next(self):
+        k = jax.random.fold_in(self.base, self.count)
+        self.count += 1
+        return k
+
+
+def push_trace_provider(base_key) -> "_TraceProvider":
+    st = _global()
+    if not hasattr(st, "providers"):
+        st.providers = []
+    p = _TraceProvider(base_key)
+    st.providers.append(p)
+    return p
+
+
+def pop_trace_provider():
+    _global().providers.pop()
+
+
+def in_trace() -> bool:
+    st = _global()
+    return bool(getattr(st, "providers", None))
+
+
+def next_key():
+    st = _global()
+    providers: List[_TraceProvider] = getattr(st, "providers", [])
+    if providers:
+        return providers[-1].next()
+    st.key, sub = jax.random.split(st.key)
+    return sub

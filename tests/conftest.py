@@ -1,0 +1,22 @@
+"""Test config: force an 8-device CPU "pod simulator" before JAX initializes backends.
+
+This is the NaiveEngine-equivalent deterministic backend of the reference's test
+strategy (SURVEY.md §4): CPU is the oracle, and the 8 virtual host devices stand in for
+a TPU slice so sharding/collective tests run without real chips.
+
+Note: the environment boots an `axon` TPU PJRT plugin from sitecustomize and pins
+``JAX_PLATFORMS=axon``, so plain env vars are not enough — we override the jax config
+directly (backends are not yet initialized when conftest loads).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,174 @@
+"""Autograd tests — modeled on tests/python/unittest/test_autograd.py of the reference."""
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = y * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp(2 * np.asarray([0.5, 1.0])),
+                               rtol=1e-5)
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([2.0, 4.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [6, 12])
+
+
+def test_multi_path_accumulation():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [7.0])  # 2x + 3
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_detach_blocks_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # only d(y_const*x)/dx = y = 4
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_pause_scope():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            c = x * 10  # not recorded
+        z = y + c.detach()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training() and autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training() and not autograd.is_recording()
+
+
+def test_grad_function_api():
+    x = nd.array([1.0, 2.0])
+    y = nd.array([3.0, 4.0])
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        z = x * y
+    gx, gy = autograd.grad(z, [x, y])
+    np.testing.assert_allclose(gx.asnumpy(), [3, 4])
+    np.testing.assert_allclose(gy.asnumpy(), [1, 2])
+
+
+def test_mark_variables():
+    x = nd.array([2.0])
+    autograd.mark_variables([x], grad_reqs="write")
+    with autograd.record():
+        y = x ** 3
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_softmax_output_custom_grad():
+    data = nd.array(np.random.randn(4, 3).astype(np.float32))
+    label = nd.array([0.0, 1.0, 2.0, 1.0])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = out.asnumpy()
+    onehot = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+    np.testing.assert_allclose(data.grad.asnumpy(), p - onehot, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            y = nd.NDArray(y) if not isinstance(y, nd.NDArray) else y
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_reduction_grad():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_matmul_grad():
+    a = nd.array(np.random.rand(2, 3).astype(np.float32))
+    b = nd.array(np.random.rand(3, 4).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b)
+        loss = nd.sum(c)
+    loss.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               np.ones((2, 4)) @ b.asnumpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(),
+                               a.asnumpy().T @ np.ones((2, 4)), rtol=1e-5)

@@ -1,0 +1,207 @@
+"""NDArray tests — modeled on tests/python/unittest/test_ndarray.py of the reference."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def test_array_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    np.testing.assert_allclose(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_creation_ops():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_allclose(nd.full((2,), val=3.5).asnumpy(), [3.5, 3.5])
+    np.testing.assert_allclose(nd.arange(0, 5).asnumpy(), np.arange(5, dtype=np.float32))
+    e = nd.eye(3)
+    np.testing.assert_allclose(e.asnumpy(), np.eye(3, dtype=np.float32))
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((2 + a).asnumpy(), [3, 4, 5])
+    np.testing.assert_allclose((1 - a).asnumpy(), [0, -1, -2])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), [2, 3])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [4, 6])
+
+
+def test_comparison_dtype():
+    a = nd.array([1.0, 2.0, 3.0])
+    out = (a > 1.5).asnumpy()
+    assert out.dtype == np.float32  # reference returns 0/1 floats
+    np.testing.assert_allclose(out, [0, 1, 1])
+
+
+def test_indexing_and_views():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[0:2, 1].asnumpy(), [1, 5])
+    # write-through view (reference Slice semantics)
+    v = a[1]
+    v[:] = 0
+    assert a.asnumpy()[1].sum() == 0
+    a[2] = 7
+    np.testing.assert_allclose(a.asnumpy()[2], [7, 7, 7, 7])
+
+
+def test_setitem_array():
+    a = nd.zeros((3, 3))
+    a[1] = nd.array([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(a.asnumpy()[1], [1, 2, 3])
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((0, 0, -4, 2, 2)).shape == (2, 3, 2, 2)  # -4 splits a dim
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert a.reshape((0, 0, -4, -1, 2)).shape == (2, 3, 2, 2)  # -1 inside split
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(a, b.T, transpose_b=True).asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_batch_dot():
+    a = np.random.rand(2, 3, 4).astype(np.float32)
+    b = np.random.rand(2, 4, 5).astype(np.float32)
+    out = nd.batch_dot(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+
+def test_concat_default_axis():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    assert nd.concat(a, b).shape == (2, 6)  # reference default dim=1
+    assert nd.concat(a, b, dim=0).shape == (4, 3)
+
+
+def test_split():
+    a = nd.array(np.arange(12).reshape(2, 6))
+    parts = nd.split(a, num_outputs=3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[0].asnumpy(), [[0, 1], [6, 7]])
+
+
+def test_reductions():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert nd.sum(a).asscalar() == 15
+    np.testing.assert_allclose(nd.sum(a, axis=1).asnumpy(), [3, 12])
+    np.testing.assert_allclose(nd.mean(a, axis=0).asnumpy(), [1.5, 2.5, 3.5])
+    np.testing.assert_allclose(nd.max(a, axis=1).asnumpy(), [2, 5])
+    # exclude=True reduces over all OTHER axes
+    np.testing.assert_allclose(nd.sum(a, axis=0, exclude=True).asnumpy(), [3, 12])
+
+
+def test_take_pick_onehot():
+    a = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2])
+    np.testing.assert_allclose(nd.take(a, idx).asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    p = nd.pick(a, nd.array([0, 1, 2, 0]), axis=1)
+    np.testing.assert_allclose(p.asnumpy(), [0, 4, 8, 9])
+    oh = nd.one_hot(nd.array([1, 0]), depth=3)
+    np.testing.assert_allclose(oh.asnumpy(), [[0, 1, 0], [1, 0, 0]])
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    v = nd.topk(a, k=2, ret_typ="value")
+    np.testing.assert_allclose(v.asnumpy(), [[3, 2], [5, 4]])
+    s = nd.sort(a, axis=1)
+    np.testing.assert_allclose(s.asnumpy(), [[1, 2, 3], [0, 4, 5]])
+    i = nd.argsort(a, axis=1)
+    np.testing.assert_allclose(i.asnumpy(), [[1, 2, 0], [0, 2, 1]])
+
+
+def test_where_clip():
+    a = nd.array([-1.0, 0.5, 2.0])
+    np.testing.assert_allclose(nd.clip(a, a_min=0.0, a_max=1.0).asnumpy(), [0, 0.5, 1])
+    cond = nd.array([1.0, 0.0, 1.0])
+    np.testing.assert_allclose(
+        nd.where(cond, a, nd.zeros((3,))).asnumpy(), [-1, 0, 2])
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    d = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), np.ones((2, 2)))
+    nd.save(f, [nd.ones((2,))])
+    lst = nd.load(f)
+    assert isinstance(lst, list) and len(lst) == 1
+
+
+def test_astype_copyto_context():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = nd.zeros((2, 2))
+    a.copyto(c)
+    np.testing.assert_allclose(c.asnumpy(), 1)
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context.device_type == "cpu"
+
+
+def test_dlpack_roundtrip():
+    a = nd.array([1.0, 2.0])
+    b = nd.from_dlpack(nd.to_dlpack(a))
+    np.testing.assert_allclose(b.asnumpy(), [1, 2])
+
+
+def test_norm_l2norm():
+    a = nd.array([[3.0, 4.0]])
+    assert abs(nd.norm(a).asscalar() - 5.0) < 1e-6
+    out = nd.L2Normalization(a)
+    np.testing.assert_allclose(out.asnumpy(), [[0.6, 0.8]], rtol=1e-5)
+
+
+def test_sequence_ops():
+    data = nd.array(np.arange(2 * 3 * 2, dtype=np.float32).reshape(2, 3, 2))  # (T,B,C)
+    lens = nd.array([1.0, 2.0, 1.0])
+    m = nd.SequenceMask(data, lens, use_sequence_length=True, value=-1.0)
+    out = m.asnumpy()
+    assert (out[1, 0] == -1).all() and (out[1, 1] != -1).all()
+    last = nd.SequenceLast(data, lens, use_sequence_length=True)
+    np.testing.assert_allclose(last.asnumpy()[0], data.asnumpy()[0, 0])
+    np.testing.assert_allclose(last.asnumpy()[1], data.asnumpy()[1, 1])
+
+
+def test_gather_scatter_nd():
+    data = nd.array(np.arange(9).reshape(3, 3))
+    idx = nd.array([[0, 2], [1, 0]])
+    out = nd.gather_nd(data, idx)
+    np.testing.assert_allclose(out.asnumpy(), [1, 6])
+    sc = nd.scatter_nd(nd.array([5.0, 6.0]), idx, shape=(3, 3))
+    assert sc.asnumpy()[0, 1] == 5 and sc.asnumpy()[2, 0] == 6
+
+
+def test_waitall_runs():
+    nd.waitall()

@@ -1,0 +1,296 @@
+"""NN operator tests — modeled on tests/python/unittest/test_operator.py.
+
+Oracle strategy per SURVEY.md §4: numpy for simple ops; torch-CPU as the heavyweight
+oracle for conv/pool/norm kernels (the reference uses hand-rolled numpy refs).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+from mxtpu import autograd, nd
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 7).astype(np.float32)
+    w = np.random.rand(5, 7).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=5)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T + b, rtol=1e-5)
+    # flatten semantics for >2D input
+    x3 = np.random.rand(4, 2, 7).astype(np.float32)
+    w3 = np.random.rand(5, 14).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x3), nd.array(w3), nd.array(b), num_hidden=5)
+    np.testing.assert_allclose(out.asnumpy(), x3.reshape(4, -1) @ w3.T + b, rtol=1e-5)
+
+
+@pytest.mark.parametrize("stride,pad,dilate,groups", [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 1), (2, 2), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+])
+def test_convolution_vs_torch(stride, pad, dilate, groups):
+    x = np.random.rand(2, 4, 9, 9).astype(np.float32)
+    w = np.random.rand(6, 4 // groups, 3, 3).astype(np.float32)
+    b = np.random.rand(6).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b), kernel=(3, 3),
+                         num_filter=6, stride=stride, pad=pad, dilate=dilate,
+                         num_group=groups)
+    ref = tF.conv2d(_t(x), _t(w), _t(b), stride=stride, padding=pad,
+                    dilation=dilate, groups=groups).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_1d_3d():
+    x = np.random.rand(2, 3, 12).astype(np.float32)
+    w = np.random.rand(4, 3, 5).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(5,), num_filter=4,
+                         no_bias=True)
+    ref = tF.conv1d(_t(x), _t(w)).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    x3 = np.random.rand(1, 2, 5, 6, 7).astype(np.float32)
+    w3 = np.random.rand(3, 2, 2, 2, 2).astype(np.float32)
+    out = nd.Convolution(nd.array(x3), nd.array(w3), None, kernel=(2, 2, 2),
+                         num_filter=3, no_bias=True)
+    ref = tF.conv3d(_t(x3), _t(w3)).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_vs_torch():
+    x = np.random.rand(2, 4, 5, 5).astype(np.float32)
+    w = np.random.rand(4, 3, 3, 3).astype(np.float32)  # [in, out, kh, kw]
+    for stride, pad in [((1, 1), (0, 0)), ((2, 2), (1, 1))]:
+        out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=3,
+                               stride=stride, pad=pad)
+        ref = tF.conv_transpose2d(_t(x), _t(w), stride=stride, padding=pad).numpy()
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg", "sum"])
+def test_pooling(pool_type):
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type=pool_type, stride=(2, 2))
+    if pool_type == "max":
+        ref = tF.max_pool2d(_t(x), 2, 2).numpy()
+    elif pool_type == "avg":
+        ref = tF.avg_pool2d(_t(x), 2, 2).numpy()
+    else:
+        ref = tF.avg_pool2d(_t(x), 2, 2).numpy() * 4
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+
+def test_global_pooling():
+    x = np.random.rand(2, 3, 5, 7).astype(np.float32)
+    out = nd.Pooling(nd.array(x), pool_type="avg", global_pool=True)
+    np.testing.assert_allclose(out.asnumpy(), x.mean(axis=(2, 3), keepdims=True),
+                               rtol=1e-5)
+    out = nd.Pooling(nd.array(x), pool_type="max", global_pool=True)
+    np.testing.assert_allclose(out.asnumpy(), x.max(axis=(2, 3), keepdims=True))
+
+
+def test_pooling_full_convention():
+    # ceil-mode pooling: 7 with kernel 2 stride 2 → 4 outputs under 'full'
+    x = np.random.rand(1, 1, 7, 7).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max",
+                     pooling_convention="full")
+    assert out.shape == (1, 1, 4, 4)
+    ref = tF.max_pool2d(_t(x), 2, 2, ceil_mode=True).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref)
+
+
+def test_batchnorm_inference():
+    x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32)
+    beta = np.random.rand(3).astype(np.float32)
+    mean = np.random.rand(3).astype(np.float32)
+    var = np.random.rand(3).astype(np.float32) + 0.5
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mean),
+                       nd.array(var), fix_gamma=False, eps=1e-5)
+    ref = tF.batch_norm(_t(x), _t(mean), _t(var), _t(gamma), _t(beta), False,
+                        eps=1e-5).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_stats():
+    x = np.random.rand(8, 3, 4, 4).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    out, mean, var = nd.batch_norm_train(nd.array(x), nd.array(gamma), nd.array(beta),
+                                         fix_gamma=False, eps=1e-6)
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(axis=(0, 2, 3)), rtol=1e-5)
+    o = out.asnumpy()
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0, atol=1e-5)
+    np.testing.assert_allclose(o.std(axis=(0, 2, 3)), 1, atol=1e-3)
+
+
+def test_layernorm_vs_torch():
+    x = np.random.rand(4, 10).astype(np.float32)
+    g = np.random.rand(10).astype(np.float32)
+    b = np.random.rand(10).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-5)
+    ref = tF.layer_norm(_t(x), (10,), _t(g), _t(b), eps=1e-5).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_activations():
+    x = np.array([-2.0, -0.5, 0.0, 1.5], np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.Activation(a, act_type="relu").asnumpy(),
+                               np.maximum(x, 0))
+    np.testing.assert_allclose(nd.Activation(a, act_type="tanh").asnumpy(),
+                               np.tanh(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.LeakyReLU(a, act_type="leaky", slope=0.1).asnumpy(),
+                               np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    np.testing.assert_allclose(nd.LeakyReLU(a, act_type="elu", slope=1.0).asnumpy(),
+                               tF.elu(_t(x)).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(nd.LeakyReLU(a, act_type="gelu").asnumpy(),
+                               tF.gelu(_t(x)).numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_ops():
+    x = np.random.rand(3, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.softmax(nd.array(x)).asnumpy(),
+                               tF.softmax(_t(x), dim=-1).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(nd.log_softmax(nd.array(x)).asnumpy(),
+                               tF.log_softmax(_t(x), dim=-1).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(nd.softmax(nd.array(x), temperature=2.0).asnumpy(),
+                               tF.softmax(_t(x / 2.0), dim=-1).numpy(), rtol=1e-5)
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    # inference: identity
+    out = nd.Dropout(x, p=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    # training: ~half zeroed, scaled by 2
+    with autograd.record():
+        out = nd.Dropout(x, p=0.5)
+    o = out.asnumpy()
+    frac = (o == 0).mean()
+    assert 0.4 < frac < 0.6
+    assert np.allclose(o[o != 0], 2.0)
+    # always mode applies without training
+    o2 = nd.Dropout(x, p=0.5, mode="always").asnumpy()
+    assert (o2 == 0).any()
+
+
+def test_dropout_axes_broadcast():
+    x = nd.ones((4, 8, 8))
+    with autograd.record():
+        o = nd.Dropout(x, p=0.5, axes=(1, 2)).asnumpy()
+    # noise broadcast over axes 1,2: each sample either all-zero or all-2
+    per_sample = o.reshape(4, -1)
+    for row in per_sample:
+        assert (row == 0).all() or (row == 2).all()
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = nd.array([1.0, 3.0, 1.0])
+    out = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w[[1, 3, 1]])
+
+
+def test_conv_gradient():
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    a, ww = nd.array(x), nd.array(w)
+    a.attach_grad(); ww.attach_grad()
+    with autograd.record():
+        out = nd.Convolution(a, ww, None, kernel=(3, 3), num_filter=3, no_bias=True)
+        loss = nd.sum(out)
+    loss.backward()
+    tx = _t(x).requires_grad_(True)
+    tw = _t(w).requires_grad_(True)
+    tF.conv2d(tx, tw).sum().backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), tx.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ww.grad.asnumpy(), tw.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_lrn():
+    x = np.random.rand(2, 7, 4, 4).astype(np.float32)
+    out = nd.LRN(nd.array(x), nsize=5, alpha=1e-4, beta=0.75, knorm=2.0)
+    ref = tF.local_response_norm(_t(x), size=5, alpha=1e-4, beta=0.75, k=2.0).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-6)
+
+
+def test_regression_outputs():
+    data = nd.array(np.random.rand(4, 3).astype(np.float32))
+    label = nd.array(np.random.rand(4, 3).astype(np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(data, label)
+    out.backward()
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy())
+    np.testing.assert_allclose(data.grad.asnumpy(),
+                               (data.asnumpy() - label.asnumpy()) / 3, rtol=1e-5)
+
+
+def test_make_loss():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.make_loss(x * 5)
+    y.backward()
+    # make_loss: unit gradient into the subgraph → d(5x)/dx = 5
+    np.testing.assert_allclose(x.grad.asnumpy(), [5.0, 5.0])
+
+
+def test_upsampling():
+    x = np.random.rand(1, 2, 3, 3).astype(np.float32)
+    out = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    ref = tF.interpolate(_t(x), scale_factor=2, mode="nearest").numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref)
+
+
+def test_instance_norm():
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    g = np.random.rand(3).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    out = nd.InstanceNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-5)
+    ref = tF.instance_norm(_t(x), weight=_t(g), bias=_t(b), eps=1e-5).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.3, 0.3, 2.0], np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0)
+    ref = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+
+def test_random_ops_reproducible():
+    import mxtpu
+    mxtpu.random.seed(42)
+    a = nd.random.uniform(shape=(3, 3)).asnumpy()
+    mxtpu.random.seed(42)
+    b = nd.random.uniform(shape=(3, 3)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    c = nd.random.uniform(shape=(3, 3)).asnumpy()
+    assert not np.allclose(b, c)
+    n = nd.random.normal(loc=1.0, scale=2.0, shape=(2000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.2 and abs(n.std() - 2.0) < 0.2
+
+
+def test_multinomial():
+    p = nd.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+    out = nd.random.multinomial(p).asnumpy()
+    np.testing.assert_allclose(out, [1, 0])
+
+
+def test_linalg_ops():
+    a = np.random.rand(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = nd.linalg.potrf(nd.array(spd))
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-4)
+    inv = nd.linalg.potri(L)
+    np.testing.assert_allclose(inv.asnumpy(), np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    g = nd.linalg.gemm2(nd.array(a), nd.array(spd), alpha=2.0)
+    np.testing.assert_allclose(g.asnumpy(), 2 * a @ spd, rtol=1e-4)

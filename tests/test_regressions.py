@@ -1,0 +1,86 @@
+"""Regression tests for review findings (chained views, dropout grad, out=, make_loss)."""
+
+import numpy as np
+import pytest
+
+from mxtpu import autograd, nd
+
+
+def test_chained_view_write():
+    x = nd.array([0.0, 1.0, 2.0, 3.0, 4.0, 5.0])
+    v = x[2:5]
+    w = v[0]
+    w += 10
+    np.testing.assert_allclose(x.asnumpy(), [0, 1, 12, 3, 4, 5])
+    np.testing.assert_allclose(v.asnumpy(), [12, 3, 4])
+
+
+def test_view_read_through_after_base_mutation():
+    x = nd.array([0.0, 1.0, 2.0])
+    v = x[0:2]
+    x += 1
+    np.testing.assert_allclose(v.asnumpy(), [1, 2])
+
+
+def test_sibling_views_stay_consistent():
+    x = nd.array(np.zeros((2, 3), np.float32))
+    a, b = x[0], x[1]
+    a[:] = 1
+    b[:] = 2
+    np.testing.assert_allclose(x.asnumpy(), [[1, 1, 1], [2, 2, 2]])
+    np.testing.assert_allclose(a.asnumpy(), [1, 1, 1])
+
+
+def test_dropout_gradient_matches_mask():
+    x = nd.ones((50, 50))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5)
+    fwd = y.asnumpy()
+    y.backward()
+    g = x.grad.asnumpy()
+    # gradient must be 2 exactly where forward kept the element, 0 where dropped
+    np.testing.assert_allclose(g[fwd != 0], 2.0)
+    np.testing.assert_allclose(g[fwd == 0], 0.0)
+
+
+def test_out_kwarg_records_gradient():
+    p = nd.array([1.0, 2.0])
+    q = nd.array([3.0, 4.0])
+    p.attach_grad()
+    c = nd.zeros((2,))
+    with autograd.record():
+        nd.add(p, q, out=c)
+        s = nd.sum(c * c)
+    s.backward()
+    np.testing.assert_allclose(p.grad.asnumpy(), 2 * (p.asnumpy() + q.asnumpy()))
+
+
+def test_make_loss_grad_scale():
+    a = nd.array([1.0, 2.0, 3.0])
+    a.attach_grad()
+    with autograd.record():
+        l = nd.make_loss(a, grad_scale=3.0)
+    l.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [3, 3, 3])
+
+
+def test_regression_output_norm_ndim():
+    data = nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    label = nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(data, label)
+    out.backward()
+    np.testing.assert_allclose(
+        data.grad.asnumpy(), (data.asnumpy() - label.asnumpy()) / 12, rtol=1e-5)
+
+
+def test_double_backward_raises():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    with pytest.raises(RuntimeError, match="freed"):
+        y.backward()
