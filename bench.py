@@ -1,0 +1,108 @@
+"""Benchmark: ResNet-50 synthetic-data training throughput (images/sec) on one chip.
+
+Mirrors the reference's headline harness ``train_imagenet.py --benchmark 1``
+(example/image-classification, BASELINE.md): synthetic NCHW batches, full
+fwd+bwd+SGD-momentum update per step. Baseline: 109 img/s (ResNet-50, 1× K80,
+batch 32, BASELINE.md row 5).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 109.0  # ResNet-50, 1x K80, batch 32 (BASELINE.md)
+BATCH = 32
+WARMUP = 3
+STEPS = 10
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mxtpu import autograd, nd, rng as rng_mod
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    loss_fn = SoftmaxCrossEntropyLoss()
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(BATCH, 3, 224, 224).astype(np.float32))
+    y = jnp.asarray(rs.randint(0, 1000, BATCH).astype(np.int32))
+
+    # materialize params with one imperative forward
+    with autograd.predict_mode():
+        net(nd.NDArray(x[:2]))
+    param_handles = [p for p in net.collect_params().values()
+                     if p._data is not None and p.grad_req != "null"]
+    aux_handles = [p for p in net.collect_params().values()
+                   if p._data is not None and p.grad_req == "null"]
+
+    def train_step(params, auxs, moms, xb, yb, key):
+        provider = rng_mod.push_trace_provider(key)
+        saved = [p._data._data for p in param_handles]
+        saved_aux = [p._data._data for p in aux_handles]
+        try:
+            def loss_of(ps):
+                for p, v in zip(param_handles, ps):
+                    p._data._data = v
+                    p._data._version += 1
+                for p, v in zip(aux_handles, auxs):
+                    p._data._data = v
+                    p._data._version += 1
+                with autograd.pause(train_mode=True):
+                    out = net(nd.NDArray(xb))
+                    loss = loss_fn(out, nd.NDArray(yb))
+                new_aux = [p._data._data for p in aux_handles]
+                return jnp.mean(loss.data), new_aux
+
+            (loss, new_aux), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                list(params))
+            new_params, new_moms = [], []
+            for w, g, m in zip(params, grads, moms):
+                m2 = 0.9 * m - 0.05 * g
+                new_params.append(w + m2)
+                new_moms.append(m2)
+            return new_params, new_aux, new_moms, loss
+        finally:
+            for p, v in zip(param_handles, saved):
+                p._data._data = v
+            for p, v in zip(aux_handles, saved_aux):
+                p._data._data = v
+            rng_mod.pop_trace_provider()
+
+    step = jax.jit(train_step, donate_argnums=(0, 2))
+    params = [p.data().data for p in param_handles]
+    auxs = [p.data().data for p in aux_handles]
+    moms = [jnp.zeros_like(w) for w in params]
+
+    for i in range(WARMUP):
+        params, auxs, moms, loss = step(params, auxs, moms, x, y,
+                                        jax.random.key(i))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        params, auxs, moms, loss = step(params, auxs, moms, x, y,
+                                        jax.random.key(100 + i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = STEPS * BATCH / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Training callbacks — parity with ``python/mxnet/callback.py`` (Speedometer,
+do_checkpoint, log_train_metric, ProgressBar)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import NamedTuple, Optional
+
+
+class BatchEndParam(NamedTuple):
+    epoch: int
+    nbatch: int
+    eval_metric: object
+    locals: Optional[dict] = None
+
+
+class Speedometer:
+    """Throughput logger (callback.py Speedometer): samples/sec every ``frequent``."""
+
+    def __init__(self, batch_size: int, frequent: int = 50, auto_reset: bool = True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param: BatchEndParam):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    nv = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "\t".join(f"{n}={v:.6f}" for n, v in nv)
+                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
+                                 param.epoch, count, speed, msg)
+                else:
+                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                                 param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix: str, period: int = 1):
+    """Epoch-end checkpoint callback (callback.py module_checkpoint parity)."""
+    period = max(1, int(period))
+
+    def _callback(epoch, sym, arg_params, aux_params):
+        if (epoch + 1) % period == 0:
+            from .model import save_checkpoint
+            save_checkpoint(prefix, epoch + 1, sym, arg_params, aux_params)
+
+    return _callback
+
+
+def log_train_metric(period: int, auto_reset: bool = False):
+    def _callback(param: BatchEndParam):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            nv = param.eval_metric.get_name_value()
+            msg = "\t".join(f"{n}={v:.6f}" for n, v in nv)
+            logging.info("Iter[%d] Batch[%d] Train-%s", param.epoch, param.nbatch, msg)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class ProgressBar:
+    def __init__(self, total: int, length: int = 80):
+        self.total = total
+        self.length = length
+
+    def __call__(self, param: BatchEndParam):
+        filled = int(round(self.length * param.nbatch / float(self.total)))
+        bar = "=" * filled + "-" * (self.length - filled)
+        print(f"\r[{bar}] {param.nbatch}/{self.total}", end="", flush=True)

@@ -1,0 +1,12 @@
+"""Gluon-equivalent imperative/hybrid module system (parity with python/mxnet/gluon)."""
+
+from . import loss
+from . import nn
+from . import rnn
+from . import utils
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+
+from . import data  # noqa: E402
+from . import model_zoo  # noqa: E402

@@ -1,0 +1,7 @@
+"""gluon.data — datasets, samplers, DataLoader (parity with python/mxnet/gluon/data)."""
+
+from . import vision
+from .dataloader import DataLoader
+from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
+from .sampler import (BatchSampler, IntervalSampler, RandomSampler, Sampler,
+                      SequentialSampler)

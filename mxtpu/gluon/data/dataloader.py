@@ -1,0 +1,86 @@
+"""DataLoader — parity with ``python/mxnet/gluon/data/dataloader.py``.
+
+The reference forks worker processes and rebuilds NDArrays over POSIX shared memory
+(ForkingPickler + CPUSharedStorageManager, dataloader.py:26-96, storage.cc:96). Here
+workers run in a **thread pool over numpy** (decode/augment release the GIL via
+numpy/PIL) and the batch is device_put once per batch — host→TPU transfer is the only
+device interaction, so there is no shared-memory tensor protocol to rebuild. A
+``prefetch`` window of in-flight batches double-buffers the pipeline like the
+reference's PrefetcherIter.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (dataloader.py default_batchify_fn parity)."""
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn([d[i] for d in data])
+                     for i in range(len(data[0])))
+    if isinstance(data[0], NDArray):
+        return nd.array(np.stack([d.asnumpy() for d in data]))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return nd.array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: Optional[str] = None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn: Optional[Callable] = None, num_workers: int = 0,
+                 prefetch: Optional[int] = None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with an explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(1, prefetch if prefetch is not None
+                             else 2 * max(1, self._num_workers))
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        with ThreadPoolExecutor(self._num_workers) as pool:
+            futures = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch):
+                    futures.append(pool.submit(self._load_batch, next(it)))
+            except StopIteration:
+                pass
+            while futures:
+                batch = futures.pop(0).result()
+                try:
+                    futures.append(pool.submit(self._load_batch, next(it)))
+                except StopIteration:
+                    pass
+                yield batch
+
+    def __len__(self):
+        return len(self._batch_sampler)

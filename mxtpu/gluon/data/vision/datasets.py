@@ -1,0 +1,188 @@
+"""Vision datasets — parity with ``python/mxnet/gluon/data/vision/datasets.py``
+(MNIST, FashionMNIST, CIFAR10/100, ImageRecordDataset, ImageFolderDataset).
+
+Zero-egress environment: dataset files must already exist under ``root`` (or a
+synthetic fallback is available for tests via ``synthetic=True``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from .... import ndarray as nd
+from ..dataset import ArrayDataset, Dataset
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root: str, transform: Optional[Callable]):
+        self._root = os.path.expanduser(root)
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from the standard IDX files (train-images-idx3-ubyte[.gz] etc.)."""
+
+    def __init__(self, root: str = "~/.mxtpu/datasets/mnist", train: bool = True,
+                 transform: Optional[Callable] = None, synthetic: bool = False):
+        self._train = train
+        self._synthetic = synthetic
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        prefix = "train" if self._train else "t10k"
+        img = os.path.join(self._root, f"{prefix}-images-idx3-ubyte")
+        lbl = os.path.join(self._root, f"{prefix}-labels-idx1-ubyte")
+        if not (os.path.exists(img) or os.path.exists(img + ".gz")):
+            if self._synthetic:
+                rs = np.random.RandomState(42)
+                n = 1024 if self._train else 256
+                self._data = rs.randint(0, 255, (n, 28, 28, 1)).astype(np.uint8)
+                self._label = rs.randint(0, 10, (n,)).astype(np.int32)
+                return
+            raise RuntimeError(
+                f"MNIST files not found under {self._root} (no network egress; "
+                "place the IDX files there or pass synthetic=True)")
+        self._data = _read_idx_images(img)
+        self._label = _read_idx_labels(lbl)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root: str = "~/.mxtpu/datasets/fashion-mnist", **kwargs):
+        super().__init__(root=root, **kwargs)
+
+
+def _maybe_gz(path: str):
+    if os.path.exists(path):
+        return open(path, "rb")
+    return gzip.open(path + ".gz", "rb")
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    with _maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    with _maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.astype(np.int32)
+
+
+class CIFAR10(_DownloadedDataset):
+    def __init__(self, root: str = "~/.mxtpu/datasets/cifar10", train: bool = True,
+                 transform: Optional[Callable] = None, synthetic: bool = False):
+        self._train = train
+        self._synthetic = synthetic
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        batch_dir = os.path.join(self._root, "cifar-10-batches-py")
+        if not os.path.isdir(batch_dir):
+            if self._synthetic:
+                rs = np.random.RandomState(0)
+                n = 1024 if self._train else 256
+                self._data = rs.randint(0, 255, (n, 32, 32, 3)).astype(np.uint8)
+                self._label = rs.randint(0, 10, (n,)).astype(np.int32)
+                return
+            raise RuntimeError(f"CIFAR-10 python batches not found in {self._root}")
+        files = [f"data_batch_{i}" for i in range(1, 6)] if self._train \
+            else ["test_batch"]
+        data, labels = [], []
+        for fn in files:
+            with open(os.path.join(batch_dir, fn), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            labels.extend(d[b"labels"])
+        self._data = np.concatenate(data)
+        self._label = np.asarray(labels, np.int32)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root: str = "~/.mxtpu/datasets/cifar100", fine_label=True,
+                 **kwargs):
+        self._fine = fine_label
+        super().__init__(root=root, **kwargs)
+
+
+class ImageRecordDataset(Dataset):
+    """Images from a RecordIO pack (datasets.py ImageRecordDataset)."""
+
+    def __init__(self, filename: str, flag: int = 1,
+                 transform: Optional[Callable] = None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._record)
+
+    def __getitem__(self, idx):
+        from .... import recordio
+        from .... import image
+        raw = self._record[idx]
+        header, img_bytes = recordio.unpack(raw)
+        img = image.imdecode(img_bytes, flag=self._flag)
+        label = np.float32(header.label) if np.isscalar(header.label) \
+            else np.asarray(header.label, np.float32)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """folder/<class>/<image> layout (datasets.py ImageFolderDataset)."""
+
+    def __init__(self, root: str, flag: int = 1,
+                 transform: Optional[Callable] = None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png", ".bmp"}
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fn in sorted(os.listdir(path)):
+                if os.path.splitext(fn)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, fn), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from .... import image
+        path, label = self.items[idx]
+        with open(path, "rb") as f:
+            img = image.imdecode(f.read(), flag=self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, np.float32(label)

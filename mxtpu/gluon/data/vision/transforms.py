@@ -1,0 +1,228 @@
+"""Vision transforms — parity with ``python/mxnet/gluon/data/vision/transforms.py``:
+Compose, Cast, ToTensor, Normalize, RandomResizedCrop, CenterCrop, Resize,
+RandomFlipLeftRight/TopBottom, RandomBrightness/Contrast/Saturation/Hue/ColorJitter,
+RandomLighting. Operate on HWC uint8/float numpy or NDArray (host-side, like the
+reference's CPU augmentation pipeline)."""
+
+from __future__ import annotations
+
+import random as pyrandom
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .... import ndarray as nd
+from ....ndarray.ndarray import NDArray
+from ...block import Block
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class Compose(Block):
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = transforms
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return nd.array(_to_np(x).astype(self._dtype))
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (transforms.py ToTensor)."""
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32) / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return nd.array(arr)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32)
+        self._std = np.asarray(std, np.float32)
+
+    def forward(self, x):
+        arr = _to_np(x)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return nd.array((arr - mean) / std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio: bool = False, interpolation: int = 1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        from .... import image
+        return image.imresize(x, self._size[0], self._size[1])
+
+
+class CenterCrop(Block):
+    def __init__(self, size):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        arr = _to_np(x)
+        h, w = arr.shape[:2]
+        cw, ch = self._size
+        x0 = max(0, (w - cw) // 2)
+        y0 = max(0, (h - ch) // 2)
+        return nd.array(arr[y0:y0 + ch, x0:x0 + cw])
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation: int = 1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale, self._ratio = scale, ratio
+
+    def forward(self, x):
+        from .... import image
+        arr = _to_np(x)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self._scale)
+            ar = pyrandom.uniform(*self._ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                x0 = pyrandom.randint(0, w - cw)
+                y0 = pyrandom.randint(0, h - ch)
+                crop = arr[y0:y0 + ch, x0:x0 + cw]
+                return image.imresize(nd.array(crop), self._size[0], self._size[1])
+        return CenterCrop(self._size)(nd.array(arr))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        arr = _to_np(x)
+        if pyrandom.random() < 0.5:
+            arr = arr[:, ::-1]
+        return nd.array(np.ascontiguousarray(arr))
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        arr = _to_np(x)
+        if pyrandom.random() < 0.5:
+            arr = arr[::-1]
+        return nd.array(np.ascontiguousarray(arr))
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness: float):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        f = 1.0 + pyrandom.uniform(-self._b, self._b)
+        return nd.array(np.clip(arr * f, 0, 255))
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast: float):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        f = 1.0 + pyrandom.uniform(-self._c, self._c)
+        gray = arr.mean()
+        return nd.array(np.clip(gray + (arr - gray) * f, 0, 255))
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation: float):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        f = 1.0 + pyrandom.uniform(-self._s, self._s)
+        gray = arr.mean(axis=-1, keepdims=True)
+        return nd.array(np.clip(gray + (arr - gray) * f, 0, 255))
+
+
+class RandomHue(Block):
+    def __init__(self, hue: float):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        f = pyrandom.uniform(-self._h, self._h)
+        # cheap hue rotation approximation in RGB (reference uses HSL roundtrip)
+        u = np.cos(f * np.pi)
+        w = np.sin(f * np.pi)
+        t = np.array([[0.299, 0.587, 0.114],
+                      [0.299, 0.587, 0.114],
+                      [0.299, 0.587, 0.114]], np.float32) + \
+            u * np.array([[0.701, -0.587, -0.114],
+                          [-0.299, 0.413, -0.114],
+                          [-0.299, -0.587, 0.886]], np.float32) + \
+            w * np.array([[0.168, 0.330, -0.497],
+                          [-0.328, 0.035, 0.292],
+                          [1.250, -1.050, -0.203]], np.float32)
+        return nd.array(np.clip(arr @ t.T, 0, 255))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        ts = list(self._ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (transforms.py RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha: float):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        arr = _to_np(x).astype(np.float32)
+        alpha = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return nd.array(np.clip(arr + rgb, 0, 255))

@@ -1,0 +1,4 @@
+"""Model zoo (parity with python/mxnet/gluon/model_zoo)."""
+
+from . import model_store, vision
+from .vision import get_model

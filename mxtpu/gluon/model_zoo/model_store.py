@@ -1,0 +1,33 @@
+"""Pretrained weight store — parity with ``python/mxnet/gluon/model_zoo/model_store.py``.
+
+Zero-egress: weights resolve from a local mirror (``MXTPU_REPO_DIR`` or
+``~/.mxtpu/models``) in this framework's npz parameter format.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def get_model_file(name: str, root: str = "~/.mxtpu/models") -> str:
+    fname = f"{name}.params"
+    for base in [os.environ.get("MXTPU_REPO_DIR"), os.path.expanduser(root)]:
+        if base:
+            cand = os.path.join(base, fname)
+            if os.path.exists(cand):
+                return cand
+    raise RuntimeError(
+        f"pretrained weights {fname} not found locally (no network egress). "
+        f"Place the file under $MXTPU_REPO_DIR or {root}, or use pretrained=False")
+
+
+def load_pretrained(net, name: str, ctx=None, root: str = "~/.mxtpu/models"):
+    net.load_parameters(get_model_file(name, root), ctx=ctx)
+
+
+def purge(root: str = "~/.mxtpu/models"):
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

@@ -1,0 +1,854 @@
+"""Model zoo — parity with ``python/mxnet/gluon/model_zoo/vision`` (SURVEY.md §2.5):
+ResNet v1/v2 (18/34/50/101/152), VGG 11/13/16/19 (±BN), AlexNet, SqueezeNet 1.0/1.1,
+DenseNet 121/161/169/201, MobileNet v1 (multipliers) & v2, Inception-V3, plus LeNet
+(the reference's canonical MNIST example network, example/image-classification
+train_mnist.py).
+
+``pretrained=True`` requires a local weight mirror (zero-egress env) — see
+gluon/utils.download.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import ndarray as nd
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["get_model", "get_resnet", "resnet18_v1", "resnet34_v1", "resnet50_v1",
+           "resnet101_v1", "resnet152_v1", "resnet18_v2", "resnet34_v2",
+           "resnet50_v2", "resnet101_v2", "resnet152_v2", "vgg11", "vgg13", "vgg16",
+           "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn", "alexnet",
+           "squeezenet1_0", "squeezenet1_1", "densenet121", "densenet161",
+           "densenet169", "densenet201", "mobilenet1_0", "mobilenet0_75",
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0", "mobilenet_v2_0_75",
+           "mobilenet_v2_0_5", "mobilenet_v2_0_25", "inception_v3", "lenet", "LeNet"]
+
+
+# ---------------------------------------------------------------------------
+# ResNet (model_zoo/vision/resnet.py parity)
+# ---------------------------------------------------------------------------
+
+
+def _conv3x3(channels, stride, in_channels):
+    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
+                     use_bias=False, in_channels=in_channels)
+
+
+class BasicBlockV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(_conv3x3(channels, stride, in_channels))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(_conv3x3(channels, 1, channels))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
+                                          use_bias=False, in_channels=in_channels))
+            self.downsample.add(nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        x = self.body(x)
+        if self.downsample:
+            residual = self.downsample(residual)
+        return nd.Activation(x + residual, act_type="relu")
+
+
+class BottleneckV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
+                                          use_bias=False, in_channels=in_channels))
+            self.downsample.add(nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        x = self.body(x)
+        if self.downsample:
+            residual = self.downsample(residual)
+        return nd.Activation(x + residual, act_type="relu")
+
+
+class BasicBlockV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = _conv3x3(channels, stride, in_channels)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = _conv3x3(channels, 1, channels)
+        if downsample:
+            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
+                                        in_channels=in_channels)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        x = self.bn1(x)
+        x = nd.Activation(x, act_type="relu")
+        if self.downsample:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x)
+        x = nd.Activation(x, act_type="relu")
+        x = self.conv2(x)
+        return x + residual
+
+
+class BottleneckV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
+        self.bn3 = nn.BatchNorm()
+        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False)
+        if downsample:
+            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
+                                        in_channels=in_channels)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        x = self.bn1(x)
+        x = nd.Activation(x, act_type="relu")
+        if self.downsample:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x)
+        x = nd.Activation(x, act_type="relu")
+        x = self.conv2(x)
+        x = self.bn3(x)
+        x = nd.Activation(x, act_type="relu")
+        x = self.conv3(x)
+        return x + residual
+
+
+class ResNetV1(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            if thumbnail:
+                self.features.add(_conv3x3(channels[0], 1, 0))
+            else:
+                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+                self.features.add(nn.BatchNorm())
+                self.features.add(nn.Activation("relu"))
+                self.features.add(nn.MaxPool2D(3, 2, 1))
+            for i, num_layer in enumerate(layers):
+                stride = 1 if i == 0 else 2
+                self.features.add(self._make_layer(block, num_layer, channels[i + 1],
+                                                   stride, i + 1,
+                                                   in_channels=channels[i]))
+            self.features.add(nn.GlobalAvgPool2D())
+            self.output = nn.Dense(classes, in_units=channels[-1])
+
+    def _make_layer(self, block, layers, channels, stride, stage_index,
+                    in_channels=0):
+        layer = nn.HybridSequential(prefix=f"stage{stage_index}_")
+        with layer.name_scope():
+            layer.add(block(channels, stride, channels != in_channels,
+                            in_channels=in_channels, prefix=""))
+            for _ in range(layers - 1):
+                layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
+        return layer
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class ResNetV2(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.BatchNorm(scale=False, center=False))
+            if thumbnail:
+                self.features.add(_conv3x3(channels[0], 1, 0))
+            else:
+                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+                self.features.add(nn.BatchNorm())
+                self.features.add(nn.Activation("relu"))
+                self.features.add(nn.MaxPool2D(3, 2, 1))
+            in_channels = channels[0]
+            for i, num_layer in enumerate(layers):
+                stride = 1 if i == 0 else 2
+                self.features.add(self._make_layer(block, num_layer, channels[i + 1],
+                                                   stride, i + 1,
+                                                   in_channels=in_channels))
+                in_channels = channels[i + 1]
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes, in_units=in_channels)
+
+    _make_layer = ResNetV1._make_layer
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+resnet_spec = {
+    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+}
+resnet_net_versions = [ResNetV1, ResNetV2]
+resnet_block_versions = [
+    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
+    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
+]
+
+
+def get_resnet(version: int, num_layers: int, pretrained: bool = False, ctx=None,
+               **kwargs) -> HybridBlock:
+    block_type, layers, channels = resnet_spec[num_layers]
+    resnet_class = resnet_net_versions[version - 1]
+    block_class = resnet_block_versions[version - 1][block_type]
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    if pretrained:
+        from .model_store import load_pretrained
+        load_pretrained(net, f"resnet{num_layers}_v{version}", ctx)
+    return net
+
+
+def resnet18_v1(**kw):
+    return get_resnet(1, 18, **kw)
+
+
+def resnet34_v1(**kw):
+    return get_resnet(1, 34, **kw)
+
+
+def resnet50_v1(**kw):
+    return get_resnet(1, 50, **kw)
+
+
+def resnet101_v1(**kw):
+    return get_resnet(1, 101, **kw)
+
+
+def resnet152_v1(**kw):
+    return get_resnet(1, 152, **kw)
+
+
+def resnet18_v2(**kw):
+    return get_resnet(2, 18, **kw)
+
+
+def resnet34_v2(**kw):
+    return get_resnet(2, 34, **kw)
+
+
+def resnet50_v2(**kw):
+    return get_resnet(2, 50, **kw)
+
+
+def resnet101_v2(**kw):
+    return get_resnet(2, 101, **kw)
+
+
+def resnet152_v2(**kw):
+    return get_resnet(2, 152, **kw)
+
+
+# ---------------------------------------------------------------------------
+# VGG (model_zoo/vision/vgg.py parity)
+# ---------------------------------------------------------------------------
+
+vgg_spec = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            for i, num in enumerate(layers):
+                for _ in range(num):
+                    self.features.add(nn.Conv2D(filters[i], kernel_size=3, padding=1))
+                    if batch_norm:
+                        self.features.add(nn.BatchNorm())
+                    self.features.add(nn.Activation("relu"))
+                self.features.add(nn.MaxPool2D(strides=2))
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(0.5))
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(0.5))
+            self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def _vgg(num_layers, batch_norm=False, pretrained=False, ctx=None, **kwargs):
+    layers, filters = vgg_spec[num_layers]
+    net = VGG(layers, filters, batch_norm=batch_norm, **kwargs)
+    if pretrained:
+        from .model_store import load_pretrained
+        load_pretrained(net, f"vgg{num_layers}{'_bn' if batch_norm else ''}", ctx)
+    return net
+
+
+def vgg11(**kw):
+    return _vgg(11, **kw)
+
+
+def vgg13(**kw):
+    return _vgg(13, **kw)
+
+
+def vgg16(**kw):
+    return _vgg(16, **kw)
+
+
+def vgg19(**kw):
+    return _vgg(19, **kw)
+
+
+def vgg11_bn(**kw):
+    return _vgg(11, batch_norm=True, **kw)
+
+
+def vgg13_bn(**kw):
+    return _vgg(13, batch_norm=True, **kw)
+
+
+def vgg16_bn(**kw):
+    return _vgg(16, batch_norm=True, **kw)
+
+
+def vgg19_bn(**kw):
+    return _vgg(19, batch_norm=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (model_zoo/vision/alexnet.py parity)
+# ---------------------------------------------------------------------------
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(64, 11, 4, 2, activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(nn.Conv2D(192, 5, padding=2, activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(nn.Conv2D(384, 3, padding=1, activation="relu"))
+            self.features.add(nn.Conv2D(256, 3, padding=1, activation="relu"))
+            self.features.add(nn.Conv2D(256, 3, padding=1, activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(nn.Flatten())
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(0.5))
+            self.features.add(nn.Dense(4096, activation="relu"))
+            self.features.add(nn.Dropout(0.5))
+            self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def alexnet(pretrained=False, ctx=None, **kwargs):
+    net = AlexNet(**kwargs)
+    if pretrained:
+        from .model_store import load_pretrained
+        load_pretrained(net, "alexnet", ctx)
+    return net
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (model_zoo/vision/squeezenet.py parity)
+# ---------------------------------------------------------------------------
+
+
+class _Fire(HybridBlock):
+    def __init__(self, squeeze, expand1x1, expand3x3, **kwargs):
+        super().__init__(**kwargs)
+        self.squeeze = nn.Conv2D(squeeze, 1, activation="relu")
+        self.expand1 = nn.Conv2D(expand1x1, 1, activation="relu")
+        self.expand3 = nn.Conv2D(expand3x3, 3, padding=1, activation="relu")
+
+    def forward(self, x):
+        x = self.squeeze(x)
+        return nd.concat(self.expand1(x), self.expand3(x), dim=1)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version: str = "1.0", classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            if version == "1.0":
+                self.features.add(nn.Conv2D(96, 7, 2, activation="relu"))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                for sq, e1, e3 in [(16, 64, 64), (16, 64, 64), (32, 128, 128)]:
+                    self.features.add(_Fire(sq, e1, e3))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                for sq, e1, e3 in [(32, 128, 128), (48, 192, 192), (48, 192, 192),
+                                   (64, 256, 256)]:
+                    self.features.add(_Fire(sq, e1, e3))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                self.features.add(_Fire(64, 256, 256))
+            else:
+                self.features.add(nn.Conv2D(64, 3, 2, activation="relu"))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                for sq, e1, e3 in [(16, 64, 64), (16, 64, 64)]:
+                    self.features.add(_Fire(sq, e1, e3))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                for sq, e1, e3 in [(32, 128, 128), (32, 128, 128)]:
+                    self.features.add(_Fire(sq, e1, e3))
+                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                for sq, e1, e3 in [(48, 192, 192), (48, 192, 192), (64, 256, 256),
+                                   (64, 256, 256)]:
+                    self.features.add(_Fire(sq, e1, e3))
+            self.features.add(nn.Dropout(0.5))
+            self.output = nn.HybridSequential(prefix="")
+            self.output.add(nn.Conv2D(classes, 1, activation="relu"))
+            self.output.add(nn.GlobalAvgPool2D())
+            self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **_strip(kw))
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet("1.1", **_strip(kw))
+
+
+def _strip(kw):
+    kw.pop("pretrained", None)
+    kw.pop("ctx", None)
+    return kw
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (model_zoo/vision/densenet.py parity)
+# ---------------------------------------------------------------------------
+
+
+def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
+    out = nn.HybridSequential(prefix=f"stage{stage_index}_")
+    with out.name_scope():
+        for _ in range(num_layers):
+            out.add(_DenseLayer(growth_rate, bn_size, dropout))
+    return out
+
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(bn_size * growth_rate, 1, use_bias=False))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(growth_rate, 3, padding=1, use_bias=False))
+        if dropout:
+            self.body.add(nn.Dropout(dropout))
+
+    def forward(self, x):
+        return nd.concat(x, self.body(x), dim=1)
+
+
+def _make_transition(num_output_features):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+    out.add(nn.Conv2D(num_output_features, 1, use_bias=False))
+    out.add(nn.AvgPool2D(2, 2))
+    return out
+
+
+densenet_spec = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config, bn_size=4,
+                 dropout=0.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(num_init_features, 7, 2, 3, use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+            num_features = num_init_features
+            for i, num_layers in enumerate(block_config):
+                self.features.add(_make_dense_block(num_layers, bn_size, growth_rate,
+                                                    dropout, i + 1))
+                num_features += num_layers * growth_rate
+                if i != len(block_config) - 1:
+                    num_features //= 2
+                    self.features.add(_make_transition(num_features))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def _densenet(num_layers, **kwargs):
+    init_f, growth, cfg = densenet_spec[num_layers]
+    return DenseNet(init_f, growth, cfg, **_strip(kwargs))
+
+
+def densenet121(**kw):
+    return _densenet(121, **kw)
+
+
+def densenet161(**kw):
+    return _densenet(161, **kw)
+
+
+def densenet169(**kw):
+    return _densenet(169, **kw)
+
+
+def densenet201(**kw):
+    return _densenet(201, **kw)
+
+
+# ---------------------------------------------------------------------------
+# MobileNet v1/v2 (model_zoo/vision/mobilenet.py parity)
+# ---------------------------------------------------------------------------
+
+
+def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1, active=True,
+              relu6=False):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group, use_bias=False))
+    out.add(nn.BatchNorm())
+    if active:
+        out.add(nn.HybridLambda(lambda x: nd.clip(x, 0.0, 6.0)) if relu6
+                else nn.Activation("relu"))
+
+
+class MobileNet(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            _add_conv(self.features, int(32 * multiplier), 3, 2, 1)
+            dw_channels = [int(x * multiplier) for x in
+                           [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+            channels = [int(x * multiplier) for x in
+                        [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+            strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
+            for dwc, c, s in zip(dw_channels, channels, strides):
+                _add_conv(self.features, dwc, 3, s, 1, num_group=dwc)  # depthwise
+                _add_conv(self.features, c, 1, 1, 0)  # pointwise
+            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class _LinearBottleneck(HybridBlock):
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        self.out = nn.HybridSequential(prefix="")
+        _add_conv(self.out, in_channels * t, relu6=True)
+        _add_conv(self.out, in_channels * t, 3, stride, 1, num_group=in_channels * t,
+                  relu6=True)
+        _add_conv(self.out, channels, active=False)
+
+    def forward(self, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="features_")
+            _add_conv(self.features, int(32 * multiplier), 3, 2, 1, relu6=True)
+            in_c = [int(multiplier * x) for x in
+                    [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
+                    + [160] * 3]
+            channels = [int(multiplier * x) for x in
+                        [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 + [160] * 3
+                        + [320]]
+            ts = [1] + [6] * 16
+            strides = [1, 2, 1, 2, 1, 1, 2, 1, 1, 1, 1, 1, 1, 2, 1, 1, 1]
+            for ic, c, t, s in zip(in_c, channels, ts, strides):
+                self.features.add(_LinearBottleneck(ic, c, t, s))
+            last = int(1280 * multiplier) if multiplier > 1.0 else 1280
+            _add_conv(self.features, last, relu6=True)
+            self.features.add(nn.GlobalAvgPool2D())
+            self.output = nn.HybridSequential(prefix="output_")
+            self.output.add(nn.Conv2D(classes, 1, use_bias=False))
+            self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def mobilenet1_0(**kw):
+    return MobileNet(1.0, **_strip(kw))
+
+
+def mobilenet0_75(**kw):
+    return MobileNet(0.75, **_strip(kw))
+
+
+def mobilenet0_5(**kw):
+    return MobileNet(0.5, **_strip(kw))
+
+
+def mobilenet0_25(**kw):
+    return MobileNet(0.25, **_strip(kw))
+
+
+def mobilenet_v2_1_0(**kw):
+    return MobileNetV2(1.0, **_strip(kw))
+
+
+def mobilenet_v2_0_75(**kw):
+    return MobileNetV2(0.75, **_strip(kw))
+
+
+def mobilenet_v2_0_5(**kw):
+    return MobileNetV2(0.5, **_strip(kw))
+
+
+def mobilenet_v2_0_25(**kw):
+    return MobileNetV2(0.25, **_strip(kw))
+
+
+# ---------------------------------------------------------------------------
+# Inception V3 (model_zoo/vision/inception.py parity)
+# ---------------------------------------------------------------------------
+
+
+def _make_basic_conv(channels, kernel, stride=1, padding=0):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(channels, kernel, stride, padding, use_bias=False))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class _Branch(HybridBlock):
+    def __init__(self, branches, **kwargs):
+        super().__init__(**kwargs)
+        self.branches = branches
+        for i, b in enumerate(branches):
+            self.register_child(b, f"branch{i}")
+
+    def forward(self, x):
+        return nd.concat(*[b(x) for b in self.branches], dim=1)
+
+
+def _make_A(pool_features, prefix):
+    b1 = _make_basic_conv(64, 1)
+    b2 = nn.HybridSequential(); b2.add(_make_basic_conv(48, 1)); b2.add(_make_basic_conv(64, 5, padding=2))
+    b3 = nn.HybridSequential(); b3.add(_make_basic_conv(64, 1)); b3.add(_make_basic_conv(96, 3, padding=1)); b3.add(_make_basic_conv(96, 3, padding=1))
+    b4 = nn.HybridSequential(); b4.add(nn.AvgPool2D(3, 1, 1)); b4.add(_make_basic_conv(pool_features, 1))
+    return _Branch([b1, b2, b3, b4])
+
+
+def _make_B():
+    b1 = _make_basic_conv(384, 3, 2)
+    b2 = nn.HybridSequential(); b2.add(_make_basic_conv(64, 1)); b2.add(_make_basic_conv(96, 3, padding=1)); b2.add(_make_basic_conv(96, 3, 2))
+    b3 = nn.HybridSequential(); b3.add(nn.MaxPool2D(3, 2))
+    return _Branch([b1, b2, b3])
+
+
+def _make_C(channels_7x7):
+    b1 = _make_basic_conv(192, 1)
+    c = channels_7x7
+    b2 = nn.HybridSequential()
+    for ch, k, p in [(c, (1, 7), (0, 3)), (192, (7, 1), (3, 0))]:
+        b2.add(_make_basic_conv(ch, k, padding=p))
+    b2_pre = nn.HybridSequential(); b2_pre.add(_make_basic_conv(c, 1)); b2_pre.add(b2)
+    b3 = nn.HybridSequential()
+    b3.add(_make_basic_conv(c, 1))
+    for ch, k, p in [(c, (7, 1), (3, 0)), (c, (1, 7), (0, 3)), (c, (7, 1), (3, 0)),
+                     (192, (1, 7), (0, 3))]:
+        b3.add(_make_basic_conv(ch, k, padding=p))
+    b4 = nn.HybridSequential(); b4.add(nn.AvgPool2D(3, 1, 1)); b4.add(_make_basic_conv(192, 1))
+    return _Branch([b1, b2_pre, b3, b4])
+
+
+def _make_D():
+    b1 = nn.HybridSequential(); b1.add(_make_basic_conv(192, 1)); b1.add(_make_basic_conv(320, 3, 2))
+    b2 = nn.HybridSequential()
+    b2.add(_make_basic_conv(192, 1))
+    b2.add(_make_basic_conv(192, (1, 7), padding=(0, 3)))
+    b2.add(_make_basic_conv(192, (7, 1), padding=(3, 0)))
+    b2.add(_make_basic_conv(192, 3, 2))
+    b3 = nn.HybridSequential(); b3.add(nn.MaxPool2D(3, 2))
+    return _Branch([b1, b2, b3])
+
+
+class _SplitConcat(HybridBlock):
+    def __init__(self, pre, left, right, **kwargs):
+        super().__init__(**kwargs)
+        self.pre, self.left, self.right = pre, left, right
+        self.register_child(pre, "pre")
+        self.register_child(left, "left")
+        self.register_child(right, "right")
+
+    def forward(self, x):
+        x = self.pre(x)
+        return nd.concat(self.left(x), self.right(x), dim=1)
+
+
+def _make_E():
+    b1 = _make_basic_conv(320, 1)
+    b2 = _SplitConcat(_make_basic_conv(384, 1),
+                      _make_basic_conv(384, (1, 3), padding=(0, 1)),
+                      _make_basic_conv(384, (3, 1), padding=(1, 0)))
+    pre3 = nn.HybridSequential()
+    pre3.add(_make_basic_conv(448, 1))
+    pre3.add(_make_basic_conv(384, 3, padding=1))
+    b3 = _SplitConcat(pre3, _make_basic_conv(384, (1, 3), padding=(0, 1)),
+                      _make_basic_conv(384, (3, 1), padding=(1, 0)))
+    b4 = nn.HybridSequential(); b4.add(nn.AvgPool2D(3, 1, 1)); b4.add(_make_basic_conv(192, 1))
+    return _Branch([b1, b2, b3, b4])
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(_make_basic_conv(32, 3, 2))
+            self.features.add(_make_basic_conv(32, 3))
+            self.features.add(_make_basic_conv(64, 3, padding=1))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(_make_basic_conv(80, 1))
+            self.features.add(_make_basic_conv(192, 3))
+            self.features.add(nn.MaxPool2D(3, 2))
+            self.features.add(_make_A(32, "A1_"))
+            self.features.add(_make_A(64, "A2_"))
+            self.features.add(_make_A(64, "A3_"))
+            self.features.add(_make_B())
+            for c in (128, 160, 160, 192):
+                self.features.add(_make_C(c))
+            self.features.add(_make_D())
+            self.features.add(_make_E())
+            self.features.add(_make_E())
+            self.features.add(nn.AvgPool2D(8))
+            self.features.add(nn.Dropout(0.5))
+            self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(**kw):
+    return Inception3(**_strip(kw))
+
+
+# ---------------------------------------------------------------------------
+# LeNet (reference example/image-classification/symbols/lenet.py parity)
+# ---------------------------------------------------------------------------
+
+
+class LeNet(HybridBlock):
+    def __init__(self, classes=10, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(20, 5, activation="tanh"))
+            self.features.add(nn.MaxPool2D(2, 2))
+            self.features.add(nn.Conv2D(50, 5, activation="tanh"))
+            self.features.add(nn.MaxPool2D(2, 2))
+            self.features.add(nn.Flatten())
+            self.features.add(nn.Dense(500, activation="tanh"))
+            self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def lenet(**kw):
+    return LeNet(**_strip(kw))
+
+
+# ---------------------------------------------------------------------------
+# registry (model_zoo/__init__.py get_model parity)
+# ---------------------------------------------------------------------------
+
+_models = {
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+    "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+    "resnet152_v1": resnet152_v1, "resnet18_v2": resnet18_v2,
+    "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
+    "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+    "vgg19_bn": vgg19_bn, "alexnet": alexnet,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
+    "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "inceptionv3": inception_v3, "lenet": lenet,
+}
+
+
+def get_model(name: str, **kwargs) -> HybridBlock:
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(f"unknown model {name!r}; available: {sorted(_models)}")
+    return _models[name](**kwargs)

@@ -1,0 +1,6 @@
+"""gluon.rnn — recurrent layers and cells (parity with python/mxnet/gluon/rnn)."""
+
+from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell, HybridRecurrentCell,
+                       LSTMCell, ModifierCell, RecurrentCell, ResidualCell, RNNCell,
+                       SequentialRNNCell, ZoneoutCell)
+from .rnn_layer import GRU, LSTM, RNN

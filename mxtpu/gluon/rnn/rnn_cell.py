@@ -1,0 +1,311 @@
+"""RNN cells — parity with ``python/mxnet/gluon/rnn/rnn_cell.py``: RNNCell, LSTMCell,
+GRUCell, SequentialRNNCell, DropoutCell, ZoneoutCell, ResidualCell, BidirectionalCell
++ ``unroll`` (explicit-step API used by BucketingModule workflows)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+
+    def state_info(self, batch_size: int = 0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size: int = 0, func=None, **kwargs):
+        func = func or nd.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def reset(self):
+        pass
+
+    def __call__(self, inputs, states):
+        return self.forward(inputs, states)
+
+    def unroll(self, length: int, inputs, begin_state=None, layout: str = "NTC",
+               merge_outputs: Optional[bool] = None, valid_length=None):
+        """Explicit unroll (rnn_cell.py BaseRNNCell.unroll parity)."""
+        axis = layout.find("T")
+        if isinstance(inputs, NDArray):
+            steps = nd.split(inputs, num_outputs=length, axis=axis, squeeze_axis=True) \
+                if length > 1 else [inputs.squeeze(axis)]
+        else:
+            steps = list(inputs)
+        batch = steps[0].shape[0]
+        states = begin_state if begin_state is not None else self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            out, states = self(steps[t], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = nd.stack(*outputs, axis=0)  # (T, N, C)
+            masked = nd.SequenceMask(stacked, valid_length, use_sequence_length=True)
+            outputs = [masked[t] for t in range(length)]
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size: int, activation: str = "tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size: int = 0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight",
+                                              shape=(hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight",
+                                              shape=(hidden_size, hidden_size),
+                                              init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                            init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size: int = 0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _finish(self, x):
+        if self.i2h_weight._data is None:
+            self.i2h_weight._finish_deferred_init((self._hidden_size, x.shape[-1]))
+
+    def forward(self, inputs, states):
+        self._finish(inputs)
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=self._hidden_size)
+        h2h = nd.FullyConnected(states[0], self.h2h_weight.data(),
+                                self.h2h_bias.data(), num_hidden=self._hidden_size)
+        out = nd.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size: int, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size: int = 0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        h = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(4 * h, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(4 * h, h),
+                                              init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(4 * h,),
+                                            init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(4 * h,),
+                                            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size: int = 0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        if self.i2h_weight._data is None:
+            self.i2h_weight._finish_deferred_init(
+                (4 * self._hidden_size, inputs.shape[-1]))
+        h = self._hidden_size
+        gates = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                  num_hidden=4 * h) + \
+            nd.FullyConnected(states[0], self.h2h_weight.data(), self.h2h_bias.data(),
+                              num_hidden=4 * h)
+        i, f, g, o = nd.split(gates, num_outputs=4, axis=1)
+        i, f, o = nd.sigmoid(i), nd.sigmoid(f), nd.sigmoid(o)
+        g = nd.tanh(g)
+        next_c = f * states[1] + i * g
+        next_h = o * nd.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size: int, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size: int = 0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        h = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(3 * h, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(3 * h, h),
+                                              init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(3 * h,),
+                                            init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(3 * h,),
+                                            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size: int = 0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        if self.i2h_weight._data is None:
+            self.i2h_weight._finish_deferred_init(
+                (3 * self._hidden_size, inputs.shape[-1]))
+        h = self._hidden_size
+        ix = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                               num_hidden=3 * h)
+        ih = nd.FullyConnected(states[0], self.h2h_weight.data(), self.h2h_bias.data(),
+                               num_hidden=3 * h)
+        ir, iz, inn = nd.split(ix, num_outputs=3, axis=1)
+        hr, hz, hn = nd.split(ih, num_outputs=3, axis=1)
+        r = nd.sigmoid(ir + hr)
+        z = nd.sigmoid(iz + hz)
+        n = nd.tanh(inn + r * hn)
+        next_h = (1 - z) * n + z * states[0]
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size: int = 0):
+        out = []
+        for cell in self._children.values():
+            out += cell.state_info(batch_size)
+        return out
+
+    def begin_state(self, batch_size: int = 0, **kwargs):
+        out = []
+        for cell in self._children.values():
+            out += cell.begin_state(batch_size, **kwargs)
+        return out
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[pos:pos + n])
+            next_states += st
+            pos += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate: float, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size: int = 0):
+        return []
+
+    def forward(self, inputs, states):
+        return nd.Dropout(inputs, p=self._rate, axes=self._axes), states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell: RecurrentCell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size: int = 0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size: int = 0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def collect_params(self, select=None):
+        return self.base_cell.collect_params(select)
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs: float = 0.0,
+                 zoneout_states: float = 0.0):
+        super().__init__(base_cell)
+        self._zo, self._zs = zoneout_outputs, zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        from ... import autograd
+        if autograd.is_training():
+            if self._zo > 0:
+                prev = self._prev_output if self._prev_output is not None \
+                    else nd.zeros_like(out)
+                mask = nd.Dropout(nd.ones_like(out), p=self._zo)
+                out = nd.where(mask, out, prev)
+            if self._zs > 0:
+                next_states = [
+                    nd.where(nd.Dropout(nd.ones_like(ns), p=self._zs), ns, s)
+                    for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(ModifierCell):
+    def forward(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        return out + inputs, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix: str = "bi_"):
+        super().__init__()
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size: int = 0):
+        return (self._children["l_cell"].state_info(batch_size)
+                + self._children["r_cell"].state_info(batch_size))
+
+    def begin_state(self, batch_size: int = 0, **kwargs):
+        return (self._children["l_cell"].begin_state(batch_size, **kwargs)
+                + self._children["r_cell"].begin_state(batch_size, **kwargs))
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        if isinstance(inputs, NDArray):
+            steps = nd.split(inputs, num_outputs=length, axis=axis, squeeze_axis=True)
+        else:
+            steps = list(inputs)
+        batch = steps[0].shape[0]
+        states = begin_state if begin_state is not None else self.begin_state(batch)
+        nl = len(l_cell.state_info())
+        l_states, r_states = states[:nl], states[nl:]
+        l_outs, l_states = l_cell.unroll(length, steps, l_states, layout="NTC",
+                                         merge_outputs=False)
+        r_outs, r_states = r_cell.unroll(length, list(reversed(steps)), r_states,
+                                         layout="NTC", merge_outputs=False)
+        outs = [nd.concat(lo, ro, dim=1)
+                for lo, ro in zip(l_outs, reversed(r_outs))]
+        if merge_outputs:
+            outs = nd.stack(*outs, axis=axis)
+        return outs, l_states + r_states
+
+
+class HybridRecurrentCell(RecurrentCell):
+    pass

@@ -1,0 +1,150 @@
+"""Fused RNN layers — parity with ``python/mxnet/gluon/rnn/rnn_layer.py``
+(RNN/LSTM/GRU: num_layers, bidirectional, dropout between layers, TNC/NTC layout,
+begin_state). Backed by the fused ``rnn_scan`` op (lax.scan over MXU matmuls)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size: int, num_layers: int, layout: str, dropout: float,
+                 bidirectional: bool, input_size: int, mode: str,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, h = self._gates, hidden_size
+        with self.name_scope():
+            for layer in range(num_layers):
+                for d, suffix in enumerate(["l", "r"][:self._dir]):
+                    isz = input_size if layer == 0 else h * self._dir
+                    setattr(self, f"{suffix}{layer}_i2h_weight", self.params.get(
+                        f"{suffix}{layer}_i2h_weight", shape=(ng * h, isz),
+                        init=i2h_weight_initializer, allow_deferred_init=True))
+                    setattr(self, f"{suffix}{layer}_h2h_weight", self.params.get(
+                        f"{suffix}{layer}_h2h_weight", shape=(ng * h, h),
+                        init=h2h_weight_initializer))
+                    setattr(self, f"{suffix}{layer}_i2h_bias", self.params.get(
+                        f"{suffix}{layer}_i2h_bias", shape=(ng * h,),
+                        init=i2h_bias_initializer))
+                    setattr(self, f"{suffix}{layer}_h2h_bias", self.params.get(
+                        f"{suffix}{layer}_h2h_bias", shape=(ng * h,),
+                        init=h2h_bias_initializer))
+
+    def state_info(self, batch_size: int = 0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        if self._mode == "lstm":
+            return [{"shape": shape, "__layout__": "LNC"},
+                    {"shape": shape, "__layout__": "LNC"}]
+        return [{"shape": shape, "__layout__": "LNC"}]
+
+    def begin_state(self, batch_size: int = 0, func=None, **kwargs) -> List[NDArray]:
+        func = func or nd.zeros
+        return [func(shape=info["shape"], **kwargs) for info in
+                self.state_info(batch_size)]
+
+    def forward(self, inputs, states=None):
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        T, B = inputs.shape[0], inputs.shape[1]
+        if self.params is not None:
+            for layer in range(self._num_layers):
+                for suffix in ["l", "r"][:self._dir]:
+                    w = getattr(self, f"{suffix}{layer}_i2h_weight")
+                    if w._data is None:
+                        isz = inputs.shape[2] if layer == 0 else \
+                            self._hidden_size * self._dir
+                        w._finish_deferred_init(
+                            (self._gates * self._hidden_size, isz))
+        ret_states = states is not None
+        if states is None:
+            states = self.begin_state(B)
+        elif not isinstance(states, (list, tuple)):
+            states = [states]
+
+        h_all = states[0]
+        c_all = states[1] if self._mode == "lstm" else None
+        out = inputs
+        new_h, new_c = [], []
+        for layer in range(self._num_layers):
+            layer_outs = []
+            for d, suffix in enumerate(["l", "r"][:self._dir]):
+                idx = layer * self._dir + d
+                h0 = h_all[idx]
+                args = [out, h0]
+                if self._mode == "lstm":
+                    args.append(c_all[idx])
+                args += [getattr(self, f"{suffix}{layer}_i2h_weight").data(),
+                         getattr(self, f"{suffix}{layer}_i2h_bias").data(),
+                         getattr(self, f"{suffix}{layer}_h2h_weight").data(),
+                         getattr(self, f"{suffix}{layer}_h2h_bias").data()]
+                res = nd.rnn_scan(*args, mode=self._mode, reverse=(d == 1))
+                if self._mode == "lstm":
+                    o, hT, cT = res
+                    new_c.append(cT)
+                else:
+                    o, hT = res
+                layer_outs.append(o)
+                new_h.append(hT)
+            out = layer_outs[0] if self._dir == 1 else nd.concat(*layer_outs, dim=2)
+            if self._dropout > 0 and layer != self._num_layers - 1:
+                out = nd.Dropout(out, p=self._dropout)
+
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        out_states = [nd.stack(*new_h, axis=0)]
+        if self._mode == "lstm":
+            out_states.append(nd.stack(*new_c, axis=0))
+        if ret_states:
+            return out, out_states
+        return out
+
+    def __call__(self, inputs, states=None):
+        # bypass HybridBlock's single-signature __call__ for the optional states arg
+        if states is None:
+            return super().__call__(inputs)
+        return Block_call_with_states(self, inputs, states)
+
+
+def Block_call_with_states(block, inputs, states):
+    return block.forward(inputs, states)
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (relu/tanh) — rnn_layer.py RNN parity."""
+
+    def __init__(self, hidden_size: int, num_layers: int = 1, activation: str = "relu",
+                 layout: str = "TNC", dropout: float = 0.0, bidirectional: bool = False,
+                 input_size: int = 0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, f"rnn_{activation}", **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size: int, num_layers: int = 1, layout: str = "TNC",
+                 dropout: float = 0.0, bidirectional: bool = False,
+                 input_size: int = 0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size: int, num_layers: int = 1, layout: str = "TNC",
+                 dropout: float = 0.0, bidirectional: bool = False,
+                 input_size: int = 0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "gru", **kwargs)

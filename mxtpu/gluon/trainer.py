@@ -1,0 +1,138 @@
+"""Trainer — parity with ``python/mxnet/gluon/trainer.py`` (kvstore-backed optimizer
+driver: allreduce_grads → update, save/load_states, gradient compression hookup)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .. import kvstore as kv_mod
+from .. import optimizer as opt_mod
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params: Optional[dict] = None,
+                 kvstore: Union[str, "kv_mod.KVStore", None] = "device",
+                 compression_params: Optional[dict] = None,
+                 update_on_kvstore: Optional[bool] = None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        self._params: List[Parameter] = [p for p in params if p.grad_req != "null"]
+        self._all_params = list(params)
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt_mod.create(optimizer, **optimizer_params) \
+            if isinstance(optimizer, str) else optimizer
+        self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}
+        self._states = [None] * len(self._params)
+        self._kv_type = kvstore
+        self._kvstore: Optional[kv_mod.KVStore] = None
+        self._update_on_kvstore = update_on_kvstore
+        self._compression_params = compression_params
+        self._kv_initialized = False
+
+    # -- kvstore wiring ----------------------------------------------------
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        if self._kv_type is None:
+            self._kvstore = None
+        else:
+            kvs = self._kv_type if isinstance(self._kv_type, kv_mod.KVStore) \
+                else kv_mod.create(self._kv_type)
+            self._kvstore = kvs
+            if self._compression_params:
+                kvs.set_gradient_compression(self._compression_params)
+            update_on_kv = self._update_on_kvstore
+            if update_on_kv is None:
+                update_on_kv = kvs.type.startswith("dist")
+            self._update_on_kv = update_on_kv
+            for i, p in enumerate(self._params):
+                kvs.init(i, p.data())
+            if update_on_kv:
+                kvs.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr: float):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    # -- the step ----------------------------------------------------------
+    def step(self, batch_size: int, ignore_stale_grad: bool = False):
+        """allreduce (kvstore) + optimizer update; grads rescaled by 1/batch_size
+        (trainer.py step parity)."""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad, _skip_allreduce=True)
+
+    def allreduce_grads(self):
+        self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                continue
+            if self._update_on_kv:
+                continue  # push+pull handled in update for update_on_kvstore=False
+            # local kvstore without server updater: push/pull is a no-op reduce for
+            # a single logical device — grads already aggregated by XLA collectives.
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False,
+               _skip_allreduce: bool = False):
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                continue
+            grad = p._data._grad
+            if grad is None:
+                if ignore_stale_grad:
+                    continue
+                raise RuntimeError(f"Parameter {p.name} has no gradient; run "
+                                   "backward() inside autograd.record() first")
+            if self._kvstore is not None and self._update_on_kv:
+                self._kvstore.push(i, grad)
+                self._kvstore.pull(i, p.data())
+            else:
+                if self._states[i] is None:
+                    self._states[i] = self._optimizer.create_state_multi_precision(
+                        i, p.data())
+                self._states[i] = self._optimizer.update(i, p.data(), grad,
+                                                         self._states[i])
+
+    # -- state io ----------------------------------------------------------
+    def save_states(self, fname: str):
+        self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kv:
+            self._kvstore.save_optimizer_states(fname)
+            return
+        import pickle
+        import jax
+        blob = {i: [jax.device_get(x) for x in (s or ())]
+                for i, s in enumerate(self._states)}
+        with open(fname, "wb") as f:
+            pickle.dump({"states": blob, "num_update": self._optimizer.num_update,
+                         "counts": self._optimizer._index_update_count}, f)
+
+    def load_states(self, fname: str):
+        self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kv:
+            self._kvstore.load_optimizer_states(fname)
+            return
+        import pickle
+        import jax.numpy as jnp
+        with open(fname, "rb") as f:
+            data = pickle.load(f)
+        self._states = [tuple(jnp.asarray(x) for x in data["states"].get(i, ()))
+                        or None for i in range(len(self._params))]
+        self._optimizer.num_update = data["num_update"]
+        self._optimizer._index_update_count = data["counts"]

@@ -1,0 +1,77 @@
+"""Gluon utilities — parity with ``python/mxnet/gluon/utils.py``: split_data,
+split_and_load, clip_global_norm, check_sha1, download (gated: zero-egress)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import ndarray as nd
+from ..context import Context
+from ..ndarray.ndarray import NDArray
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"cannot evenly split axis {batch_axis} of size {size} into {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list: Sequence[Context], batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Reference: slice a batch across GPUs. On TPU, prefer sharded arrays
+    (mxtpu.parallel.shard_batch) — this exists for API/migration parity."""
+    data = data if isinstance(data, NDArray) else nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: Sequence[NDArray], max_norm: float) -> float:
+    """Rescale arrays in place so their joint L2 norm ≤ max_norm (utils.py parity)."""
+    total = 0.0
+    sq = [jnp.sum(jnp.square(a.data)) for a in arrays]
+    total = jnp.sqrt(sum(sq))
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-12))
+    for a in arrays:
+        a._set_data(a.data * scale.astype(a.data.dtype))
+    return float(total)
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url: str, path: Optional[str] = None, overwrite: bool = False,
+             sha1_hash: Optional[str] = None) -> str:
+    """Model-zoo download shim. This environment is zero-egress; honor a local
+    mirror via MXTPU_REPO_DIR, else raise with guidance."""
+    fname = url.split("/")[-1]
+    repo = os.environ.get("MXTPU_REPO_DIR")
+    if repo:
+        cand = os.path.join(repo, fname)
+        if os.path.exists(cand):
+            return cand
+    raise RuntimeError(
+        f"cannot download {url}: no network egress. Set MXTPU_REPO_DIR to a local "
+        "mirror directory containing the file, or pass pretrained=False")

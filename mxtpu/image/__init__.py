@@ -1,0 +1,11 @@
+"""mx.image parity — host-side decode/resize/augmenters + ImageIter.
+
+The reference's ``src/io/image_aug_default.cc`` + ``python/mxnet/image`` do OpenCV
+augmentation on CPU worker threads; here PIL/numpy fill that role (DataLoader threads),
+and anything per-batch on device goes through the image ops (``nd.image``-style).
+"""
+
+from .image import (CreateAugmenter, HorizontalFlipAug, CastAug, CenterCropAug,
+                    ColorJitterAug, ForceResizeAug, ImageIter, RandomCropAug,
+                    ResizeAug, color_normalize, fixed_crop, imdecode, imread,
+                    imresize, random_crop, center_crop, resize_short)

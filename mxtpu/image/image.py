@@ -1,0 +1,271 @@
+"""Image decode/augment — parity with ``python/mxnet/image/image.py`` essentials."""
+
+from __future__ import annotations
+
+import io
+import os
+import random as pyrandom
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def imdecode(buf: bytes, flag: int = 1, to_rgb: bool = True) -> NDArray:
+    """Decode compressed image bytes → HWC uint8 NDArray (image.py imdecode)."""
+    img = _pil().open(io.BytesIO(buf))
+    if flag == 0:
+        img = img.convert("L")
+        arr = np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = np.asarray(img)
+    return nd.array(arr.astype(np.uint8), dtype="uint8")
+
+
+def imread(filename: str, flag: int = 1, to_rgb: bool = True) -> NDArray:
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    pil = _pil().fromarray(arr.squeeze(-1) if squeeze else arr.astype(np.uint8))
+    out = np.asarray(pil.resize((w, h), resample=_pil().BILINEAR))
+    if squeeze:
+        out = out[:, :, None]
+    return nd.array(out.astype(arr.dtype), dtype=str(arr.dtype))
+
+
+def resize_short(src, size: int, interp: int = 2) -> NDArray:
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0: int, y0: int, w: int, h: int, size=None, interp: int = 2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(nd.array(out, dtype=str(out.dtype)), size[0], size[1], interp)
+    return nd.array(out, dtype=str(out.dtype))
+
+
+def random_crop(src, size: Tuple[int, int], interp: int = 2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    cw, ch = size
+    cw, ch = min(cw, w), min(ch, h)
+    x0 = pyrandom.randint(0, w - cw)
+    y0 = pyrandom.randint(0, h - ch)
+    return fixed_crop(src, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
+
+
+def center_crop(src, size: Tuple[int, int], interp: int = 2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    cw, ch = size
+    x0 = max(0, (w - cw) // 2)
+    y0 = max(0, (h - ch) // 2)
+    return fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp), (x0, y0, cw, ch)
+
+
+def color_normalize(src: NDArray, mean, std=None) -> NDArray:
+    out = src.astype("float32") - (mean if isinstance(mean, NDArray) else nd.array(mean))
+    if std is not None:
+        out = out / (std if isinstance(std, NDArray) else nd.array(std))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# augmenters (image.py Augmenter chain parity)
+# ---------------------------------------------------------------------------
+
+
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size: int, interp: int = 2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size: Tuple[int, int], interp: int = 2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size: Tuple[int, int], interp: int = 2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size: Tuple[int, int], interp: int = 2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = src.asnumpy()[:, ::-1]
+            return nd.array(np.ascontiguousarray(arr), dtype=str(arr.dtype))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ: str = "float32"):
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness: float = 0, contrast: float = 0,
+                 saturation: float = 0):
+        self.b, self.c, self.s = brightness, contrast, saturation
+
+    def __call__(self, src):
+        arr = src.asnumpy().astype(np.float32)
+        if self.b:
+            arr = arr * (1 + pyrandom.uniform(-self.b, self.b))
+        if self.c:
+            gray = arr.mean()
+            arr = gray + (arr - gray) * (1 + pyrandom.uniform(-self.c, self.c))
+        if self.s:
+            g = arr.mean(axis=-1, keepdims=True)
+            arr = g + (arr - g) * (1 + pyrandom.uniform(-self.s, self.s))
+        return nd.array(np.clip(arr, 0, 255))
+
+
+def CreateAugmenter(data_shape, resize: int = 0, rand_crop: bool = False,
+                    rand_resize: bool = False, rand_mirror: bool = False,
+                    mean=None, std=None, brightness: float = 0, contrast: float = 0,
+                    saturation: float = 0, pca_noise: float = 0, inter_method: int = 2
+                    ) -> List[Augmenter]:
+    """image.py CreateAugmenter parity: build the standard augmentation chain."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if mean is not None or std is not None:
+        class _Norm(Augmenter):
+            def __call__(self, src):
+                return color_normalize(src, nd.array(mean) if mean is not None else 0,
+                                       nd.array(std) if std is not None else None)
+        auglist.append(_Norm())
+    return auglist
+
+
+class ImageIter:
+    """mx.image.ImageIter parity: .rec/.lst/folder-driven batch iterator with
+    augmentation chain, NCHW output."""
+
+    def __init__(self, batch_size: int, data_shape, label_width: int = 1,
+                 path_imgrec: Optional[str] = None, path_imglist: Optional[str] = None,
+                 path_root: str = "", shuffle: bool = False, aug_list=None,
+                 imglist=None, **kwargs):
+        from ..io import DataBatch, DataDesc
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.auglist = aug_list if aug_list is not None else CreateAugmenter(
+            (batch_size,) + self.data_shape, **{k: v for k, v in kwargs.items()
+                                               if k in ("resize", "rand_crop",
+                                                        "rand_mirror", "mean", "std")})
+        self._items = []
+        if path_imgrec:
+            from ..gluon.data import RecordFileDataset
+            self._rec = RecordFileDataset(path_imgrec)
+            self._items = list(range(len(self._rec)))
+            self._mode = "rec"
+        elif imglist is not None:
+            self._list = imglist
+            self._root = path_root
+            self._items = list(range(len(imglist)))
+            self._mode = "list"
+        else:
+            raise ValueError("need path_imgrec or imglist")
+        self._shuffle = shuffle
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            pyrandom.shuffle(self._items)
+
+    def _read(self, idx):
+        from .. import recordio
+        if self._mode == "rec":
+            header, payload = recordio.unpack(self._rec[idx])
+            img = imdecode(payload)
+            label = header.label
+        else:
+            label, path = self._list[idx][0], self._list[idx][-1]
+            img = imread(os.path.join(self._root, path))
+        for aug in self.auglist:
+            img = aug(img)
+        return img, np.asarray(label, np.float32)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from ..io import DataBatch
+        if self._cursor >= len(self._items):
+            raise StopIteration
+        imgs, labels = [], []
+        pad = 0
+        for i in range(self.batch_size):
+            if self._cursor + i < len(self._items):
+                img, label = self._read(self._items[self._cursor + i])
+                arr = img.asnumpy().astype(np.float32)
+                imgs.append(arr.transpose(2, 0, 1))
+                labels.append(label)
+            else:
+                pad += 1
+                imgs.append(imgs[-1])
+                labels.append(labels[-1])
+        self._cursor += self.batch_size
+        return DataBatch(data=[nd.array(np.stack(imgs))],
+                         label=[nd.array(np.stack(labels))], pad=pad)
+
+    next = __next__

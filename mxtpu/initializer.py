@@ -1,0 +1,188 @@
+"""Weight initializers — parity with ``python/mxnet/initializer.py`` (SURVEY.md §2.5).
+
+Registry-backed so string specs work everywhere a reference API accepts them
+(``net.initialize(init='xavier')``, ``Parameter(init=...)``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import rng
+from .base import Registry, dtype_np
+from .ndarray.ndarray import NDArray
+
+registry = Registry("initializer")
+register = registry.register
+
+
+class Initializer:
+    """Base initializer. Subclasses implement ``_init_array(key, shape, dtype)``."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name_or_arr, arr: Optional[NDArray] = None):
+        """Two calling conventions for parity: ``init(name, arr)`` (reference
+        InitDesc protocol) or ``init(arr)``."""
+        if arr is None:
+            name, arr = "", name_or_arr
+        else:
+            name = str(name_or_arr)
+        self.init_array(name, arr)
+        return arr
+
+    def init_array(self, name: str, arr: NDArray):
+        lname = name.lower()
+        if lname.endswith("bias") or lname.endswith("beta") or lname.endswith("running_mean"):
+            arr._set_data(jnp.zeros(arr.shape, arr.dtype))
+        elif lname.endswith("gamma") or lname.endswith("running_var"):
+            arr._set_data(jnp.ones(arr.shape, arr.dtype))
+        else:
+            arr._set_data(self._init_array(rng.next_key(), arr.shape, arr.dtype))
+
+    def _init_array(self, key, shape, dtype):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register(name="zeros", aliases=("zero",))
+class Zero(Initializer):
+    def _init_array(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@register(name="ones", aliases=("one",))
+class One(Initializer):
+    def _init_array(self, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+@register(name="constant")
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_array(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register(name="uniform")
+class Uniform(Initializer):
+    def __init__(self, scale: float = 0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_array(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, jnp.float32, -self.scale,
+                                  self.scale).astype(dtype)
+
+
+@register(name="normal")
+class Normal(Initializer):
+    def __init__(self, sigma: float = 0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_array(self, key, shape, dtype):
+        return (self.sigma * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+def _fans(shape):
+    if len(shape) < 2:
+        return (shape[0] if shape else 1,) * 2
+    hw = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * hw
+    fan_out = shape[0] * hw
+    return fan_in, fan_out
+
+
+@register(name="xavier")
+class Xavier(Initializer):
+    """Glorot init (initializer.py Xavier): factor_type in/out/avg × uniform/gaussian."""
+
+    def __init__(self, rnd_type: str = "uniform", factor_type: str = "avg",
+                 magnitude: float = 3.0):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type, self.factor_type, self.magnitude = rnd_type, factor_type, magnitude
+
+    def _init_array(self, key, shape, dtype):
+        fan_in, fan_out = _fans(shape)
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[
+            self.factor_type]
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            out = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        else:
+            out = scale * jax.random.normal(key, shape, jnp.float32)
+        return out.astype(dtype)
+
+
+@register(name="msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type: str = "avg", slope: float = 0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register(name="orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale: float = 1.414, rand_type: str = "uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_array(self, key, shape, dtype):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = jax.random.normal(key, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return (self.scale * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+@register(name="bilinear")
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (for Deconvolution-based UpSampling)."""
+
+    def _init_array(self, key, shape, dtype):
+        weight = np.zeros(shape, np.float32)
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype)
+
+
+@register(name="lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias init (initializer.py LSTMBias): forget gate = forget_bias."""
+
+    def __init__(self, forget_bias: float = 1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_array(self, key, shape, dtype):
+        out = np.zeros(shape, np.float32)
+        n = shape[0] // 4
+        out[n:2 * n] = self.forget_bias  # gate order i,f,c,o
+        return jnp.asarray(out, dtype)
+
+
+def create(spec) -> Initializer:
+    if isinstance(spec, Initializer) or callable(spec) and not isinstance(spec, str):
+        return spec
+    if spec is None:
+        return Uniform()
+    return registry.get(spec)()

@@ -1,0 +1,378 @@
+"""Data iterators — parity with ``python/mxnet/io.py`` (DataIter/DataBatch/DataDesc,
+NDArrayIter, CSVIter, MNISTIter, ResizeIter, PrefetchingIter) and the C++ iterator
+framework of ``src/io/`` (SURVEY.md §2.4: layered decorators — batching, shuffle,
+prefetch).
+
+Host pipeline is numpy/threads; the device boundary is one ``nd.array`` per batch.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
+DataDesc.__new__.__defaults__ = (np.float32, "NCHW")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad: int = 0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(), self.getpad(),
+                             self.getindex())
+        raise StopIteration
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self) -> int:
+        return 0
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty: bool, default_name: str):
+    if data is None:
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{i if i else ''}" if len(data) > 1 else default_name: d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        out.append((k, arr))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (io.py NDArrayIter: pad/discard/roll_over last-batch)."""
+
+    def __init__(self, data, label=None, batch_size: int = 1, shuffle: bool = False,
+                 last_batch_handle: str = "pad", data_name: str = "data",
+                 label_name: str = "softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.last_batch_handle = last_batch_handle
+        self.shuffle = shuffle
+        self.cursor = -batch_size
+        self._shuffled_idx = np.arange(self.num_data)
+        if shuffle:
+            np.random.shuffle(self._shuffled_idx)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._shuffled_idx)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self) -> bool:
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for _, arr in arrays:
+            end = self.cursor + self.batch_size
+            if end <= self.num_data:
+                idx = self._shuffled_idx[self.cursor:end]
+                out.append(nd.array(arr[idx]))
+            else:  # pad by wrapping
+                idx = np.concatenate([self._shuffled_idx[self.cursor:],
+                                      self._shuffled_idx[:end - self.num_data]])
+                out.append(nd.array(arr[idx]))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self) -> int:
+        end = self.cursor + self.batch_size
+        return max(0, end - self.num_data)
+
+
+class CSVIter(DataIter):
+    """CSV-backed iterator (src/io/iter_csv.cc parity)."""
+
+    def __init__(self, data_csv: str, data_shape, label_csv: Optional[str] = None,
+                 label_shape=(1,), batch_size: int = 1, round_batch: bool = True):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        self._inner_data = data.reshape((-1,) + tuple(data_shape))
+        label = (np.loadtxt(label_csv, delimiter=",", dtype=np.float32, ndmin=2)
+                 if label_csv else np.zeros((len(self._inner_data), 1), np.float32))
+        self._inner = NDArrayIter(self._inner_data, label.squeeze(-1) if
+                                  label.shape[-1] == 1 else label, batch_size,
+                                  last_batch_handle="pad" if round_batch else "discard",
+                                  label_name="label")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class MNISTIter(DataIter):
+    """MNIST iterator (src/io/iter_mnist.cc parity): flat=True → (N,784)."""
+
+    def __init__(self, image: str = "", label: str = "", batch_size: int = 128,
+                 shuffle: bool = True, flat: bool = False, seed: int = 0,
+                 silent: bool = False, synthetic: bool = False, **kwargs):
+        super().__init__(batch_size)
+        if image and os.path.exists(image) or (image and os.path.exists(image + ".gz")):
+            from .gluon.data.vision.datasets import _read_idx_images, _read_idx_labels
+            imgs = _read_idx_images(image).astype(np.float32) / 255.0
+            lbls = _read_idx_labels(label).astype(np.float32)
+        else:
+            rs = np.random.RandomState(seed or 42)
+            n = 1024
+            imgs = rs.rand(n, 28, 28, 1).astype(np.float32)
+            lbls = rs.randint(0, 10, (n,)).astype(np.float32)
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs.transpose(0, 3, 1, 2)  # NCHW
+        self._inner = NDArrayIter(imgs, lbls, batch_size, shuffle=shuffle)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (io.py ResizeIter)."""
+
+    def __init__(self, data_iter: DataIter, size: int, reset_internal: bool = True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffered producer thread (io.py PrefetchingIter ≈ iter_prefetcher.h).
+
+    Exceptions in the producer are re-raised at next() — the reference's
+    exception-propagation contract (docs/architecture/exception_handling.md).
+    """
+
+    def __init__(self, iters, rename_data=None, rename_label=None, prefetch: int = 2):
+        iters = iters if isinstance(iters, (list, tuple)) else [iters]
+        assert len(iters) == 1, "single backing iter supported"
+        super().__init__(iters[0].batch_size)
+        self.iter = iters[0]
+        self._prefetch = prefetch
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self._stop = threading.Event()
+        self._started = False
+
+    def _put(self, item) -> bool:
+        """Stop-aware put: returns False if reset() asked the producer to die."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self):
+        try:
+            for batch in self.iter:
+                if not self._put(("data", batch)):
+                    return
+        except Exception as e:  # propagate to consumer at next()
+            self._put(("error", e))
+            return
+        self._put(("end", None))
+
+    def _ensure(self):
+        if not self._started:
+            self._thread = threading.Thread(target=self._producer, daemon=True)
+            self._thread.start()
+            self._started = True
+
+    def reset(self):
+        if self._started:
+            # kill the producer before touching the backing iterator, or a blocked
+            # put would keep draining the freshly-reset iter
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._stop.clear()
+        self.iter.reset()
+        self._queue = queue.Queue(maxsize=self._prefetch)
+        self._started = False
+
+    def next(self):
+        self._ensure()
+        kind, payload = self._queue.get()
+        if kind == "error":
+            raise payload
+        if kind == "end":
+            raise StopIteration
+        return payload
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+
+def ImageRecordIter(path_imgrec: str, data_shape, batch_size: int,
+                    label_width: int = 1, shuffle: bool = False,
+                    preprocess_threads: int = 4, prefetch_buffer: int = 2,
+                    rand_crop: bool = False, rand_mirror: bool = False,
+                    mean_r: float = 0, mean_g: float = 0, mean_b: float = 0,
+                    std_r: float = 1, std_g: float = 1, std_b: float = 1,
+                    resize: int = 0, **kwargs) -> DataIter:
+    """ImageRecordIter parity (iter_image_recordio_2.cc): RecordIO → threaded decode/
+    augment → NCHW batches, wrapped in a prefetcher."""
+    from .image import ImageIter
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    it = ImageIter(batch_size, data_shape, label_width, path_imgrec=path_imgrec,
+                   shuffle=shuffle, resize=resize, rand_crop=rand_crop,
+                   rand_mirror=rand_mirror, mean=mean)
+    return PrefetchingIter(_ImageIterAdapter(it, batch_size),
+                           prefetch=prefetch_buffer)
+
+
+class _ImageIterAdapter(DataIter):
+    def __init__(self, it, batch_size):
+        super().__init__(batch_size)
+        self._it = it
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        return next(self._it)
+
+    def __iter__(self):
+        self._it.reset()
+        return self._it

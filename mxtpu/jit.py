@@ -1,0 +1,209 @@
+"""JIT layer — CachedOp (hybridize) and functional transforms.
+
+Capability parity with the reference's two graph-execution engines (SURVEY.md §2.1):
+
+* ``CachedOp`` (src/imperative/cached_op.{h,cc}) — Gluon ``hybridize()``: trace a
+  Python forward once, re-run the compiled graph after. Here the trace IS ``jax.jit``:
+  the imperative NDArray ops run on tracers transparently (they are jnp calls under the
+  hood), so hybridizing is "run forward under jit, cache by input signature".
+  The reference's knobs map as: ``static_alloc``/``static_shape`` → XLA buffer
+  assignment (always on, accepted for API parity); per-shape retraces → the signature
+  cache (the BucketingModule story); ``inline_limit`` → XLA inlining (N/A).
+* ``GraphExecutor``'s passes (gradient, memory planning, device placement) are XLA's
+  job; the *export* capability (symbol JSON + params, block.py:866 ``export``) maps to
+  StableHLO serialization (``export_stablehlo``).
+
+Mutation discipline: a traced forward may mutate state handles (BatchNorm running
+stats). The trace detects which handles were written (their buffer became a tracer)
+and turns them into extra outputs that are written back on every call — the functional
+equivalent of the reference's aux-state arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd, rng
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CachedOp", "jit", "grad", "value_and_grad", "export_stablehlo"]
+
+
+class CachedOp:
+    """Compile an NDArray-level callable; re-trace per input signature.
+
+    ``fn(*args)`` takes NDArrays and may close over parameter/state NDArray handles
+    (passed as ``params`` so tracing can substitute tracers and grads can flow).
+    """
+
+    def __init__(self, fn: Callable, params: Sequence[NDArray] = (),
+                 static_alloc: bool = False, static_shape: bool = False,
+                 donate_params: bool = False):
+        self.fn = fn
+        self.params: List[NDArray] = list(params)
+        self.static_alloc = static_alloc  # API parity; XLA always plans statically
+        self.static_shape = static_shape
+        self._cache: Dict[tuple, dict] = {}
+
+    # -- signature ---------------------------------------------------------
+    def _sig(self, args) -> tuple:
+        return (
+            tuple((a.shape, str(a.dtype)) for a in args),
+            tuple((p.shape, str(p.dtype)) for p in self.params),
+            autograd.is_training(),
+        )
+
+    # -- tracing -----------------------------------------------------------
+    def _build(self, sig, args) -> dict:
+        n_params = len(self.params)
+        param_handles = self.params
+        fn = self.fn
+        mutated_idx: List[int] = []
+        out_struct: dict = {}
+
+        def pure(param_raws, input_raws, key):
+            provider = rng.push_trace_provider(key)
+            saved = [p._data for p in param_handles]
+            try:
+                for p, r in zip(param_handles, param_raws):
+                    p._data = r
+                    p._version += 1
+                arg_handles = [NDArray(r) for r in input_raws]
+                with autograd.pause(train_mode=autograd.is_training()):
+                    result = fn(*arg_handles)
+                single = not isinstance(result, (tuple, list))
+                outs = [result] if single else list(result)
+                out_struct["single"] = single
+                raw_outs = [o.data for o in outs]
+                # state write-back: params whose buffer was swapped during the trace
+                mutated_idx.clear()
+                mutated = []
+                for i, (p, r) in enumerate(zip(param_handles, param_raws)):
+                    if p._data is not r:
+                        mutated_idx.append(i)
+                        mutated.append(p._data)
+                out_struct["n_keys"] = provider.count
+                return tuple(raw_outs), tuple(mutated)
+            finally:
+                for p, s in zip(param_handles, saved):
+                    p._data = s
+                    p._version += 1
+                rng.pop_trace_provider()
+
+        jitted = jax.jit(pure)
+        # prime the trace now so out_struct/mutated_idx are known
+        key0 = rng.next_key()
+        raw_outs, mutated = jitted([p.data for p in self.params],
+                                   [a.data for a in args], key0)
+        entry = {
+            "jitted": jitted,
+            "single": out_struct["single"],
+            "mutated_idx": list(mutated_idx),
+            "first": (raw_outs, mutated, key0),
+        }
+        self._cache[sig] = entry
+        return entry
+
+    def __call__(self, *args: NDArray):
+        args = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
+        sig = self._sig(args)
+        entry = self._cache.get(sig)
+        first = None
+        if entry is None:
+            entry = self._build(sig, args)
+            raw_outs, mutated, key = entry.pop("first")
+            first = True
+        else:
+            key = rng.next_key()
+            raw_outs, mutated = entry["jitted"](
+                [p.data for p in self.params], [a.data for a in args], key)
+
+        outs = [NDArray(r) for r in raw_outs]
+
+        if autograd.is_recording():
+            jitted = entry["jitted"]
+            n_params = len(self.params)
+            fixed_key = key
+
+            def pure_primary(*raws):
+                o, _ = jitted(list(raws[:n_params]), list(raws[n_params:]), fixed_key)
+                return tuple(o) if len(o) > 1 else o[0]
+
+            autograd.record_custom_node(pure_primary, self.params + list(args), outs)
+
+        # state write-back (aux mutation, e.g. BN moving stats)
+        for i, m in zip(entry["mutated_idx"], mutated):
+            self.params[i]._set_data(m)
+
+        if entry["single"]:
+            return outs[0]
+        return tuple(outs)
+
+
+def jit(fn: Callable, static_alloc: bool = False) -> Callable:
+    """Functional convenience: hybridize a free function over NDArrays.
+
+    Parameters are any NDArray leaves in args — no closure state support here; use
+    CachedOp for stateful blocks.
+    """
+    op = CachedOp(fn, params=())
+    return op
+
+
+def _functionalize(fn: Callable):
+    """Wrap an NDArray-level fn as a raw-array fn for jax transforms."""
+
+    def raw_fn(*raws):
+        outs = fn(*[NDArray(r) for r in raws])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o.data for o in outs)
+        return outs.data
+
+    return raw_fn
+
+
+def grad(fn: Callable, argnums=0) -> Callable:
+    """Functional gradient transform over NDArray functions (composable — this is the
+    higher-order escape hatch the imperative tape doesn't cover, jax.grad underneath)."""
+    raw_fn = _functionalize(fn)
+    gfn = jax.grad(raw_fn, argnums=argnums)
+
+    def wrapped(*args):
+        raws = [a.data if isinstance(a, NDArray) else jnp.asarray(a) for a in args]
+        out = gfn(*raws)
+        if isinstance(out, tuple):
+            return tuple(NDArray(o) for o in out)
+        return NDArray(out)
+
+    return wrapped
+
+
+def value_and_grad(fn: Callable, argnums=0) -> Callable:
+    raw_fn = _functionalize(fn)
+    vg = jax.value_and_grad(raw_fn, argnums=argnums)
+
+    def wrapped(*args):
+        raws = [a.data if isinstance(a, NDArray) else jnp.asarray(a) for a in args]
+        v, g = vg(*raws)
+        if isinstance(g, tuple):
+            g = tuple(NDArray(x) for x in g)
+        else:
+            g = NDArray(g)
+        return NDArray(v), g
+
+    return wrapped
+
+
+def export_stablehlo(fn: Callable, example_args: Sequence[NDArray]) -> str:
+    """Serialize a traced computation to StableHLO text.
+
+    Capability parity with symbol-JSON export (``Symbol.tojson`` symbol.py:1218 /
+    ``HybridBlock.export`` block.py:866): a portable, inspectable compiled-graph
+    artifact. StableHLO is the XLA-native exchange format.
+    """
+    raw_fn = _functionalize(fn)
+    lowered = jax.jit(raw_fn).lower(*[a.data for a in example_args])
+    return lowered.as_text()

@@ -1,0 +1,178 @@
+"""KVStore — parity with ``src/kvstore/`` + ``python/mxnet/kvstore.py`` (SURVEY.md §2.3).
+
+The reference's KVStore hierarchy (local CPU-reduce / device P2P-reduce / NCCL /
+ps-lite dist_sync|dist_async) exists because GPUs need explicit reduction and clusters
+need a parameter server. On TPU the same *semantics* (named values, push accumulates a
+reduction, pull reads, optional server-side updater, rank/size/barrier) sit on two
+mechanisms:
+
+* intra-process: handles are single logical arrays; "reduce over devices" degenerates
+  to summing the pushed list (multi-device data-parallelism is expressed with sharded
+  arrays, where XLA inserts the all-reduce — see ``mxtpu.parallel``).
+* inter-process (``dist_sync``): ``jax.distributed`` supplies rank/size, and pushed
+  grads are all-reduced over the pod with an XLA collective (``parallel.collectives``) —
+  replacing ps-lite push/pull (kvstore_dist.h) with ICI/DCN allreduce, per BASELINE's
+  north star. Sync semantics match ``dist_sync`` (every worker sees the same reduced
+  value); ``dist_async`` has no XLA equivalent and raises with guidance.
+
+Types accepted for parity: local | device | tpu | dist | dist_sync | dist_device_sync
+(kvstore.cc:40-76 type strings; nccl → tpu).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray.ndarray import NDArray
+from . import optimizer as opt_mod
+
+__all__ = ["KVStore", "create"]
+
+
+def create(name: str = "local") -> "KVStore":
+    return KVStore(name)
+
+
+class KVStore:
+    def __init__(self, kv_type: str = "local"):
+        kv_type = {"nccl": "tpu", "device": "tpu"}.get(kv_type, kv_type)
+        if kv_type.startswith("dist"):
+            self._distributed = True
+        elif kv_type in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                         "tpu"):
+            self._distributed = False
+        else:
+            raise ValueError(f"unknown kvstore type {kv_type!r}")
+        if "async" in kv_type:
+            raise NotImplementedError(
+                "dist_async: XLA collectives are synchronous; use dist_sync (see "
+                "SURVEY.md §7 hard-parts — async PS would need a host-side service)")
+        self.type = kv_type
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer: Optional[opt_mod.Optimizer] = None
+        self._compression_params: Optional[dict] = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if self._distributed else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count() if self._distributed else 1
+
+    def barrier(self):
+        if self._distributed and jax.process_count() > 1:
+            # a tiny psum over all processes is the canonical XLA barrier
+            from .parallel import collectives
+            collectives.barrier()
+
+    # -- data --------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                self._store[k] = NDArray(jnp.asarray(v.data))
+
+    def push(self, key, value, priority: int = 0):
+        """Accumulate: list-of-values are reduced (Comm::Reduce parity, comm.h:103);
+        in dist mode the reduced grad is all-reduced across workers."""
+        keys, values = self._normalize_push(key, value)
+        for k, vlist in zip(keys, values):
+            red = vlist[0].data
+            for v in vlist[1:]:
+                red = red + v.data
+            if self._distributed and jax.process_count() > 1:
+                from .parallel import collectives
+                red = collectives.allreduce_array(red)
+            if self._compression_params is not None:
+                red = self._compress(k, red)
+            if self._updater is not None:
+                grad = NDArray(red)
+                self._updater(k, grad, self._store[k])
+            else:
+                self._store[k] = NDArray(red)
+
+    def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True):
+        keys, outs = self._normalize_push(key, out)
+        for k, olist in zip(keys, outs):
+            src = self._store[k]
+            for o in olist:
+                o._set_data(src.data.astype(o.dtype).reshape(o.shape))
+
+    def pushpull(self, key, value, out=None, priority: int = 0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority: int = 0, row_ids=None):
+        """Sparse pull (kvstore_dist.h:436): fetch only the requested rows.
+
+        Dense storage underneath (XLA-friendly); the *semantics* — pulling a subset of
+        rows identified by ``row_ids`` — are preserved for Embedding-style workflows.
+        """
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        keys, outs = self._normalize_push(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(outs[0])
+        for k, olist in zip(keys, outs):
+            src = self._store[k]
+            for o, rid in zip(olist, rids):
+                rows = jnp.unique(rid.data.astype(jnp.int32),
+                                  size=min(rid.size, src.shape[0]))
+                gathered = src.data[rows]
+                o._set_data(o.data.at[rows].set(gathered.astype(o.dtype)))
+
+    # -- updater / optimizer ----------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = opt_mod.create(optimizer) if not isinstance(
+            optimizer, opt_mod.Optimizer) else optimizer
+        self._updater = opt_mod.get_updater(self._optimizer)
+
+    def _set_updater(self, updater: Callable):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params: dict):
+        """2-bit gradient compression parity (gradient_compression.h:37): quantize to
+        {-threshold, 0, +threshold} with error-feedback residual before reduction."""
+        if compression_params.get("type", "2bit") != "2bit":
+            raise ValueError("only 2bit compression is supported (reference parity)")
+        self._compression_params = dict(compression_params)
+        self._residuals: Dict[Any, jnp.ndarray] = {}
+
+    def _compress(self, key, grad):
+        thr = float(self._compression_params.get("threshold", 0.5))
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros_like(grad)
+        g = grad + res
+        q = jnp.where(g >= thr, thr, jnp.where(g <= -thr, -thr, 0.0)).astype(g.dtype)
+        self._residuals[key] = g - q
+        return q
+
+    def save_optimizer_states(self, fname: str, dump_optimizer: bool = False):
+        if self._updater is None:
+            raise RuntimeError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname: str):
+        if self._updater is None:
+            raise RuntimeError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- helpers -----------------------------------------------------------
+    def _normalize(self, key, value):
+        if isinstance(key, (list, tuple)):
+            return list(key), list(value)
+        return [key], [value]
+
+    def _normalize_push(self, key, value):
+        if isinstance(key, (list, tuple)):
+            return list(key), [v if isinstance(v, (list, tuple)) else [v]
+                               for v in value]
+        return [key], [value if isinstance(value, (list, tuple)) else [value]]

@@ -1,0 +1,47 @@
+"""Checkpoint helpers — parity with ``python/mxnet/model.py`` save_checkpoint/
+load_checkpoint (:384-414). The symbol-JSON slot stores a block-class descriptor
+(the graph itself is re-traced from code; StableHLO export covers the portable-graph
+capability, jit.export_stablehlo)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol=None, arg_params: Dict = None,
+                    aux_params: Dict = None, remove_amp_cast: bool = True):
+    """``prefix-symbol.json`` + ``prefix-####.params`` layout parity (model.py:384)."""
+    if symbol is not None:
+        with open(f"{prefix}-symbol.json", "w") as f:
+            json.dump({"framework": "mxtpu", "block": type(symbol).__name__,
+                       "repr": repr(symbol)}, f)
+    payload = {}
+    for k, v in (arg_params or {}).items():
+        payload[f"arg:{k}"] = v
+    for k, v in (aux_params or {}).items():
+        payload[f"aux:{k}"] = v
+    nd.save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """Returns (symbol_descriptor|None, arg_params, aux_params) (model.py:414)."""
+    symbol = None
+    sym_file = f"{prefix}-symbol.json"
+    if os.path.exists(sym_file):
+        with open(sym_file) as f:
+            symbol = json.load(f)
+    loaded = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params

@@ -1,0 +1,393 @@
+"""Module API — parity with ``python/mxnet/module/`` (SURVEY.md §2.5: BaseModule.fit
+is the canonical symbolic training loop; Module wraps bind/init_params/init_optimizer;
+BucketingModule shares compiled executors across variable-length buckets).
+
+Re-design: the reference binds a Symbol into a GraphExecutor; here a Module wraps a
+Gluon-style (Hybrid)Block — "bind" allocates/initializes parameters for the declared
+shapes and hybridizes (the XLA compile is the executor). BucketingModule's per-bucket
+executor sharing maps to CachedOp's signature cache: one Block, one weight set, one
+compiled executable per bucket shape — exactly the reference's
+``shared executor`` semantics (bucketing_module.py:36-108) without the bookkeeping.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import autograd
+from . import metric as metric_mod
+from . import ndarray as nd
+from . import optimizer as opt_mod
+from .callback import BatchEndParam
+from .gluon.block import Block
+from .gluon.trainer import Trainer
+from .io import DataBatch, DataIter
+from .ndarray.ndarray import NDArray
+
+
+class BaseModule:
+    """Training-loop surface (base_module.py:64): fit/score/predict/forward/backward."""
+
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # subclass interface ---------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True, **kwargs):
+        raise NotImplementedError
+
+    def init_params(self, initializer=None, **kwargs):
+        raise NotImplementedError
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, **kwargs):
+        raise NotImplementedError
+
+    def forward(self, data_batch: DataBatch, is_train: Optional[bool] = None):
+        raise NotImplementedError
+
+    def backward(self, out_grads=None):
+        raise NotImplementedError
+
+    def update(self):
+        raise NotImplementedError
+
+    def get_outputs(self) -> List[NDArray]:
+        raise NotImplementedError
+
+    def update_metric(self, eval_metric, labels):
+        raise NotImplementedError
+
+    # shared loop ----------------------------------------------------------
+    def forward_backward(self, data_batch: DataBatch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data: DataIter, eval_metric, num_batch=None,
+              batch_end_callback=None, reset=True, epoch=0):
+        assert self.binded and self.params_initialized
+        eval_metric = metric_mod.create(eval_metric)
+        if reset:
+            eval_data.reset()
+        eval_metric.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            if batch_end_callback:
+                for cb in _as_list(batch_end_callback):
+                    cb(BatchEndParam(epoch, nbatch, eval_metric))
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data: DataIter, num_batch=None, reset: bool = True):
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            if batch.pad:
+                outs = [o[:o.shape[0] - batch.pad] for o in outs]
+            outputs.append(outs)
+        if not outputs:
+            return []
+        joined = [nd.concatenate([o[i] for o in outputs], axis=0)
+                  for i in range(len(outputs[0]))]
+        return joined[0] if len(joined) == 1 else joined
+
+    def fit(self, train_data: DataIter, eval_data: Optional[DataIter] = None,
+            eval_metric="acc", epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd", optimizer_params=None,
+            eval_end_callback=None, initializer=None, arg_params=None,
+            aux_params=None, allow_missing=False, force_init=False, begin_epoch=0,
+            num_epoch=None, validation_metric=None, monitor=None):
+        """The canonical train loop (base_module.py:399)."""
+        assert num_epoch is not None, "num_epoch required"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(BatchEndParam(epoch, nbatch, eval_metric))
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, getattr(self, "_symbol", None), arg, aux)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric, epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    """Module over a Block (module.py:40 Module-over-Symbol parity)."""
+
+    def __init__(self, block: Block, data_names: Sequence[str] = ("data",),
+                 label_names: Sequence[str] = ("softmax_label",), logger=logging,
+                 context=None, loss=None):
+        super().__init__(logger)
+        self._block = block
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context
+        from .gluon.loss import SoftmaxCrossEntropyLoss
+        self._loss = loss if loss is not None else SoftmaxCrossEntropyLoss()
+        self._trainer: Optional[Trainer] = None
+        self._outputs: List[NDArray] = []
+        self._loss_val: Optional[NDArray] = None
+        self._batch_size = 0
+
+    @property
+    def symbol(self):
+        return self._block
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._for_training = for_training
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        self._block.initialize(init=initializer, force_reinit=force_init)
+        # run one forward on zeros to complete deferred shapes (all declared inputs)
+        dummies = [nd.zeros(tuple(d.shape)) for d in self._data_shapes]
+        with autograd.predict_mode():
+            self._block(*dummies)
+        if arg_params:
+            for name, p in self._block.collect_params().items():
+                short = name[len(self._block.prefix):] \
+                    if name.startswith(self._block.prefix) else name
+                if short in arg_params:
+                    p.set_data(arg_params[short])
+                elif name in arg_params:
+                    p.set_data(arg_params[name])
+        if aux_params:
+            for name, p in self._block.collect_params().items():
+                short = name[len(self._block.prefix):] \
+                    if name.startswith(self._block.prefix) else name
+                if short in aux_params or name in aux_params:
+                    p.set_data(aux_params.get(short, aux_params.get(name)))
+        self.params_initialized = True
+
+    def get_params(self):
+        arg, aux = {}, {}
+        for name, p in self._block.collect_params().items():
+            short = name[len(self._block.prefix):] \
+                if name.startswith(self._block.prefix) else name
+            if p._data is None:
+                continue
+            (aux if p.grad_req == "null" else arg)[short] = p.data()
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        optimizer_params = dict(optimizer_params or {})
+        if "learning_rate" not in optimizer_params and isinstance(optimizer, str):
+            optimizer_params["learning_rate"] = 0.01
+        self._trainer = Trainer(self._block.collect_params(), optimizer,
+                                optimizer_params, kvstore=kvstore)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch: DataBatch, is_train: Optional[bool] = None):
+        assert self.binded
+        data = list(data_batch.data)
+        label = data_batch.label[0] if data_batch.label else None
+        self._batch_size = data[0].shape[0]
+        is_train = self._for_training if is_train is None else is_train
+        if is_train:
+            with autograd.record():
+                out = self._block(*data)
+                self._outputs = [out] if isinstance(out, NDArray) else list(out)
+                if label is not None:
+                    self._loss_val = self._loss(self._outputs[0], label)
+        else:
+            with autograd.predict_mode():
+                out = self._block(*data)
+            self._outputs = [out] if isinstance(out, NDArray) else list(out)
+            self._loss_val = None
+
+    def backward(self, out_grads=None):
+        if self._loss_val is not None:
+            autograd.backward([self._loss_val])
+
+    def update(self):
+        assert self._trainer is not None, "init_optimizer first"
+        self._trainer.step(self._batch_size)
+
+    def get_outputs(self, merge_multi_context=True) -> List[NDArray]:
+        # classification modules output probabilities (SoftmaxOutput-symbol parity);
+        # other losses pass raw outputs through
+        from .gluon.loss import SoftmaxCrossEntropyLoss
+        if self._outputs and isinstance(self._loss, SoftmaxCrossEntropyLoss):
+            return [self._outputs[0].softmax()] + self._outputs[1:]
+        return list(self._outputs)
+
+    def get_input_grads(self):
+        raise NotImplementedError("inputs_need_grad path not implemented")
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def save_checkpoint(self, prefix: str, epoch: int, save_optimizer_states=False):
+        from .model import save_checkpoint
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, None, arg, aux)
+        if save_optimizer_states and self._trainer is not None:
+            self._trainer.save_states(f"{prefix}-{epoch:04d}.states")
+
+
+class BucketingModule(BaseModule):
+    """Variable-length training (bucketing_module.py:36).
+
+    ``sym_gen(bucket_key) -> (block, data_names, label_names)``; one parameter set is
+    shared across buckets; each bucket shape compiles once in the CachedOp cache.
+    """
+
+    def __init__(self, sym_gen: Callable, default_bucket_key=None, logger=logging,
+                 context=None, loss=None):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._modules: Dict = {}
+        self._context = context
+        self._loss = loss
+        self._curr: Optional[Module] = None
+        self._shared_params = None
+        self._opt_args = None
+
+    def _get_module(self, bucket_key, data_shapes=None, label_shapes=None):
+        if bucket_key not in self._modules:
+            block, data_names, label_names = self._sym_gen(bucket_key)
+            mod = Module(block, data_names, label_names, self.logger,
+                         self._context, self._loss)
+            mod.bind(data_shapes or self._data_shapes,
+                     label_shapes or self._label_shapes, self._for_training)
+            mod.init_params(initializer=self._init)
+            if self._modules:
+                # one weight set across buckets (reference shared-executor
+                # semantics, bucketing_module.py:36): sym_gen must build blocks
+                # over shared Parameters (same block, or params=shared ParameterDict)
+                # — detect violations instead of silently training disjoint weights.
+                first_key, first = next(iter(self._modules.items()))
+                first_ids = set(map(id, first._block.collect_params().values()))
+                new_ids = set(map(id, block.collect_params().values()))
+                if first_ids.isdisjoint(new_ids):
+                    raise ValueError(
+                        f"BucketingModule: bucket {bucket_key!r} shares no "
+                        f"parameters with bucket {first_key!r}; sym_gen must build "
+                        "blocks over shared parameters (reuse one block or pass "
+                        "params=first_block.collect_params())")
+                # share the trainer so optimizer state is per-weight, not per-bucket
+                mod._trainer = first._trainer
+                mod.optimizer_initialized = first.optimizer_initialized
+            elif self._opt_args is not None:
+                mod.init_optimizer(*self._opt_args)
+            self._modules[bucket_key] = mod
+        return self._modules[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True, **kwargs):
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._for_training = for_training
+        self.binded = True
+
+    def init_params(self, initializer=None, **kwargs):
+        self._init = initializer
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, **kwargs):
+        self._opt_args = (kvstore, optimizer, optimizer_params)
+        mods = list(self._modules.values())
+        if mods:
+            mods[0].init_optimizer(kvstore, optimizer, optimizer_params)
+            for m in mods[1:]:  # one trainer across buckets (shared weights)
+                m._trainer = mods[0]._trainer
+                m.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch: DataBatch, is_train=None):
+        key = data_batch.bucket_key if data_batch.bucket_key is not None \
+            else self._default_key
+        self._curr = self._get_module(key, data_batch.provide_data,
+                                      data_batch.provide_label)
+        self._curr.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr.backward(out_grads)
+
+    def update(self):
+        self._curr.update()
+
+    def get_outputs(self):
+        return self._curr.get_outputs()
+
+    def update_metric(self, eval_metric, labels):
+        self._curr.update_metric(eval_metric, labels)
+
+    def get_params(self):
+        return self._curr.get_params() if self._curr else ({}, {})
+
+
+class SequentialModule(BaseModule):
+    """Chain of modules (sequential_module.py parity, minimal)."""
+
+    def __init__(self, logger=logging):
+        super().__init__(logger)
+        self._modules: List[BaseModule] = []
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        return self

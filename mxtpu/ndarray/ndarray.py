@@ -80,6 +80,8 @@ class NDArray:
     def _set_data(self, new_data):
         """The single mutation point (handle swap). Views write through to the
         parent chain, which composes chained-view indices correctly."""
+        if not isinstance(new_data, jax.Array):
+            new_data = jnp.asarray(new_data)
         if self._base is not None:
             self._base._sync()
             self._base._set_data(self._base._data.at[self._index].set(

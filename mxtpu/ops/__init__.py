@@ -13,3 +13,5 @@ from . import linalg  # noqa: F401
 from . import sequence  # noqa: F401
 from . import nn  # noqa: F401
 from . import random  # noqa: F401
+from . import contrib_ops  # noqa: F401
+from . import rnn  # noqa: F401

@@ -1,0 +1,191 @@
+"""Contrib ops — parity targets from ``src/operator/contrib/`` (SURVEY.md §2.2):
+ctc_loss, bilinear resize, adaptive avg pooling, ROIAlign, box ops/NMS, count_sketch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+NS = "contrib"
+NEG = -1e10
+
+
+@register("ctc_loss", namespace=NS, aliases=("CTCLoss",))
+def _ctc_loss(pred, label, pred_lengths, label_lengths):
+    """CTC negative log-likelihood (contrib ctc_loss.cc parity).
+
+    pred: (T, N, C) activations (softmax applied internally, matching the reference);
+    label: (N, L) int labels with blank=0 reserved; lengths: (N,) ints.
+    Standard log-alpha recursion over ``lax.scan`` — static shapes, TPU-friendly
+    (the reference binds warp-ctc / a hand-written DP kernel, ctc_include/).
+    """
+    T, N, C = pred.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(pred, axis=-1)
+    lab = label.astype(jnp.int32)
+    ext = jnp.zeros((N, 2 * L + 1), dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    lab_len = label_lengths.astype(jnp.int32)
+    seq_len = pred_lengths.astype(jnp.int32)
+    ext_len = 2 * lab_len + 1
+    S = 2 * L + 1
+    pos = jnp.arange(S)[None, :]
+
+    emit0 = jnp.take_along_axis(logp[0], ext, axis=1)
+    alpha0 = jnp.where(pos < 2, emit0, NEG)
+
+    def step(alpha, t):
+        emit = jnp.take_along_axis(logp[t], ext, axis=1)  # (N, S)
+        a1 = alpha
+        a2 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=NEG)
+        a3 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=NEG)
+        same = jnp.pad(ext[:, :-2] == ext[:, 2:], ((0, 0), (2, 0)),
+                       constant_values=True)
+        a3 = jnp.where((ext == 0) | same, NEG, a3)
+        m = jnp.maximum(jnp.maximum(a1, a2), a3)
+        new = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m) + jnp.exp(a3 - m)) + emit
+        new = jnp.where(t < seq_len[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    last1 = jnp.take_along_axis(alpha, (ext_len - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(alpha, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0]
+    m = jnp.maximum(last1, last2)
+    return -(m + jnp.log(jnp.exp(last1 - m) + jnp.exp(last2 - m)))
+
+
+@register("BilinearResize2D", namespace=NS, aliases=("bilinear_resize_2d",))
+def _bilinear_resize(data, height: int = 1, width: int = 1):
+    """contrib bilinear_resize.cc — NCHW bilinear interpolation via jax.image."""
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, height, width), method="linear")
+
+
+@register("AdaptiveAvgPooling2D", namespace=NS, aliases=("adaptive_avg_pooling",))
+def _adaptive_avg_pool(data, output_size=(1, 1)):
+    """contrib adaptive_avg_pooling.cc — pool to a fixed output grid."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    n, c, h, w = data.shape
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        return data.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+    return jax.image.resize(data, (n, c, oh, ow), method="linear")
+
+
+@register("ROIAlign", namespace=NS, aliases=("roi_align",))
+def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale: float = 1.0,
+               sample_ratio: int = 2):
+    """contrib roi_align.cc — bilinear-sampled ROI pooling (NCHW, rois [K,5])."""
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+    sr = max(sample_ratio, 1)
+
+    def one_roi(roi):
+        batch = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        # sample sr×sr points per bin, bilinear each
+        iy = jnp.arange(ph)[:, None, None, None]
+        ix = jnp.arange(pw)[None, :, None, None]
+        sy = jnp.arange(sr)[None, None, :, None]
+        sx = jnp.arange(sr)[None, None, None, :]
+        y = y1 + (iy + (sy + 0.5) / sr) * bin_h
+        x = x1 + (ix + (sx + 0.5) / sr) * bin_w
+        y = jnp.clip(y, 0, h - 1)
+        x = jnp.clip(x, 0, w - 1)
+        y0, x0 = jnp.floor(y).astype(jnp.int32), jnp.floor(x).astype(jnp.int32)
+        y1i, x1i = jnp.minimum(y0 + 1, h - 1), jnp.minimum(x0 + 1, w - 1)
+        wy, wx = y - y0, x - x0
+        img = data[batch]  # (C, H, W)
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1i]
+        v10 = img[:, y1i, x0]
+        v11 = img[:, y1i, x1i]
+        val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+               + v10 * wy * (1 - wx) + v11 * wy * wx)
+        return val.mean(axis=(-1, -2))  # average samples → (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("box_iou", namespace=NS)
+def _box_iou(lhs, rhs, format: str = "corner"):
+    """contrib bounding_box.cc box_iou: pairwise IoU, corner format (x1,y1,x2,y2)."""
+    if format == "center":
+        def corner(b):
+            cx, cy, bw, bh = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2], -1)
+        lhs, rhs = corner(lhs), corner(rhs)
+    a = lhs[..., :, None, :]
+    b = rhs[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    inter = jnp.prod(jnp.maximum(br - tl, 0), axis=-1)
+    area_a = jnp.prod(a[..., 2:] - a[..., :2], axis=-1)
+    area_b = jnp.prod(b[..., 2:] - b[..., :2], axis=-1)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("box_nms", namespace=NS, differentiable=False)
+def _box_nms(data, overlap_thresh: float = 0.5, valid_thresh: float = 0.0,
+             topk: int = -1, coord_start: int = 2, score_index: int = 1,
+             id_index: int = -1, force_suppress: bool = False,
+             in_format: str = "corner", out_format: str = "corner"):
+    """contrib bounding_box.cc box_nms — greedy NMS, static-shape (TPU) formulation.
+
+    Suppressed entries get score -1 (reference convention); output order = by score.
+    """
+    boxes = data[..., coord_start:coord_start + 4]
+    scores = data[..., score_index]
+    ids = data[..., id_index] if id_index >= 0 else None
+
+    def nms_one(boxes, scores, ids):
+        n = boxes.shape[0]
+        order = jnp.argsort(-scores)
+        boxes_s = boxes[order]
+        scores_s = scores[order]
+        iou = _box_iou(boxes_s, boxes_s, format=in_format)
+        if ids is not None and not force_suppress:
+            same_cls = ids[order][:, None] == ids[order][None, :]
+            iou = jnp.where(same_cls, iou, 0.0)
+        valid = scores_s > valid_thresh
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(n) > i) & keep[i]
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, n, body, valid)
+        new_scores = jnp.where(keep, scores_s, -1.0)
+        out = data[order] if ids is None else data[order]
+        out = out.at[..., score_index].set(new_scores)
+        return out
+
+    if data.ndim == 2:
+        return nms_one(boxes, scores, ids)
+    return jax.vmap(nms_one)(boxes, scores, ids)
+
+
+@register("count_sketch", namespace=NS)
+def _count_sketch(data, h, s, out_dim: int = 0):
+    """contrib count_sketch.cc — random projection sketch."""
+    idx = h.astype(jnp.int32)
+    signed = data * s
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), dtype=data.dtype)
+    return out.at[..., idx].add(signed)
+
+
+@register("getnnz", namespace=NS, differentiable=False)
+def _getnnz(data, axis=None):
+    return jnp.sum((data != 0).astype(jnp.int32), axis=axis)

@@ -1,0 +1,485 @@
+"""Optimizers — parity with ``python/mxnet/optimizer.py`` (SGD family, Adam family,
+Ada*/RMSProp/Ftrl/FTML/Signum/SGLD/DCASGD, SURVEY.md §2.5) and with the reference's
+*fused update ops* (src/operator/optimizer_op-inl.h): each optimizer's math is one
+jitted XLA kernel with donated buffers, so the weight update is a single fused
+HBM-bandwidth-bound pass — the TPU equivalent of the hand-fused CUDA update kernels.
+
+Design: ``create_state(index, weight)`` returns a tuple of raw jax arrays;
+``update(index, weight, grad, state)`` mutates the NDArray handle in place and returns
+the new state. ``multi_precision`` keeps an fp32 master copy for fp16/bf16 weights
+(optimizer.py SGD multi-precision parity).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import Registry
+from .lr_scheduler import LRScheduler
+from .ndarray.ndarray import NDArray
+
+registry = Registry("optimizer")
+register = registry.register
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    return registry.get(name)(**kwargs)
+
+
+class Optimizer:
+    def __init__(self, learning_rate: float = 0.01, wd: float = 0.0,
+                 rescale_grad: float = 1.0, clip_gradient: Optional[float] = None,
+                 lr_scheduler: Optional[LRScheduler] = None,
+                 multi_precision: bool = False, param_dict: Optional[dict] = None,
+                 begin_num_update: int = 0, **kwargs):
+        self.lr = learning_rate
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[Any, int] = {}
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+        self.param_dict = param_dict or {}
+        self._jitted: Optional[Callable] = None
+
+    # -- reference API ----------------------------------------------------
+    def set_learning_rate(self, lr: float):
+        self.lr = lr
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.base_lr = lr
+
+    @property
+    def learning_rate(self) -> float:
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult: dict):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: dict):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        self._index_update_count[index] = self._index_update_count.get(index, 0) + 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    def _get_lr(self, index) -> float:
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        p = self.param_dict.get(index)
+        if p is not None and getattr(p, "lr_mult", None) is not None:
+            lr *= p.lr_mult
+        return lr * self.lr_mult.get(index, 1.0)
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        p = self.param_dict.get(index)
+        if p is not None and getattr(p, "wd_mult", None) is not None:
+            wd *= p.wd_mult
+        return wd * self.wd_mult.get(index, 1.0)
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight: NDArray) -> Tuple:
+        return ()
+
+    def create_state_multi_precision(self, index, weight: NDArray) -> Tuple:
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master = weight.data.astype(jnp.float32)
+            return (master,) + self.create_state(index, NDArray(master))
+        return self.create_state(index, weight)
+
+    # -- update -----------------------------------------------------------
+    def _kernel(self, weight, grad, lr, wd, t, *state):
+        """Pure update math: returns (new_weight, *new_state). Override."""
+        raise NotImplementedError
+
+    def _preprocess_grad(self, grad, rescale, clip):
+        g = grad * rescale
+        if clip is not None:
+            g = jnp.clip(g, -clip, clip)
+        return g
+
+    def _get_jitted(self, clipped: bool):
+        # rescale/clip are traced arguments (Trainer mutates rescale_grad per step —
+        # a value frozen at trace time would silently mis-scale partial batches);
+        # only clip's presence is a static variant.
+        if self._jitted is None:
+            self._jitted = {}
+        if clipped not in self._jitted:
+            def stepfn(w, g, lr, wd, rescale, clip, t, *st):
+                g = self._preprocess_grad(g.astype(w.dtype), rescale,
+                                          clip if clipped else None)
+                return self._kernel(w, g, lr, wd, t, *st)
+            self._jitted[clipped] = jax.jit(stepfn, donate_argnums=(0,))
+        return self._jitted[clipped]
+
+    def update(self, index, weight: NDArray, grad: NDArray, state: Tuple) -> Tuple:
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clipped = self.clip_gradient is not None
+        jitted = self._get_jitted(clipped)
+        clip = self.clip_gradient if clipped else 0.0
+
+        use_master = (self.multi_precision and state
+                      and isinstance(state, tuple) and len(state) > 0
+                      and weight.dtype in (jnp.float16, jnp.bfloat16))
+        if use_master:
+            master, *rest = state
+            out = jitted(master, grad.data.astype(jnp.float32),
+                         jnp.float32(lr), jnp.float32(wd),
+                         jnp.float32(self.rescale_grad), jnp.float32(clip), t, *rest)
+            new_master, *new_state = out if isinstance(out, tuple) else (out,)
+            weight._set_data(new_master.astype(weight.dtype))
+            return (new_master, *new_state)
+        dt = weight.data.dtype
+        out = jitted(weight.data, grad.data, jnp.asarray(lr, dt),
+                     jnp.asarray(wd, dt), jnp.asarray(self.rescale_grad, dt),
+                     jnp.asarray(clip, dt), t, *state)
+        if isinstance(out, tuple):
+            new_w, *new_state = out
+        else:
+            new_w, new_state = out, []
+        weight._set_data(new_w)
+        return tuple(new_state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        return self.update(index, weight, grad, state)
+
+
+@register(name="sgd")
+class SGD(Optimizer):
+    """SGD w/ momentum + weight decay (optimizer.py:444; fused sgd_mom_update parity)."""
+
+    def __init__(self, momentum: float = 0.0, lazy_update: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return (jnp.zeros(weight.shape, weight.data.dtype),)
+        return ()
+
+    def _kernel(self, w, g, lr, wd, t, *state):
+        g = g + wd * w
+        if self.momentum == 0.0:
+            return w - lr * g
+        (mom,) = state
+        mom = self.momentum * mom - lr * g
+        return w + mom, mom
+
+
+@register(name="nag")
+class NAG(SGD):
+    """Nesterov accelerated SGD (optimizer.py NAG)."""
+
+    def _kernel(self, w, g, lr, wd, t, *state):
+        g = g + wd * w
+        if self.momentum == 0.0:
+            return w - lr * g
+        (mom,) = state
+        mom = self.momentum * mom + g
+        return w - lr * (g + self.momentum * mom), mom
+
+
+@register(name="signum")
+class Signum(Optimizer):
+    """Sign-based SGD w/ momentum (optimizer.py Signum; signsgd_update parity)."""
+
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.9,
+                 wd_lh: float = 0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return (jnp.zeros(weight.shape, weight.data.dtype),)
+        return ()
+
+    def _kernel(self, w, g, lr, wd, t, *state):
+        if self.momentum == 0.0:
+            return w - lr * (jnp.sign(g + wd * w))
+        (mom,) = state
+        mom = self.momentum * mom - (1 - self.momentum) * (g + wd * w)
+        return (1 - lr * self.wd_lh) * w + lr * jnp.sign(mom), mom
+
+
+@register(name="sgld")
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        from . import rng
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.data, self.rescale_grad,
+                                  self.clip_gradient) + wd * weight.data
+        noise = jnp.sqrt(lr) * jax.random.normal(rng.next_key(), weight.shape,
+                                                 weight.data.dtype)
+        weight._set_data(weight.data - lr / 2 * g + noise)
+        return state
+
+
+@register(name="dcasgd")
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (optimizer.py DCASGD)."""
+
+    def __init__(self, momentum: float = 0.0, lamda: float = 0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.data.dtype),
+                jnp.array(weight.data))  # (mom, previous_weight)
+
+    def _kernel(self, w, g, lr, wd, t, mom, prev_w):
+        g = g + wd * w
+        comp = g + self.lamda * g * g * (w - prev_w)
+        mom = self.momentum * mom - lr * comp
+        new_w = w + mom
+        return new_w, mom, new_w
+
+
+@register(name="adam")
+class Adam(Optimizer):
+    """Adam (optimizer.py:1069; fused adam_update parity)."""
+
+    def __init__(self, learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (z, z)
+
+    def _kernel(self, w, g, lr, wd, t, m, v):
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        coef = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        return w - coef * m / (jnp.sqrt(v) + self.epsilon), m, v
+
+
+@register(name="adamax")
+class Adamax(Adam):
+    def __init__(self, learning_rate: float = 0.002, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def _kernel(self, w, g, lr, wd, t, m, u):
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        return w - lr / (1 - self.beta1 ** t) * m / (u + self.epsilon), m, u
+
+
+@register(name="nadam")
+class Nadam(Adam):
+    def __init__(self, learning_rate: float = 0.001, schedule_decay: float = 0.004,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.schedule_decay = schedule_decay
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        # momentum schedule Π mom_i is carried in state (the kernel is jitted, so a
+        # Python-side accumulator would freeze at trace time)
+        return (z, z, jnp.ones((), weight.data.dtype))
+
+    def _kernel(self, w, g, lr, wd, t, m, v, m_sched_prev):
+        g = g + wd * w
+        mom_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mom_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        m_sched = m_sched_prev * mom_t
+        m_sched_next = m_sched * mom_t1
+        gp = g / (1 - m_sched)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mp = m / (1 - m_sched_next)
+        vp = v / (1 - self.beta2 ** t)
+        m_bar = (1 - mom_t) * gp + mom_t1 * mp
+        return w - lr * m_bar / (jnp.sqrt(vp) + self.epsilon), m, v, m_sched
+
+
+@register(name="adagrad")
+class AdaGrad(Optimizer):
+    def __init__(self, eps: float = 1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.data.dtype),)
+
+    def _kernel(self, w, g, lr, wd, t, hist):
+        g = g + wd * w
+        hist = hist + g * g
+        return w - lr * g / (jnp.sqrt(hist) + self.float_stable_eps), hist
+
+
+@register(name="adadelta")
+class AdaDelta(Optimizer):
+    def __init__(self, rho: float = 0.9, epsilon: float = 1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (z, z)
+
+    def _kernel(self, w, g, lr, wd, t, acc_g, acc_d):
+        g = g + wd * w
+        acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_d + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * delta * delta
+        return w - delta, acc_g, acc_d
+
+
+@register(name="rmsprop")
+class RMSProp(Optimizer):
+    """RMSProp, centered variant included (optimizer.py RMSProp)."""
+
+    def __init__(self, learning_rate: float = 0.001, gamma1: float = 0.9,
+                 gamma2: float = 0.9, epsilon: float = 1e-8, centered: bool = False,
+                 clip_weights: Optional[float] = None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon, self.centered, self.clip_weights = epsilon, centered, clip_weights
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (z, z, z) if self.centered else (z,)
+
+    def _kernel(self, w, g, lr, wd, t, *state):
+        g = g + wd * w
+        if not self.centered:
+            (n,) = state
+            n = (1 - self.gamma1) * g * g + self.gamma1 * n
+            new_w = w - lr * g / jnp.sqrt(n + self.epsilon)
+            out_state = (n,)
+        else:
+            n, mean_g, delta = state
+            n = (1 - self.gamma1) * g * g + self.gamma1 * n
+            mean_g = (1 - self.gamma1) * g + self.gamma1 * mean_g
+            delta = self.gamma2 * delta - lr * g / jnp.sqrt(
+                n - mean_g * mean_g + self.epsilon)
+            new_w = w + delta
+            out_state = (n, mean_g, delta)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        return (new_w,) + out_state
+
+
+@register(name="ftrl")
+class Ftrl(Optimizer):
+    def __init__(self, lamda1: float = 0.01, learning_rate: float = 0.1,
+                 beta: float = 1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (z, z)  # (z_acc, n_acc)
+
+    def _kernel(self, w, g, lr, wd, t, z, n):
+        g = g + wd * w
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + g * g
+        new_w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) / ((self.beta + jnp.sqrt(n)) / lr + wd),
+            0.0).astype(w.dtype)
+        return new_w, z, n
+
+
+@register(name="ftml")
+class FTML(Optimizer):
+    def __init__(self, learning_rate: float = 0.0025, beta1: float = 0.6,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (z, z, z)  # (d, v, z)
+
+    def _kernel(self, w, g, lr, wd, t, d, v, z):
+        g = g + wd * w
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * w
+        return -z / d_t, d_t, v, z
+
+
+@register(name="lbsgd")
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise adaptive rate (optimizer.py LBSGD)."""
+
+    def __init__(self, warmup_strategy: str = "linear", warmup_epochs: int = 5,
+                 batch_scale: float = 1.0, updates_per_epoch: int = 32, **kwargs):
+        super().__init__(**kwargs)
+        self.warmup_strategy = warmup_strategy
+
+    def _kernel(self, w, g, lr, wd, t, *state):
+        wnorm = jnp.sqrt(jnp.sum(w * w))
+        gnorm = jnp.sqrt(jnp.sum(g * g))
+        phi = jnp.where((wnorm > 0) & (gnorm > 0),
+                        wnorm / (gnorm + wd * wnorm + 1e-12), 1.0)
+        return super()._kernel(w, g, lr * jnp.minimum(phi, 10.0), wd, t, *state)
+
+
+@register(name="test", aliases=("sgd_test",))
+class Test(Optimizer):
+    """Plain SGD without extras — the reference's Test optimizer for unit tests."""
+
+    def create_state(self, index, weight):
+        return ()
+
+    def _kernel(self, w, g, lr, wd, t):
+        return w - lr * (g + wd * w)
+
+
+# ---------------------------------------------------------------------------
+# Updater — kvstore server-side application (optimizer.py Updater/get_updater)
+# ---------------------------------------------------------------------------
+
+
+class Updater:
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Tuple] = {}
+
+    def __call__(self, index, grad: NDArray, weight: NDArray):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.states[index] = self.optimizer.update(index, weight, grad,
+                                                  self.states[index])
+
+    def get_states(self):
+        import pickle
+        return pickle.dumps({k: [jax.device_get(s) for s in v]
+                             for k, v in self.states.items()})
+
+    def set_states(self, blob):
+        import pickle
+        raw = pickle.loads(blob)
+        self.states = {k: tuple(jnp.asarray(s) for s in v) for k, v in raw.items()}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,16 @@
+"""TPU-first parallelism: meshes, collectives, sharded data-parallel training.
+
+This package is the re-imagining of the reference's distributed stack (SURVEY.md §2.3):
+Comm/NCCL/ps-lite → XLA collectives over ICI/DCN; DataParallelExecutorGroup → sharded
+SPMD steps; ``ctx_group`` model parallelism → pjit shardings. Long-context sequence
+parallelism (ring attention) lives in ``ring_attention``.
+"""
+
+from . import collectives
+from . import mesh
+from .collectives import (all_gather, all_to_all, allgather_array, allreduce,
+                          allreduce_array, barrier, broadcast_array, pmean, ppermute,
+                          psum, reduce_scatter, reduce_scatter_array)
+from .data_parallel import DataParallelTrainer, replicate, shard_batch
+from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, get_default_mesh,
+                   make_mesh, set_default_mesh)

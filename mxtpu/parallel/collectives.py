@@ -1,0 +1,121 @@
+"""Collectives — the communication backbone (SURVEY.md §5 "distributed communication
+backend"): one layer exposing allreduce/allgather/reducescatter/broadcast/barrier as
+XLA collectives over a Mesh, replacing the reference's Comm tree / NCCL / ps-lite
+stack (src/kvstore/comm.h, kvstore_nccl.h, kvstore_dist.h).
+
+Two API levels:
+
+* **array level** (used by KVStore dist mode): ``allreduce_array`` etc. operate on a
+  replicated/sharded ``jax.Array`` and run a tiny pjit'd program whose collective XLA
+  lowers onto ICI (in-slice) or DCN (cross-slice) automatically.
+* **in-program level** (used inside shard_map'd training steps): ``psum``/
+  ``all_gather``/``reduce_scatter``/``ppermute`` re-exports with the mesh axis name —
+  these are what a sharded train step calls so XLA can overlap them with compute
+  (the reference's push/pull priority-overlap trick, model.py:141-153, becomes XLA
+  latency hiding).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import Mesh, get_default_mesh
+
+__all__ = ["allreduce", "allreduce_array", "allgather_array", "broadcast_array",
+           "reduce_scatter_array", "barrier", "psum", "pmean", "all_gather",
+           "reduce_scatter", "ppermute", "all_to_all"]
+
+# -- in-program collectives (use inside shard_map/pjit bodies) --------------
+psum = lax.psum
+pmean = lax.pmean
+all_gather = lax.all_gather
+ppermute = lax.ppermute
+all_to_all = lax.all_to_all
+
+
+def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0, tiled: bool = True):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
+                            tiled=tiled)
+
+
+# -- array-level collectives ------------------------------------------------
+
+def _shard_map_1d(fn, mesh: Mesh, in_spec, out_spec):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec)
+
+
+def allreduce_array(x, mesh: Optional[Mesh] = None, op: str = "sum"):
+    """All-reduce a (replicated or dp-sharded) array over the mesh's first axis.
+
+    For a fully-replicated single-process array this is the identity for 'sum' over
+    ranks=1; in multi-process (jax.distributed) it reduces across processes.
+    """
+    mesh = mesh or get_default_mesh()
+    axis = mesh.axis_names[0]
+    if mesh.devices.size == 1:
+        return jnp.asarray(x)
+
+    def _psum(v):
+        r = lax.psum(v, axis)
+        return r / mesh.shape[axis] if op == "mean" else r
+
+    fn = jax.shard_map(_psum, mesh=mesh, in_specs=P(), out_specs=P(),
+                       check_vma=False)
+    return fn(jnp.asarray(x))
+
+
+allreduce = allreduce_array
+
+
+def allgather_array(x, mesh: Optional[Mesh] = None, axis: int = 0):
+    """Gather dp-sharded rows into the full array on every device."""
+    mesh = mesh or get_default_mesh()
+    ax_name = mesh.axis_names[0]
+    if mesh.devices.size == 1:
+        return jnp.asarray(x)
+    spec = [None] * jnp.ndim(x)
+    spec[axis] = ax_name
+
+    def _ag(v):
+        return lax.all_gather(v, ax_name, axis=axis, tiled=True)
+
+    fn = jax.shard_map(_ag, mesh=mesh, in_specs=P(*spec), out_specs=P(),
+                       check_vma=False)
+    return fn(jnp.asarray(x))
+
+
+def reduce_scatter_array(x, mesh: Optional[Mesh] = None, axis: int = 0):
+    mesh = mesh or get_default_mesh()
+    ax_name = mesh.axis_names[0]
+    if mesh.devices.size == 1:
+        return jnp.asarray(x)
+    spec = [None] * jnp.ndim(x)
+    spec[axis] = ax_name
+
+    def _rs(v):
+        return lax.psum_scatter(v, ax_name, scatter_dimension=axis, tiled=True)
+
+    fn = jax.shard_map(_rs, mesh=mesh, in_specs=P(), out_specs=P(*spec),
+                       check_vma=False)
+    return fn(jnp.asarray(x))
+
+
+def broadcast_array(x, mesh: Optional[Mesh] = None, root: int = 0):
+    """Broadcast root's value to all devices (device_put with replicated sharding)."""
+    mesh = mesh or get_default_mesh()
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P()))
+
+
+def barrier(mesh: Optional[Mesh] = None):
+    """Block until all devices/processes reach this point (ps::Postoffice barrier
+    parity): a 1-element psum everyone must contribute to."""
+    mesh = mesh or get_default_mesh()
+    out = allreduce_array(jnp.ones(()), mesh)
+    jax.block_until_ready(out)
+    return float(out)

@@ -1,0 +1,160 @@
+"""Data-parallel training over a mesh — the TPU-native replacement for the reference's
+``DataParallelExecutorGroup`` + KVStore reduce (SURVEY.md §2.3 row "DP, single
+machine"): instead of splitting a batch into per-GPU executors and reducing grads
+through a Comm tree, the batch is **sharded** over the ``dp`` mesh axis and one jitted
+step runs SPMD — XLA inserts the gradient all-reduce over ICI and overlaps it with
+backward compute (the reference's priority-overlap trick, for free).
+
+``DataParallelTrainer`` wraps a Gluon block + optimizer into such a step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import autograd
+from .. import ndarray as nd_mod
+from ..ndarray.ndarray import NDArray
+from .mesh import Mesh, get_default_mesh
+
+__all__ = ["shard_batch", "replicate", "DataParallelTrainer"]
+
+
+def shard_batch(array, mesh: Optional[Mesh] = None, axis: int = 0) -> NDArray:
+    """Place a host batch as a dp-sharded jax.Array (≈ decide_slices/_split_input_slice,
+    executor_group.py:281-310 — but one logical array, no per-device copies)."""
+    mesh = mesh or get_default_mesh()
+    spec = [None] * (array.ndim if hasattr(array, "ndim") else len(array.shape))
+    spec[axis] = mesh.axis_names[0]
+    raw = array.data if isinstance(array, NDArray) else jnp.asarray(array)
+    return NDArray(jax.device_put(raw, NamedSharding(mesh, P(*spec))))
+
+
+def replicate(array, mesh: Optional[Mesh] = None) -> NDArray:
+    mesh = mesh or get_default_mesh()
+    raw = array.data if isinstance(array, NDArray) else jnp.asarray(array)
+    return NDArray(jax.device_put(raw, NamedSharding(mesh, P())))
+
+
+class DataParallelTrainer:
+    """Sharded training step: params replicated, batch dp-sharded, grads psum'd.
+
+    Usage::
+
+        dpt = DataParallelTrainer(net, loss_fn, optimizer, mesh)
+        loss = dpt.step(x_batch, y_batch)   # one jitted SPMD step
+
+    The whole fwd+bwd+update is ONE XLA program: gradient all-reduce rides ICI and
+    overlaps backward; optimizer update is fused in (donated buffers).
+    """
+
+    def __init__(self, block, loss_fn, optimizer, mesh: Optional[Mesh] = None):
+        self.block = block
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh or get_default_mesh()
+        self._step_fn = None
+        self._params: List = []
+        self._states: List = []
+
+    def _collect(self, x_example):
+        # ensure deferred params materialize
+        with autograd.predict_mode():
+            self.block(x_example)
+        self._param_handles = [p for p in self.block.collect_params().values()
+                               if p._data is not None and p.grad_req != "null"]
+        self._aux_handles = [p for p in self.block.collect_params().values()
+                             if p._data is not None and p.grad_req == "null"]
+        # replicate across the mesh
+        for p in self._param_handles + self._aux_handles:
+            p._data._set_data(jax.device_put(p.data().data,
+                                             NamedSharding(self.mesh, P())))
+        self._states = [self.optimizer.create_state(i, p.data())
+                        for i, p in enumerate(self._param_handles)]
+        self._states = [tuple(jax.device_put(s, NamedSharding(self.mesh, P()))
+                              for s in st) for st in self._states]
+
+    def _build(self):
+        block, loss_fn, opt = self.block, self.loss_fn, self.optimizer
+        param_handles = self._param_handles
+        aux_handles = self._aux_handles
+        from .. import rng as rng_mod
+
+        def step(params, auxs, states, x, y, lr, key, t):
+            provider = rng_mod.push_trace_provider(key)
+            saved = [p._data._data for p in param_handles]
+            saved_aux = [p._data._data for p in aux_handles]
+            try:
+                def loss_of(ps):
+                    for p, v in zip(param_handles, ps):
+                        p._data._data = v
+                        p._data._version += 1
+                    for p, v in zip(aux_handles, auxs):
+                        p._data._data = v
+                        p._data._version += 1
+                    with autograd.pause(train_mode=True):
+                        out = block(nd_mod.NDArray(x))
+                        loss = loss_fn(out, nd_mod.NDArray(y))
+                    new_auxs = [p._data._data for p in aux_handles]
+                    return jnp.mean(loss.data), new_auxs
+
+                (loss_val, new_auxs), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(list(params))
+                new_params, new_states = [], []
+                for i, (p, g, st) in enumerate(zip(params, grads, states)):
+                    g = g.astype(p.dtype)
+                    out = opt._kernel(p, g, lr.astype(p.dtype), jnp.asarray(
+                        opt.wd, p.dtype), t, *st)
+                    if isinstance(out, tuple):
+                        new_params.append(out[0])
+                        new_states.append(tuple(out[1:]))
+                    else:
+                        new_params.append(out)
+                        new_states.append(())
+                return new_params, new_auxs, new_states, loss_val
+            finally:
+                for p, v in zip(param_handles, saved):
+                    p._data._data = v
+                for p, v in zip(aux_handles, saved_aux):
+                    p._data._data = v
+                rng_mod.pop_trace_provider()
+
+        repl = NamedSharding(self.mesh, P())
+        batch = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        # NB: no donation — optimizer states may alias the same zero buffer (e.g.
+        # Adam's (m, v)) and XLA rejects donating one buffer twice; buffers are
+        # reclaimed by refcount anyway since the handles are swapped after the call.
+        self._step_fn = jax.jit(
+            step,
+            in_shardings=(repl, repl, repl, batch, batch, repl, repl, None),
+            out_shardings=(repl, repl, repl, repl))
+
+    def step(self, x, y) -> float:
+        x = x if isinstance(x, NDArray) else nd_mod.array(x)
+        y = y if isinstance(y, NDArray) else nd_mod.array(y)
+        if self._step_fn is None:
+            self._collect(x)
+            self._build()
+            self._t = 0
+        xs = shard_batch(x, self.mesh).data
+        ys = shard_batch(y, self.mesh).data
+        self._t += 1
+        lr = jnp.asarray(self.optimizer.learning_rate, jnp.float32)
+        key = jax.random.key(self._t)
+        params = [p.data().data for p in self._param_handles]
+        auxs = [p.data().data for p in self._aux_handles]
+        new_params, new_auxs, new_states, loss = self._step_fn(
+            params, auxs, self._states, xs, ys, lr, key, self._t)
+        for p, v in zip(self._param_handles, new_params):
+            p._data._data = v
+            p._data._version += 1
+        for p, v in zip(self._aux_handles, new_auxs):
+            p._data._data = v
+            p._data._version += 1
+        self._states = new_states
+        self.optimizer.num_update = self._t
+        return float(loss)

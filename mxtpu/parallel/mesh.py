@@ -1,0 +1,51 @@
+"""Device meshes — the TPU-native device model (SURVEY.md §2.3 TPU-equivalents).
+
+The reference enumerates GPUs into flat context lists; here parallelism is a named-axis
+mesh (``jax.sharding.Mesh``) over which pjit shardings and shard_map collectives are
+expressed. Standard axis names: ``dp`` (data), ``tp`` (tensor), ``pp`` (pipeline),
+``sp`` (sequence/context). ICI topology is honored by device order (jax returns
+devices in torus order, so contiguous mesh axes ride ICI neighbors).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "data_parallel_mesh",
+           "get_default_mesh", "set_default_mesh"]
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(shape: Sequence[int] = None, axis_names: Sequence[str] = ("dp",),
+              devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (devices.size,)
+    need = int(np.prod(shape))
+    if need > devices.size:
+        raise ValueError(f"mesh {tuple(shape)} needs {need} devices, have {devices.size}")
+    return Mesh(devices[:need].reshape(tuple(shape)), tuple(axis_names))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = num_devices or len(devs)
+    return make_mesh((n,), ("dp",), devs[:n])
+
+
+def get_default_mesh() -> Mesh:
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = data_parallel_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Optional[Mesh]):
+    global _default_mesh
+    _default_mesh = mesh

@@ -1,0 +1,118 @@
+"""Test utilities — parity with ``python/mxnet/test_utils.py`` (the workhorse of the
+reference's operator tests, SURVEY.md §4): assert_almost_equal w/ per-dtype tolerances,
+check_numeric_gradient, check_consistency (CPU-vs-accelerator), rand_ndarray."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import autograd
+from . import ndarray as nd
+from .context import cpu, current_context
+from .ndarray.ndarray import NDArray
+
+_DTYPE_TOL = {
+    np.dtype(np.float16): (1e-2, 1e-2),
+    np.dtype(np.float32): (1e-4, 1e-5),
+    np.dtype(np.float64): (1e-6, 1e-8),
+}
+
+
+def default_rtol_atol(dtype) -> tuple:
+    return _DTYPE_TOL.get(np.dtype(dtype), (1e-4, 1e-5))
+
+
+def assert_almost_equal(a, b, rtol: Optional[float] = None,
+                        atol: Optional[float] = None, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    if rtol is None or atol is None:
+        r, t = default_rtol_atol(a.dtype)
+        rtol = rtol if rtol is not None else r
+        atol = atol if atol is not None else t
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} vs {names[1]}")
+
+
+def almost_equal(a, b, rtol=None, atol=None) -> bool:
+    try:
+        assert_almost_equal(a, b, rtol, atol)
+        return True
+    except AssertionError:
+        return False
+
+
+def rand_ndarray(shape, dtype="float32", scale: float = 1.0) -> NDArray:
+    return nd.array((np.random.randn(*shape) * scale).astype(dtype))
+
+
+def rand_shape_nd(ndim: int, dim: int = 10) -> tuple:
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3):
+    """Finite-difference vs autograd gradients (test_utils.py:check_numeric_gradient).
+
+    ``fn(*inputs) -> scalar NDArray``. All inputs must be float32+.
+    """
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+    out.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for xi, x in enumerate(inputs):
+        arr = x.asnumpy().astype(np.float64)
+        numeric = np.zeros_like(arr)
+        flat = arr.ravel()
+        num_flat = numeric.ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            x._set_data(np.asarray(arr, np.float32).reshape(x.shape))
+            f_plus = float(fn(*inputs).asscalar())
+            flat[i] = orig - eps
+            x._set_data(np.asarray(arr, np.float32).reshape(x.shape))
+            f_minus = float(fn(*inputs).asscalar())
+            flat[i] = orig
+            x._set_data(np.asarray(arr, np.float32).reshape(x.shape))
+            num_flat[i] = (f_plus - f_minus) / (2 * eps)
+        np.testing.assert_allclose(analytic[xi], numeric, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch on input {xi}")
+
+
+def check_consistency(fn: Callable, inputs: Sequence[np.ndarray],
+                      ctx_list=None, rtol: float = 1e-3, atol: float = 1e-4):
+    """Run fn on each context and compare outputs (CPU is the oracle — the
+    reference's GPU-vs-CPU check, test_utils.py:check_consistency)."""
+    from .context import Context
+    ctx_list = ctx_list or [cpu(0), current_context()]
+    results = []
+    for ctx in ctx_list:
+        args = [nd.array(a, ctx=ctx) for a in inputs]
+        out = fn(*args)
+        results.append(out.asnumpy())
+    for r in results[1:]:
+        np.testing.assert_allclose(results[0], r, rtol=rtol, atol=atol)
+
+
+def same(a, b) -> bool:
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class DummyIter:
+    """Infinite synthetic-batch iterator (test_utils simple_forward helpers)."""
+
+    def __init__(self, batch):
+        self.batch = batch
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.batch

@@ -1,0 +1,68 @@
+"""Visualization — parity with ``python/mxnet/visualization.py`` (print_summary,
+plot_network). ``plot_network`` renders block trees (graphviz if available, text
+otherwise); detailed op graphs live in StableHLO dumps (jit.export_stablehlo)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .gluon.block import Block
+
+
+def print_summary(block: Block, shape=None, line_length: int = 72):
+    """Parameter-count table per sub-block (visualization.py print_summary parity)."""
+    rows = []
+    total = 0
+
+    def visit(b: Block, depth: int):
+        nonlocal total
+        own = 0
+        for name, p in b.params.items():
+            if p.shape and all(s > 0 for s in p.shape):
+                n = 1
+                for s in p.shape:
+                    n *= s
+                own += n
+        total += own
+        rows.append(("  " * depth + type(b).__name__, b.name, own))
+        for child in b._children.values():
+            visit(child, depth + 1)
+
+    visit(block, 0)
+    print("=" * line_length)
+    print(f"{'Layer':<40}{'Name':<20}{'Params':>10}")
+    print("=" * line_length)
+    for layer, name, n in rows:
+        print(f"{layer:<40}{name:<20}{n:>10}")
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    return total
+
+
+def plot_network(block: Block, title: str = "plot", save_format: str = "pdf",
+                 shape=None, **kwargs):
+    try:
+        import graphviz
+    except ImportError:
+        # text fallback
+        lines = []
+
+        def visit(b, depth):
+            lines.append("  " * depth + f"{type(b).__name__}({b.name})")
+            for c in b._children.values():
+                visit(c, depth + 1)
+
+        visit(block, 0)
+        return "\n".join(lines)
+    dot = graphviz.Digraph(name=title)
+
+    def visit2(b, parent=None):
+        nid = b.name or str(id(b))
+        dot.node(nid, f"{type(b).__name__}\n{b.name}")
+        if parent:
+            dot.edge(parent, nid)
+        for c in b._children.values():
+            visit2(c, nid)
+
+    visit2(block)
+    return dot

@@ -1,0 +1,301 @@
+"""Gluon tests — modeled on tests/python/unittest/test_gluon.py."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.gluon import nn
+
+
+def test_dense_forward_shapes():
+    layer = nn.Dense(8, in_units=4)
+    layer.initialize()
+    out = layer(nd.ones((2, 4)))
+    assert out.shape == (2, 8)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(8)
+    layer.initialize()
+    out = layer(nd.ones((2, 5)))
+    assert out.shape == (2, 8)
+    assert layer.weight.shape == (8, 5)
+
+
+def test_parameter_sharing():
+    d1 = nn.Dense(4, in_units=4, prefix="shared_")
+    d1.initialize()
+    d2 = nn.Dense(4, in_units=4, prefix="shared_", params=d1.collect_params())
+    x = nd.ones((1, 4))
+    np.testing.assert_allclose(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    out = net(nd.ones((3, 10)))
+    assert out.shape == (3, 8)
+    params = net.collect_params()
+    assert len(params) == 4  # 2 weights + 2 biases
+
+
+def test_hybridize_matches_imperative():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh"), nn.Dense(4))
+    net.initialize()
+    x = nd.random.normal(shape=(5, 10))
+    ref = net(x).asnumpy()
+    net.hybridize()
+    out1 = net(x).asnumpy()
+    out2 = net(x).asnumpy()  # cached path
+    np.testing.assert_allclose(ref, out1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ref, out2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_shape_bucketing():
+    net = nn.Dense(4, in_units=6)
+    net.initialize()
+    net.hybridize()
+    assert net(nd.ones((2, 6))).shape == (2, 4)
+    assert net(nd.ones((7, 6))).shape == (7, 4)  # new signature triggers retrace
+    assert len(net._cached_op._cache) == 2
+
+
+def test_hybridize_dropout_varies_across_calls():
+    net = nn.Dropout(0.5)
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((64, 64))
+    with autograd.record():
+        a = net(x).asnumpy()
+        b = net(x).asnumpy()
+    assert (a == 0).any() and (b == 0).any()
+    assert not np.allclose(a, b)  # fresh key per call through trace provider
+
+
+def test_batchnorm_updates_running_stats():
+    net = nn.BatchNorm(in_channels=3, momentum=0.5)
+    net.initialize()
+    x = nd.array(np.random.rand(8, 3, 4, 4).astype(np.float32) * 5 + 2)
+    before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = net.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+    # inference uses running stats (no further update)
+    net(x)
+    np.testing.assert_allclose(net.running_mean.data().asnumpy(), after)
+
+
+def test_batchnorm_stats_update_under_hybridize():
+    net = nn.BatchNorm(in_channels=2, momentum=0.5)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.rand(4, 2, 3, 3).astype(np.float32) + 3)
+    with autograd.record():
+        net(x)
+    m1 = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    m2 = net.running_mean.data().asnumpy()
+    assert not np.allclose(m1, m2), "mutation write-back through CachedOp failed"
+    assert (m2 > m1 - 1e-6).all()  # moving toward batch mean (positive data)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.initializer.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        y = net(x)
+        loss = nd.sum(y)
+    loss.backward()
+    trainer.step(batch_size=1)
+    # w <- w - 0.5 * x
+    np.testing.assert_allclose(net.weight.data().asnumpy(), [[0.5, 0.0]], rtol=1e-6)
+
+
+def test_gluon_training_convergence():
+    """End-to-end: train a small MLP on a linearly separable problem."""
+    mx.random.seed(7)
+    rs = np.random.RandomState(7)
+    X = rs.randn(256, 8).astype(np.float32)
+    w_true = rs.randn(8, 1).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32).ravel()
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    data, label = nd.array(X), nd.array(y)
+    for _ in range(60):
+        with autograd.record():
+            out = net(data)
+            loss = loss_fn(out, label)
+        loss.backward()
+        trainer.step(256)
+    pred = net(data).argmax(axis=1).asnumpy()
+    acc = (pred == y).mean()
+    assert acc > 0.95, f"accuracy {acc}"
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    f = str(tmp_path / "dense.params")
+    net.save_parameters(f)
+    net2 = nn.Dense(4, in_units=3)
+    net2.load_parameters(f)
+    x = nd.ones((2, 3))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_losses_vs_torch():
+    import torch
+    import torch.nn.functional as tF
+    pred = np.random.randn(6, 5).astype(np.float32)
+    label = np.random.randint(0, 5, (6,)).astype(np.float32)
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(nd.array(pred), nd.array(label))
+    ref = tF.cross_entropy(torch.from_numpy(pred),
+                           torch.from_numpy(label.astype(np.int64)),
+                           reduction="none").numpy()
+    np.testing.assert_allclose(l.asnumpy(), ref, rtol=1e-5)
+
+    p2 = np.random.randn(4, 3).astype(np.float32)
+    t2 = np.random.rand(4, 3).astype(np.float32)
+    l2 = gluon.loss.L2Loss()(nd.array(p2), nd.array(t2))
+    ref2 = 0.5 * ((p2 - t2) ** 2).mean(axis=1)
+    np.testing.assert_allclose(l2.asnumpy(), ref2, rtol=1e-5)
+
+    lbce = gluon.loss.SigmoidBinaryCrossEntropyLoss()(nd.array(p2), nd.array(t2))
+    refbce = tF.binary_cross_entropy_with_logits(
+        torch.from_numpy(p2), torch.from_numpy(t2), reduction="none").numpy().mean(1)
+    np.testing.assert_allclose(lbce.asnumpy(), refbce, rtol=1e-4)
+
+    lh = gluon.loss.HuberLoss()(nd.array(p2), nd.array(t2))
+    refh = tF.smooth_l1_loss(torch.from_numpy(p2), torch.from_numpy(t2),
+                             reduction="none").numpy().mean(1)
+    np.testing.assert_allclose(lh.asnumpy(), refh, rtol=1e-5)
+
+
+def test_ctc_loss_vs_torch():
+    import torch
+    import torch.nn.functional as tF
+    T, N, C, L = 10, 3, 6, 4
+    rs = np.random.RandomState(0)
+    logits = rs.randn(N, T, C).astype(np.float32)
+    labels = rs.randint(1, C, (N, L)).astype(np.float32)
+    lab_len = np.array([4, 3, 2], np.int32)
+    pred_len = np.array([10, 10, 8], np.int32)
+    labels_masked = labels.copy()
+    for i, ll in enumerate(lab_len):
+        labels_masked[i, ll:] = 0
+    loss = gluon.loss.CTCLoss(layout="NTC")(
+        nd.array(logits), nd.array(labels_masked),
+        pred_lengths=nd.array(pred_len, dtype="int32"),
+        label_lengths=nd.array(lab_len, dtype="int32"))
+    ref = tF.ctc_loss(
+        torch.from_numpy(logits.transpose(1, 0, 2)).log_softmax(-1),
+        torch.from_numpy(labels_masked.astype(np.int64)),
+        torch.from_numpy(pred_len.astype(np.int64)),
+        torch.from_numpy(lab_len.astype(np.int64)),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(loss.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_rnn_layers_run():
+    for cls, mode in [(gluon.rnn.LSTM, "lstm"), (gluon.rnn.GRU, "gru"),
+                      (gluon.rnn.RNN, "rnn")]:
+        layer = cls(hidden_size=8, num_layers=2)
+        layer.initialize()
+        x = nd.random.normal(shape=(5, 3, 4))  # (T, N, C)
+        out = layer(x)
+        assert out.shape == (5, 3, 8)
+
+
+def test_lstm_vs_torch():
+    import torch
+    T, N, I, H = 6, 2, 3, 4
+    rs = np.random.RandomState(1)
+    x = rs.randn(T, N, I).astype(np.float32)
+    layer = gluon.rnn.LSTM(hidden_size=H, input_size=I)
+    layer.initialize()
+    # copy weights into torch lstm
+    tl = torch.nn.LSTM(I, H)
+    w_i2h = layer.l0_i2h_weight.data().asnumpy()
+    w_h2h = layer.l0_h2h_weight.data().asnumpy()
+    b_i2h = layer.l0_i2h_bias.data().asnumpy()
+    b_h2h = layer.l0_h2h_bias.data().asnumpy()
+    # both use gate order i,f,g,o
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.from_numpy(w_i2h))
+        tl.weight_hh_l0.copy_(torch.from_numpy(w_h2h))
+        tl.bias_ih_l0.copy_(torch.from_numpy(b_i2h))
+        tl.bias_hh_l0.copy_(torch.from_numpy(b_h2h))
+    out = layer(nd.array(x)).asnumpy()
+    ref, _ = tl(torch.from_numpy(x))
+    np.testing.assert_allclose(out, ref.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_bidirectional():
+    layer = gluon.rnn.LSTM(hidden_size=8, num_layers=1, bidirectional=True)
+    layer.initialize()
+    x = nd.random.normal(shape=(5, 3, 4))
+    out, states = layer(x, layer.begin_state(3))
+    assert out.shape == (5, 3, 16)
+    assert states[0].shape == (2, 3, 8)
+
+
+def test_rnn_cells_unroll():
+    cell = gluon.rnn.LSTMCell(hidden_size=8, input_size=4)
+    cell.initialize()
+    x = nd.random.normal(shape=(3, 5, 4))  # NTC
+    outputs, states = cell.unroll(5, x, merge_outputs=True)
+    assert outputs.shape == (3, 5, 8)
+    assert len(states) == 2
+
+
+def test_sequential_rnn_cell():
+    cell = gluon.rnn.SequentialRNNCell()
+    cell.add(gluon.rnn.LSTMCell(8, input_size=4))
+    cell.add(gluon.rnn.GRUCell(6, input_size=8))
+    cell.initialize()
+    out, states = cell(nd.ones((2, 4)), cell.begin_state(2))
+    assert out.shape == (2, 6)
+    assert len(states) == 3  # 2 lstm + 1 gru
+
+
+def test_model_zoo_smoke():
+    from mxtpu.gluon.model_zoo import vision
+    for name, size in [("resnet18_v1", 32), ("mobilenet0.25", 32),
+                       ("squeezenet1.1", 64)]:
+        net = vision.get_model(name, classes=10)
+        net.initialize()
+        out = net(nd.random.normal(shape=(1, 3, size, size)))
+        assert out.shape == (1, 10), name
+
+
+def test_resnet_v2_smoke():
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.resnet18_v2(classes=7)
+    net.initialize()
+    assert net(nd.random.normal(shape=(1, 3, 32, 32))).shape == (1, 7)
+
+
+def test_clip_global_norm():
+    a = nd.array([3.0, 4.0])
+    b = nd.array([0.0, 0.0])
+    total = gluon.utils.clip_global_norm([a, b], 1.0)
+    assert abs(total - 5.0) < 1e-5
+    np.testing.assert_allclose(a.asnumpy(), [0.6, 0.8], rtol=1e-5)
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape((6, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(0)])
+    assert len(parts) == 2 and parts[0].shape == (3, 2)

@@ -1,0 +1,98 @@
+"""Metric tests — modeled on tests/python/unittest/test_metric.py."""
+
+import numpy as np
+import pytest
+
+from mxtpu import metric, nd
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1.0, 0.0, 0.0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    np.testing.assert_allclose(acc, 2.0 / 3)
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.8, 0.15, 0.05]])
+    label = nd.array([1.0, 2.0])
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], 0.5)
+
+
+def test_mae_mse_rmse():
+    pred = nd.array([[1.0], [2.0]])
+    label = nd.array([[1.5], [1.0]])
+    m = metric.MAE()
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], 0.75)
+    m2 = metric.MSE()
+    m2.update([label], [pred])
+    np.testing.assert_allclose(m2.get()[1], (0.25 + 1.0) / 2)
+    m3 = metric.RMSE()
+    m3.update([label], [pred])
+    np.testing.assert_allclose(m3.get()[1], np.sqrt(0.625))
+
+
+def test_f1():
+    m = metric.F1()
+    pred = nd.array([[0.7, 0.3], [0.2, 0.8], [0.1, 0.9], [0.6, 0.4]])
+    label = nd.array([0.0, 1.0, 1.0, 1.0])
+    m.update([label], [pred])
+    # tp=2 fp=0 fn=1 → p=1, r=2/3, f1=0.8
+    np.testing.assert_allclose(m.get()[1], 0.8, rtol=1e-6)
+
+
+def test_perplexity():
+    m = metric.Perplexity()
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0.0, 0.0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    np.testing.assert_allclose(m.get()[1], expected, rtol=1e-5)
+
+
+def test_cross_entropy_nll():
+    pred = nd.array([[0.2, 0.8]])
+    label = nd.array([1.0])
+    m = metric.CrossEntropy()
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], -np.log(0.8), rtol=1e-5)
+
+
+def test_pearson():
+    m = metric.PearsonCorrelation()
+    pred = nd.array([1.0, 2.0, 3.0])
+    label = nd.array([2.0, 4.0, 6.0])
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], 1.0, rtol=1e-6)
+
+
+def test_composite_and_create():
+    m = metric.create(["acc", "mse"])
+    assert isinstance(m, metric.CompositeEvalMetric)
+    pred = nd.array([[0.3, 0.7]])
+    label = nd.array([1.0])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names and "mse" in names
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.abs(label - pred.argmax(-1)).sum())
+    m = metric.CustomMetric(feval, name="myerr")
+    m.update([nd.array([1.0])], [nd.array([[0.9, 0.1]])])
+    assert m.get()[1] == 1.0
+
+
+def test_reset_and_nan():
+    m = metric.Accuracy()
+    assert np.isnan(m.get()[1])
+    m.update([nd.array([0.0])], [nd.array([[0.9, 0.1]])])
+    m.reset()
+    assert np.isnan(m.get()[1])

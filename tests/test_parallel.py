@@ -1,0 +1,127 @@
+"""Parallelism tests on the 8-virtual-device CPU mesh (the reference's multi-process
+"local launcher" tier, SURVEY.md §4, reimagined as sharding tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd, optimizer, parallel
+from mxtpu.gluon import nn
+
+
+def test_eight_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_allreduce_array():
+    mesh = parallel.make_mesh((8,), ("dp",))
+    x = jnp.ones((4,))
+    out = parallel.allreduce_array(x, mesh)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+    out_mean = parallel.allreduce_array(x, mesh, op="mean")
+    np.testing.assert_allclose(np.asarray(out_mean), 1.0)
+
+
+def test_allgather_and_reduce_scatter():
+    mesh = parallel.make_mesh((8,), ("dp",))
+    x = jnp.arange(16.0).reshape(16, 1)
+    sharded = parallel.shard_batch(nd.array(np.arange(16, dtype=np.float32)
+                                            .reshape(16, 1)), mesh)
+    gathered = parallel.allgather_array(sharded.data, mesh)
+    np.testing.assert_allclose(np.asarray(gathered), np.asarray(x))
+    rs = parallel.reduce_scatter_array(jnp.ones((16, 1)), mesh)
+    np.testing.assert_allclose(np.asarray(rs), 8.0)
+
+
+def test_barrier():
+    mesh = parallel.make_mesh((8,), ("dp",))
+    assert parallel.barrier(mesh) == 8.0
+
+
+def test_shard_batch_layout():
+    mesh = parallel.make_mesh((8,), ("dp",))
+    x = nd.array(np.random.rand(16, 3).astype(np.float32))
+    sx = parallel.shard_batch(x, mesh)
+    assert sx.shape == (16, 3)
+    np.testing.assert_allclose(sx.asnumpy(), x.asnumpy())
+    # sharded over dp: addressable shard is 2 rows
+    shards = sx.data.addressable_shards
+    assert len(shards) == 8 and shards[0].data.shape == (2, 3)
+
+
+def test_data_parallel_trainer_matches_serial():
+    """DP-sharded step ≈ serial large-batch step (the dist_sync consistency check,
+    tests/nightly/dist_sync_kvstore.py re-imagined)."""
+    mesh = parallel.make_mesh((8,), ("dp",))
+
+    def build():
+        mx.rng.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="tanh", in_units=8), nn.Dense(2, in_units=16))
+        net.initialize(init=mx.initializer.Xavier())
+        return net
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 8).astype(np.float32)
+    y = rs.randint(0, 2, 32).astype(np.float32)
+
+    # serial reference
+    net_a = build()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net_a.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    for _ in range(3):
+        with autograd.record():
+            l = loss_fn(net_a(nd.array(X)), nd.array(y))
+            total = nd.mean(l)
+        total.backward()
+        # match DataParallelTrainer's mean-loss gradient scaling
+        trainer.step(1)
+
+    # sharded
+    net_b = build()
+    np.testing.assert_allclose(
+        net_a.collect_params()["hybridsequential0_dense0_weight"].data().asnumpy()
+        if False else 0, 0)
+    dpt = parallel.DataParallelTrainer(net_b, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                       optimizer.SGD(learning_rate=0.1), mesh)
+    for _ in range(3):
+        dpt.step(nd.array(X), nd.array(y))
+
+    pa = {k.split("_", 1)[-1]: p for k, p in net_a.collect_params().items()}
+    pb = {k.split("_", 1)[-1]: p for k, p in net_b.collect_params().items()}
+    for k in pa:
+        np.testing.assert_allclose(pa[k].data().asnumpy(), pb[k].data().asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_dp_trainer_loss_decreases():
+    mesh = parallel.make_mesh((8,), ("dp",))
+    mx.rng.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=10), nn.Dense(2, in_units=32))
+    net.initialize(init=mx.initializer.Xavier())
+    rs = np.random.RandomState(1)
+    X = rs.randn(64, 10).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    dpt = parallel.DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                       optimizer.Adam(learning_rate=0.01), mesh)
+    losses = [dpt.step(nd.array(X), nd.array(y)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_kvstore_tpu_type_reduce():
+    kv = mx.kvstore.create("device")  # → tpu alias
+    kv.init("x", nd.zeros((2,)))
+    kv.push("x", [nd.ones((2,))] * 4)
+    out = nd.zeros((2,))
+    kv.pull("x", out)
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+
+
+def test_mesh_2d():
+    mesh = parallel.make_mesh((4, 2), ("dp", "tp"))
+    assert mesh.shape == {"dp": 4, "tp": 2}
