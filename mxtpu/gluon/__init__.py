@@ -10,3 +10,4 @@ from .trainer import Trainer
 
 from . import data  # noqa: E402
 from . import model_zoo  # noqa: E402
+from . import contrib  # noqa: E402

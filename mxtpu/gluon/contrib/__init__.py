@@ -1,0 +1,6 @@
+"""gluon.contrib — parity with python/mxnet/gluon/contrib (SyncBatchNorm,
+VariationalDropoutCell, attention blocks)."""
+
+from . import nn
+from .nn import SyncBatchNorm
+from .rnn import VariationalDropoutCell

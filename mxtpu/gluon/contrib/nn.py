@@ -1,0 +1,122 @@
+"""Contrib layers.
+
+``SyncBatchNorm`` — parity with the reference's cross-GPU synced BN
+(src/operator/contrib/sync_batch_norm-inl.h:55-93, gluon.contrib.SyncBatchNorm):
+the reference synchronizes batch statistics across devices with a key-matched
+barrier + CPU reduction; here the data-parallel dimension is a mesh axis, so the
+stat sync is ONE ``lax.pmean`` inside the sharded program — XLA rides ICI and
+overlaps it with the surrounding compute.
+
+``MultiHeadAttention`` — flash-attention-backed block (TPU-first addition; the
+reference has no attention layer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import autograd
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..nn.basic_layers import BatchNorm, Dense
+
+
+class SyncBatchNorm(BatchNorm):
+    """BatchNorm whose batch statistics are averaged across the ``dp`` mesh axis.
+
+    Outside shard_map (single logical array) this is plain BatchNorm — the batch
+    already spans the devices, XLA computes global-batch statistics when the input is
+    dp-sharded, which is exactly the SyncBatchNorm semantic. ``axis_name`` matters
+    when the layer runs inside an explicit ``shard_map`` region (per-device batch
+    views): there the stats are pmean'd over the axis.
+    """
+
+    def __init__(self, in_channels: int = 0, num_devices: Optional[int] = None,
+                 momentum: float = 0.9, epsilon: float = 1e-5,
+                 axis_name: str = "dp", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._axis_name = axis_name
+
+    def forward(self, x):
+        self._finish(x.shape[self._axis])
+        gamma, beta = self.gamma.data(), self.beta.data()
+        rmean, rvar = self.running_mean.data(), self.running_var.data()
+        if not (autograd.is_training() and not self._use_global_stats):
+            return nd.BatchNorm(x, gamma, beta, rmean, rvar, eps=self._eps,
+                                fix_gamma=not self._scale, use_global_stats=True,
+                                axis=self._axis)
+        raw = x.data
+        shape = [1] * raw.ndim
+        shape[self._axis] = raw.shape[self._axis]
+
+        def stats(raw_in):
+            axes_ = tuple(i for i in range(raw_in.ndim) if i != self._axis)
+            mu = jnp.mean(raw_in, axis=axes_)
+            ms = jnp.mean(jnp.square(raw_in), axis=axes_)
+            try:  # inside shard_map: average stats over the dp ring
+                mu = lax.pmean(mu, self._axis_name)
+                ms = lax.pmean(ms, self._axis_name)
+            except NameError:
+                pass  # no named axis: stats already span the global (sharded) batch
+            return mu, ms - jnp.square(mu)
+
+        def pure_fn(raw_in, g_in, b_in):
+            mu, va = stats(raw_in)
+            gg = g_in if self._scale else jnp.ones_like(g_in)
+            o = (raw_in - mu.reshape(shape)) * lax.rsqrt(
+                va.reshape(shape) + self._eps)
+            return o * gg.reshape(shape) + b_in.reshape(shape)
+
+        out = pure_fn(raw, gamma.data, beta.data)
+        mean, var = stats(raw)
+        m = self._momentum
+        rmean._set_data(m * rmean.data + (1 - m) * mean)
+        rvar._set_data(m * rvar.data + (1 - m) * var)
+        result = NDArray(out)
+        if autograd.is_recording():
+            autograd.record_custom_node(pure_fn, [x, gamma, beta], [result])
+        return result
+
+
+class MultiHeadAttention(HybridBlock):
+    """Flash-attention-backed MHA block (q,k,v projections + output projection).
+
+    Input (B, T, C); ``num_heads`` must divide ``units``. For sequence-parallel long
+    context, apply ``parallel.ring_self_attention`` to the projected q/k/v directly
+    (this block's attention core is single-program flash attention).
+    """
+
+    def __init__(self, units: int, num_heads: int, use_bias: bool = True,
+                 causal: bool = False, dropout: float = 0.0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert units % num_heads == 0
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        self._dropout = dropout
+        with self.name_scope():
+            self.q_proj = Dense(units, use_bias=use_bias, flatten=False)
+            self.k_proj = Dense(units, use_bias=use_bias, flatten=False)
+            self.v_proj = Dense(units, use_bias=use_bias, flatten=False)
+            self.out_proj = Dense(units, use_bias=use_bias, flatten=False)
+
+    def forward(self, x, memory=None):
+        mem = x if memory is None else memory
+        B, T, C = x.shape
+        H = self._heads
+        D = self._units // H
+        q = self.q_proj(x).reshape((B, T, H, D)).transpose((0, 2, 1, 3))
+        k = self.k_proj(mem).reshape((B, mem.shape[1], H, D)).transpose((0, 2, 1, 3))
+        v = self.v_proj(mem).reshape((B, mem.shape[1], H, D)).transpose((0, 2, 1, 3))
+        out = nd.contrib.flash_attention(q, k, v, causal=self._causal)
+        out = out.transpose((0, 2, 1, 3)).reshape((B, T, self._units))
+        if self._dropout:
+            out = nd.Dropout(out, p=self._dropout)
+        return self.out_proj(out)

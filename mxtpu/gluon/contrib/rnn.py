@@ -1,0 +1,51 @@
+"""Contrib RNN cells — VariationalDropoutCell (gluon.contrib.rnn parity):
+one dropout mask per sequence (variational), applied to inputs/states/outputs."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import autograd
+from ... import ndarray as nd
+from ..rnn.rnn_cell import ModifierCell
+
+
+class VariationalDropoutCell(ModifierCell):
+    def __init__(self, base_cell, drop_inputs: float = 0.0, drop_states: float = 0.0,
+                 drop_outputs: float = 0.0):
+        super().__init__(base_cell)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self.reset()
+
+    def reset(self):
+        self._mask_in = None
+        self._mask_state = None
+        self._mask_out = None
+        if hasattr(self.base_cell, "reset"):
+            self.base_cell.reset()
+
+    def _mask(self, cache_attr, rate, arr):
+        if rate == 0.0 or not autograd.is_training():
+            return arr
+        mask = getattr(self, cache_attr)
+        if mask is None or mask.shape != arr.shape:
+            mask = nd.Dropout(nd.ones_like(arr), p=rate)
+            setattr(self, cache_attr, mask)
+        return arr * mask
+
+    def forward(self, inputs, states):
+        inputs = self._mask("_mask_in", self._di, inputs)
+        if self._ds:
+            # reference masks only states[0] (the hidden state, not LSTM cell
+            # memory — gluon/contrib/rnn/rnn_cell.py)
+            states = [self._mask("_mask_state", self._ds, states[0])] + \
+                list(states[1:])
+        out, next_states = self.base_cell(inputs, states)
+        out = self._mask("_mask_out", self._do, out)
+        return out, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        return super().unroll(length, inputs, begin_state, layout, merge_outputs,
+                              valid_length)

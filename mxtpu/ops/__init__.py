@@ -15,3 +15,4 @@ from . import nn  # noqa: F401
 from . import random  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import rnn  # noqa: F401
+from . import attention  # noqa: F401

@@ -14,3 +14,5 @@ from .collectives import (all_gather, all_to_all, allgather_array, allreduce,
 from .data_parallel import DataParallelTrainer, replicate, shard_batch
 from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, get_default_mesh,
                    make_mesh, set_default_mesh)
+from . import ring_attention
+from .ring_attention import ring_attention_inner, ring_self_attention

@@ -1,0 +1,127 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+The long-context mandate (SURVEY.md §5): the reference's only sequence-scaling tools
+were bucketing and fused RNNs; a TPU-native framework must scale *attention* context
+across chips. Ring attention shards the sequence over a mesh axis (``sp``): each
+device holds Q/K/V for its chunk; K/V chunks rotate around the ring via ``ppermute``
+(XLA lowers this to neighbor RDMA over ICI) while each device accumulates blockwise
+online-softmax statistics against its resident Q — full attention over N·T context
+with per-device memory O(T) and perfectly overlapped compute/communication.
+
+Math: per ring step s, device r attends its Q block to the K/V block originally from
+device (r - s) mod n, maintaining (m, l, o) flash accumulators; causal masking uses
+global chunk offsets.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import Mesh, get_default_mesh
+
+__all__ = ["ring_attention_inner", "ring_self_attention"]
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, o, scale, q_offset, k_offset, causal):
+    """Accumulate one K/V block into the flash (m, l, o) stats.
+
+    q: (B,H,Tq,D); k,v: (B,H,Tk,D); m,l: (B,H,Tq,1); o: (B,H,Tq,D).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        rows = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        cols = k_offset + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = corr * o + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention_inner(q, k, v, axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None):
+    """Call INSIDE shard_map: q,k,v are the per-device sequence chunks (B,H,t,D).
+
+    Rotates K/V with ``lax.ppermute`` (ICI neighbor exchange) n-1 times; the next
+    chunk's transfer overlaps the current chunk's attention automatically (XLA
+    schedules the ppermute DMA concurrently with the einsums).
+    """
+    n = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    t = q.shape[2]
+    d = q.shape[3]
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    q_offset = r * t
+
+    m = jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+    o = jnp.zeros(q.shape, jnp.float32)
+    qf = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def attend(s, k_cur, v_cur, m, l, o):
+        # K/V currently resident came from device (r - s) mod n
+        src = (r - s) % n
+        k_offset = src * t
+        return _block_attend(qf, k_cur.astype(jnp.float32),
+                             v_cur.astype(jnp.float32), m, l, o, sc,
+                             q_offset, k_offset, causal)
+
+    def step(s, carry):
+        k_cur, v_cur, m, l, o = carry
+        m, l, o = attend(s, k_cur, v_cur, m, l, o)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m, l, o
+
+    # n-1 attend+rotate steps, then a final attend — the last rotation would only
+    # return chunks to their owners, so skipping it saves one full K/V RDMA per call
+    k_cur, v_cur, m, l, o = lax.fori_loop(0, n - 1, step, (k, v, m, l, o))
+    m, l, o = attend(n - 1, k_cur, v_cur, m, l, o)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
+                        axis_name: str = "sp", causal: bool = False,
+                        scale: Optional[float] = None):
+    """User-level entry: full (B,H,T,D) arrays, sequence sharded over ``axis_name``.
+
+    Shards T over the mesh axis, runs the ring, returns the full output (sharded the
+    same way — composable with dp over another axis).
+    """
+    from ..ndarray.ndarray import NDArray
+    wrap = isinstance(q, NDArray)
+    handles = (q, k, v) if wrap else ()
+    if wrap:
+        q, k, v = q.data, k.data, v.data
+    mesh = mesh or get_default_mesh()
+    if axis_name not in mesh.axis_names:
+        axis_name = mesh.axis_names[0]
+    spec = P(None, None, axis_name, None)
+
+    fn = jax.shard_map(
+        partial(ring_attention_inner, axis_name=axis_name, causal=causal,
+                scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    out = fn(q, k, v)
+    if not wrap:
+        return out
+    result = NDArray(out)
+    from .. import autograd
+    if autograd.is_recording():
+        # one tape node so grads flow to q/k/v handles (matches registry invoke)
+        autograd.record_custom_node(lambda q_, k_, v_: fn(q_, k_, v_),
+                                    list(handles), [result])
+    return result

@@ -1,0 +1,210 @@
+"""Flash/ring attention tests: XLA reference vs torch; ring vs single-device."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import jax
+import jax.numpy as jnp
+
+from mxtpu import nd, parallel
+from mxtpu.ops.attention import attention_reference, _flash_attention_pallas
+
+
+def _qkv(B=2, H=2, T=16, D=8, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randn(B, H, T, D).astype(np.float32) for _ in range(3)]
+
+
+def test_attention_reference_vs_torch():
+    q, k, v = _qkv()
+    out = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    ref = tF.scaled_dot_product_attention(
+        torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v)).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_causal_vs_torch():
+    q, k, v = _qkv(T=12)
+    out = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=True)
+    ref = tF.scaled_dot_product_attention(
+        torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+        is_causal=True).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_pallas_interpret_matches_reference():
+    q, k, v = _qkv(B=1, H=2, T=128, D=128)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    ref = attention_reference(qa, ka, va)
+    out = _flash_attention_pallas(qa, ka, va, causal=False,
+                                  scale=1.0 / np.sqrt(128), interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_flash_pallas_interpret_causal():
+    q, k, v = _qkv(B=1, H=1, T=256, D=128, seed=2)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    ref = attention_reference(qa, ka, va, causal=True)
+    out = _flash_attention_pallas(qa, ka, va, causal=True,
+                                  scale=1.0 / np.sqrt(128), block_q=128,
+                                  block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_nd_attention_op_and_grad():
+    q, k, v = _qkv(T=8)
+    qn, kn, vn = nd.array(q), nd.array(k), nd.array(v)
+    qn.attach_grad()
+    from mxtpu import autograd
+    with autograd.record():
+        out = nd.contrib.flash_attention(qn, kn, vn)
+        loss = nd.sum(out)
+    loss.backward()
+    # torch grads
+    tq = torch.from_numpy(q).requires_grad_(True)
+    tF.scaled_dot_product_attention(tq, torch.from_numpy(k),
+                                    torch.from_numpy(v)).sum().backward()
+    np.testing.assert_allclose(qn.grad.asnumpy(), tq.grad.numpy(), rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_ring_attention_matches_single_device():
+    mesh = parallel.make_mesh((8,), ("sp",))
+    q, k, v = _qkv(B=1, H=2, T=64, D=16, seed=5)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    ref = attention_reference(qa, ka, va)
+    out = parallel.ring_self_attention(qa, ka, va, mesh, axis_name="sp")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_ring_attention_causal():
+    mesh = parallel.make_mesh((8,), ("sp",))
+    q, k, v = _qkv(B=1, H=1, T=64, D=16, seed=6)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    ref = attention_reference(qa, ka, va, causal=True)
+    out = parallel.ring_self_attention(qa, ka, va, mesh, axis_name="sp",
+                                       causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_ring_attention_2d_mesh_dp_sp():
+    mesh = parallel.make_mesh((2, 4), ("dp", "sp"))
+    q, k, v = _qkv(B=2, H=2, T=32, D=16, seed=7)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    ref = attention_reference(qa, ka, va)
+    out = parallel.ring_self_attention(qa, ka, va, mesh, axis_name="sp")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sync_batchnorm_global_stats():
+    """dp-sharded input: stats span the global batch (the SyncBatchNorm semantic)."""
+    from mxtpu.gluon.contrib import SyncBatchNorm
+    from mxtpu import autograd
+    net = SyncBatchNorm(in_channels=3)
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).rand(16, 3, 4, 4).astype(np.float32) * 4)
+    with autograd.record():
+        out = net(x)
+    o = out.asnumpy()
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+    # running stats moved toward batch stats
+    assert not np.allclose(net.running_mean.data().asnumpy(), 0)
+
+
+def test_sync_batchnorm_grad_flows():
+    from mxtpu.gluon.contrib import SyncBatchNorm
+    from mxtpu import autograd, gluon
+    net = SyncBatchNorm(in_channels=2)
+    net.initialize()
+    x = nd.array(np.random.rand(4, 2, 3, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = net(x)
+        loss = nd.sum(out * out)
+    loss.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    assert net.beta.data()._grad is not None
+
+
+def test_multihead_attention_block():
+    from mxtpu.gluon.contrib.nn import MultiHeadAttention
+    mha = MultiHeadAttention(units=32, num_heads=4, causal=True)
+    mha.initialize()
+    x = nd.random.normal(shape=(2, 10, 32))
+    out = mha(x)
+    assert out.shape == (2, 10, 32)
+    # cross attention
+    mem = nd.random.normal(shape=(2, 6, 32))
+    out2 = mha(x, mem)
+    assert out2.shape == (2, 10, 32)
+
+
+def test_variational_dropout_cell():
+    from mxtpu.gluon.contrib.rnn import VariationalDropoutCell
+    from mxtpu import autograd, gluon
+    cell = VariationalDropoutCell(gluon.rnn.LSTMCell(8, input_size=4),
+                                  drop_inputs=0.5)
+    cell.initialize()
+    x = nd.ones((2, 6, 4))
+    with autograd.record():
+        outs, _ = cell.unroll(6, x, merge_outputs=False)
+    # same mask across time: masked input positions identical each step
+    m1 = cell._mask_in.asnumpy()
+    assert (m1 == 0).any()
+
+
+def test_causal_cross_attention_top_left():
+    """Top-left causal alignment: query row 0 attends key 0 even when Tk < Tq."""
+    rs = np.random.RandomState(9)
+    q = jnp.asarray(rs.randn(1, 1, 10, 8).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 1, 6, 8).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 1, 6, 8).astype(np.float32))
+    out = attention_reference(q, k, v, causal=True)
+    # row 0 sees only key 0 → output equals v[0]
+    np.testing.assert_allclose(np.asarray(out[0, 0, 0]), np.asarray(v[0, 0, 0]),
+                               rtol=1e-5)
+
+
+def test_ring_attention_grad_through_tape():
+    from mxtpu import autograd
+    mesh = parallel.make_mesh((4,), ("sp",))
+    rs = np.random.RandomState(3)
+    arrs = [rs.randn(1, 2, 16, 8).astype(np.float32) for _ in range(3)]
+    qn, kn, vn = [nd.array(a) for a in arrs]
+    qn.attach_grad()
+    with autograd.record():
+        out = parallel.ring_self_attention(qn, kn, vn, mesh, axis_name="sp")
+        loss = nd.sum(out)
+    loss.backward()
+    g = qn.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # compare against single-device reference grad
+    qa = jnp.asarray(arrs[0])
+    ref_g = jax.grad(lambda q_: jnp.sum(attention_reference(
+        q_, jnp.asarray(arrs[1]), jnp.asarray(arrs[2]))))(qa)
+    np.testing.assert_allclose(g, np.asarray(ref_g), rtol=1e-4, atol=1e-5)
+
+
+def test_variational_dropout_preserves_lstm_cell_state():
+    from mxtpu.gluon.contrib.rnn import VariationalDropoutCell
+    from mxtpu import autograd, gluon
+    cell = VariationalDropoutCell(gluon.rnn.LSTMCell(8, input_size=4),
+                                  drop_states=0.9)
+    cell.initialize()
+    x = nd.ones((2, 4))
+    states = cell.begin_state(2)
+    states[1]._set_data(np.full((2, 8), 5.0, np.float32))
+    with autograd.record():
+        out, next_states = cell(x, states)
+    # cell memory (states[1]) must not be zeroed by the state mask
+    c = next_states[1].asnumpy()
+    assert np.isfinite(c).all()
