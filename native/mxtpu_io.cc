@@ -1,0 +1,170 @@
+// mxtpu native IO — C++ data-pipeline kernels (reference parity: src/io/, the
+// reference's RecordIO parse + batch assembly are C++ with OMP decode threads,
+// iter_image_recordio_2.cc). The Python layer binds these via ctypes; everything
+// here is host-side (the device path is XLA's).
+//
+// Exposed C ABI:
+//   rio_index      — scan a RecordIO file, return record offsets/sizes
+//   rio_read_batch — positioned parallel reads of many records into one buffer
+//   nhwc_u8_to_nchw_f32 — fused uint8→float32 normalize + HWC→CHW transpose,
+//                         threaded over the batch (the host-side hot loop that
+//                         feeds device_put)
+//   f32_batch_stack — parallel memcpy gather of sample pointers into a batch
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xCED7230A;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+int hw_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n ? static_cast<int>(n) : 4;
+}
+
+// simple static partition parallel-for over [0, n)
+template <typename F>
+void parallel_for(int64_t n, F&& fn, int max_threads = 0) {
+  int nt = max_threads > 0 ? max_threads : hw_threads();
+  if (nt > n) nt = static_cast<int>(n);
+  if (nt <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    workers.emplace_back([lo, hi, &fn]() {
+      for (int64_t i = lo; i < hi; ++i) fn(i);
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan a RecordIO file; fills offsets[i] (payload start) and sizes[i] for up to
+// max_records records. Returns the number of records found, or -1 on IO error,
+// -2 on a corrupt magic.
+int64_t rio_index(const char* path, int64_t* offsets, int64_t* sizes,
+                  int64_t max_records) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t count = 0;
+  uint32_t head[2];
+  int64_t pos = 0;
+  while (count < max_records && std::fread(head, 4, 2, f) == 2) {
+    if (head[0] != kMagic) {
+      std::fclose(f);
+      return -2;
+    }
+    int64_t len = head[1] & kLenMask;
+    offsets[count] = pos + 8;
+    sizes[count] = len;
+    ++count;
+    int64_t pad = (4 - (len % 4)) % 4;
+    pos += 8 + len + pad;
+    if (std::fseek(f, static_cast<long>(pos), SEEK_SET) != 0) break;
+  }
+  std::fclose(f);
+  return count;
+}
+
+// Parallel positioned reads: record i is read from offsets[i] (sizes[i] bytes)
+// into out + out_offsets[i]. Each worker opens its own FILE* (pread semantics).
+// Returns 0 on success, -1 if any read failed.
+int rio_read_batch(const char* path, const int64_t* offsets, const int64_t* sizes,
+                   const int64_t* out_offsets, int64_t n, char* out,
+                   int num_threads) {
+  std::atomic<int> failed{0};
+  int nt = num_threads > 0 ? num_threads : hw_threads();
+  if (nt > n) nt = static_cast<int>(n);
+  if (nt < 1) nt = 1;
+  std::vector<std::thread> workers;
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    workers.emplace_back([&, lo, hi]() {
+      FILE* f = std::fopen(path, "rb");
+      if (!f) {
+        failed.store(1);
+        return;
+      }
+      for (int64_t i = lo; i < hi; ++i) {
+        if (std::fseek(f, static_cast<long>(offsets[i]), SEEK_SET) != 0 ||
+            std::fread(out + out_offsets[i], 1, static_cast<size_t>(sizes[i]),
+                       f) != static_cast<size_t>(sizes[i])) {
+          failed.store(1);
+          break;
+        }
+      }
+      std::fclose(f);
+    });
+  }
+  for (auto& w : workers) w.join();
+  return failed.load() ? -1 : 0;
+}
+
+// Fused normalize + layout transform for image batches:
+//   in:  N × H × W × C uint8
+//   out: N × C × H × W float32, out = (in/255 − mean[c]) / std[c]  (scale255=1)
+//        or (in − mean[c]) / std[c]                                  (scale255=0)
+// Threaded over N (the reference does this with OMP preprocess_threads).
+void nhwc_u8_to_nchw_f32(const uint8_t* in, float* out, const float* mean,
+                         const float* stddev, int64_t n, int64_t h, int64_t w,
+                         int64_t c, int scale255, int num_threads) {
+  const int64_t hw = h * w;
+  const int64_t img_in = hw * c;
+  const int64_t img_out = c * hw;
+  const float inv255 = 1.0f / 255.0f;
+  parallel_for(
+      n,
+      [&](int64_t i) {
+        const uint8_t* src = in + i * img_in;
+        float* dst = out + i * img_out;
+        for (int64_t ch = 0; ch < c; ++ch) {
+          const float m = mean ? mean[ch] : 0.0f;
+          const float inv_s = stddev ? 1.0f / stddev[ch] : 1.0f;
+          float* d = dst + ch * hw;
+          const uint8_t* s = src + ch;
+          if (scale255) {
+            for (int64_t p = 0; p < hw; ++p)
+              d[p] = (static_cast<float>(s[p * c]) * inv255 - m) * inv_s;
+          } else {
+            for (int64_t p = 0; p < hw; ++p)
+              d[p] = (static_cast<float>(s[p * c]) - m) * inv_s;
+          }
+        }
+      },
+      num_threads);
+}
+
+// Gather n sample pointers (each `bytes` long) into a contiguous batch buffer.
+void f32_batch_stack(const float** samples, float* out, int64_t n, int64_t bytes,
+                     int num_threads) {
+  parallel_for(
+      n,
+      [&](int64_t i) {
+        std::memcpy(reinterpret_cast<char*>(out) + i * bytes,
+                    samples[i], static_cast<size_t>(bytes));
+      },
+      num_threads);
+}
+
+int mxtpu_io_abi_version() { return 1; }
+
+}  // extern "C"
