@@ -142,7 +142,11 @@ def mark_variables(variables, gradients=None, grad_reqs="write"):
 
 
 def _record(op, args, kwargs, nd_in, outs):
-    """Called by ops.registry.invoke while recording (RecordOp parity)."""
+    """Called by ops.registry.invoke while recording (RecordOp parity).
+
+    ``nd_in`` positions are ints (positional args) or strs (keyword args) — both are
+    replayed through ``pure_fn`` so kwarg tensors receive gradients too.
+    """
     positions = [i for i, _ in nd_in]
     raw_inputs = [a.data for _, a in nd_in]
     parent_entries = [a._grad_entry for _, a in nd_in]
@@ -152,11 +156,17 @@ def _record(op, args, kwargs, nd_in, outs):
 
     def pure_fn(*raw):
         full = list(template)
+        kw = dict(fixed_kwargs)
         for p, r in zip(positions, raw):
-            full[p] = r
+            if isinstance(p, str):
+                kw[p] = r
+            else:
+                full[p] = r
         full = [a.data if hasattr(a, "data") and hasattr(a, "_grad_entry") else a
                 for a in full]
-        return fn(*full, **fixed_kwargs)
+        kw = {k: (v.data if hasattr(v, "data") and hasattr(v, "_grad_entry") else v)
+              for k, v in kw.items()}
+        return fn(*full, **kw)
 
     node = _TapeNode(pure_fn, raw_inputs, parent_entries, len(outs))
     for j, o in enumerate(outs):
@@ -261,13 +271,20 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
                         if h._grad is None:
                             h._grad = NDArray(jnp.zeros_like(h._data))
                         h._grad._set_data(jnp.asarray(grads[k], dtype=h._data.dtype))
-        # heads that are themselves marked variables
+        # heads that are themselves marked variables and were NOT flushed above
+        # (skipping `seen` keeps this from clobbering grad_req='add' accumulation)
         for i, h in enumerate(heads):
             entry = h._grad_entry
-            if isinstance(entry, _VariableEntry):
+            if isinstance(entry, _VariableEntry) and id(entry) not in seen:
+                seen.add(id(entry))
                 k = _entry_key(entry)
                 if k in grads and entry.grad_req != "null":
-                    h._grad._set_data(jnp.asarray(grads[k], dtype=h._data.dtype))
+                    if entry.grad_req == "add" and h._grad is not None:
+                        h._grad._set_data(h._grad._data + grads[k])
+                    else:
+                        if h._grad is None:
+                            h._grad = NDArray(jnp.zeros_like(h._data))
+                        h._grad._set_data(jnp.asarray(grads[k], dtype=h._data.dtype))
 
     if not retain_graph:
         st.tape = []

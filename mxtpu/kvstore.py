@@ -76,7 +76,9 @@ class KVStore:
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
-                self._store[k] = NDArray(jnp.asarray(v.data))
+                # materialized copy, not an alias: the caller's weight buffer may be
+                # donated by a later optimizer step (see NDArray.copy)
+                self._store[k] = NDArray(jnp.array(v.data, copy=True))
 
     def push(self, key, value, priority: int = 0):
         """Accumulate: list-of-values are reduced (Comm::Reduce parity, comm.h:103);

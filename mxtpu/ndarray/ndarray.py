@@ -169,11 +169,15 @@ class NDArray:
     as_in_ctx = as_in_context
 
     def copy(self) -> "NDArray":
-        return NDArray(self.data)
+        # Deep-copy parity (NDArray::Copy). A materialized buffer (not an alias) is
+        # required: optimizers donate weight buffers to their fused update kernels
+        # (optimizer.py donate_argnums), which invalidates any aliasing handle.
+        return NDArray(jnp.array(self.data, copy=True))
 
     def detach(self) -> "NDArray":
-        out = NDArray(self.data)
-        return out
+        # Also materialized — a detached handle must survive donation of the source
+        # buffer by a later in-place optimizer step.
+        return NDArray(jnp.array(self.data, copy=True))
 
     # -- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req: str = "write", stype=None):
@@ -520,18 +524,28 @@ def waitall():
 # ---------------------------------------------------------------------------
 
 
+_SAVE_FORMAT_KEY = "__mxtpu_format__"  # reserved npz entry: b"list" | b"dict"
+
+
 def save(fname: str, data):
-    """Save an NDArray, list of NDArrays, or dict of name→NDArray (mx.nd.save parity)."""
+    """Save an NDArray, list of NDArrays, or dict of name→NDArray (mx.nd.save parity).
+
+    An explicit format marker is stored so a dict whose keys happen to look like
+    ``arr_<i>`` round-trips correctly (list-vs-dict is never inferred from key names).
+    """
     if isinstance(data, NDArray):
-        payload, names = {"arr_0": data.asnumpy()}, None
+        payload, fmt = {"arr_0": data.asnumpy()}, "list"
     elif isinstance(data, dict):
+        if _SAVE_FORMAT_KEY in data:
+            raise ValueError(f"key {_SAVE_FORMAT_KEY!r} is reserved")
         payload = {k: v.asnumpy() for k, v in data.items()}
-        names = list(data)
+        fmt = "dict"
     elif isinstance(data, (list, tuple)):
         payload = {f"arr_{i}": v.asnumpy() for i, v in enumerate(data)}
-        names = None
+        fmt = "list"
     else:
         raise TypeError(f"cannot save {type(data)}")
+    payload[_SAVE_FORMAT_KEY] = np.frombuffer(fmt.encode(), dtype=np.uint8)
     with open(fname, "wb") as f:
         np.savez(f, **payload)
 
@@ -540,7 +554,11 @@ def load(fname: str):
     """Load from ``save``; returns dict if named, else list (mx.nd.load parity)."""
     with open(fname, "rb") as f:
         with np.load(f, allow_pickle=False) as z:
-            keys = list(z.keys())
-            if all(k.startswith("arr_") for k in keys):
+            keys = [k for k in z.keys() if k != _SAVE_FORMAT_KEY]
+            if _SAVE_FORMAT_KEY in z.keys():
+                fmt = bytes(z[_SAVE_FORMAT_KEY]).decode()
+            else:  # pre-marker files: fall back to the key-name heuristic
+                fmt = "list" if all(k.startswith("arr_") for k in keys) else "dict"
+            if fmt == "list":
                 return [NDArray(z[f"arr_{i}"]) for i in range(len(keys))]
             return {k: NDArray(z[k]) for k in keys}

@@ -125,7 +125,9 @@ def invoke(op: OpDef, *args, out=None, **kwargs):
         kwargs = op.resolve_kwargs(dict(kwargs))
 
     raw = [a.data if isinstance(a, NDArray) else a for a in args]
-    result = op.fn(*raw, **kwargs)
+    raw_kwargs = {k: (v.data if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
+    result = op.fn(*raw, **raw_kwargs)
 
     multi = isinstance(result, (tuple, list))
     outs = [_wrap_out(r) for r in result] if multi else [_wrap_out(result)]
@@ -141,7 +143,10 @@ def invoke(op: OpDef, *args, out=None, **kwargs):
 
     from .. import autograd
     if autograd.is_recording() and op.differentiable:
+        # positional NDArrays by index, kwarg NDArrays by name — both become tape
+        # inputs so gradients flow to (e.g.) `length=` tensors as well
         nd_in = [(i, a) for i, a in enumerate(args) if isinstance(a, NDArray)]
+        nd_in += [(k, v) for k, v in kwargs.items() if isinstance(v, NDArray)]
         if nd_in:
             autograd._record(op, args, kwargs, nd_in, outs)
 

@@ -183,3 +183,73 @@ def test_module_multi_input():
                       label=[nd.zeros((2,))])
     mod.forward(batch, is_train=False)
     assert mod.get_outputs()[0].shape == (2, 3)
+
+
+def test_copy_survives_optimizer_donation():
+    """ADVICE r1: optimizer donates the weight buffer; copy()/detach() must be real
+    copies, not aliases, or the snapshot dies after the first step."""
+    from mxtpu import optimizer
+    w = nd.array(np.ones((4,), np.float32))
+    g = nd.array(np.full((4,), 0.5, np.float32))
+    snap, det = w.copy(), w.detach()
+    opt = optimizer.SGD(learning_rate=0.1)
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    np.testing.assert_allclose(snap.asnumpy(), np.ones(4))
+    np.testing.assert_allclose(det.asnumpy(), np.ones(4))
+    np.testing.assert_allclose(w.asnumpy(), np.full(4, 0.95), rtol=1e-6)
+
+
+def test_kvstore_init_survives_donation():
+    from mxtpu import kvstore, optimizer
+    kv = kvstore.create("local")
+    w = nd.array(np.ones((3,), np.float32))
+    kv.init("w", w)
+    opt = optimizer.SGD(learning_rate=0.5)
+    opt.update("w", w, nd.array(np.ones((3,), np.float32)), ())
+    out = nd.zeros((3,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(3))
+
+
+def test_ndarray_kwarg_unwrapped_and_differentiable():
+    """ADVICE r1: NDArray passed as a kwarg must be unwrapped and get gradients."""
+    x = nd.array(np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32))
+    ln = nd.array(np.array([2, 3], np.float32))
+    out = nd.softmax(x, length=ln, use_length=True)  # must not raise
+    assert out.shape == (2, 3)
+
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    b = nd.array(np.array([3.0, 4.0], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = nd.broadcast_add(a, rhs=b) if "broadcast_add" in nd.__dict__ else a + b
+        s = nd.sum(y * y)
+    s.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * (a.asnumpy() + b.asnumpy()))
+    np.testing.assert_allclose(b.grad.asnumpy(), 2 * (a.asnumpy() + b.asnumpy()))
+
+
+def test_save_load_dict_with_arr_keys(tmp_path):
+    """ADVICE r1: dict keys that look like arr_<i> must round-trip as a dict."""
+    f = str(tmp_path / "d.npz")
+    d = {"arr_weight": nd.array([1.0, 2.0]), "arr_0": nd.array([3.0])}
+    nd.save(f, d)
+    back = nd.load(f)
+    assert isinstance(back, dict) and set(back) == {"arr_weight", "arr_0"}
+    np.testing.assert_allclose(back["arr_weight"].asnumpy(), [1, 2])
+
+
+def test_head_variable_grad_req_add_not_clobbered():
+    """ADVICE r1: a head that is itself a marked variable with grad_req='add' must
+    accumulate, not be overwritten by the head-flush pass."""
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    x.attach_grad(grad_req="add")
+    with autograd.record():
+        y = x * x
+    y.backward()
+    with autograd.record():
+        y2 = x * x
+    y2.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * 2 * x.asnumpy())
