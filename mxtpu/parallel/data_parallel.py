@@ -52,31 +52,63 @@ class DataParallelTrainer:
     overlaps backward; optimizer update is fused in (donated buffers).
     """
 
-    def __init__(self, block, loss_fn, optimizer, mesh: Optional[Mesh] = None):
+    def __init__(self, block, loss_fn, optimizer, mesh: Optional[Mesh] = None,
+                 param_shardings=None):
+        """``param_shardings`` is the gluon-integrated model-parallel hook (the
+        TPU-native replacement for the reference's ``ctx_group``/``group2ctx`` layer
+        placement, graph_executor.cc:408): a dict mapping parameter-name suffixes to
+        ``PartitionSpec``s, or a callable ``name -> PartitionSpec | None``. Unlisted
+        params are replicated. XLA/GSPMD inserts the tp collectives automatically."""
         self.block = block
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh or get_default_mesh()
+        self.param_shardings = param_shardings
         self._step_fn = None
         self._params: List = []
         self._states: List = []
+
+    def _spec_for(self, name) -> P:
+        if self.param_shardings is None:
+            return P()
+        if callable(self.param_shardings):
+            return self.param_shardings(name) or P()
+        for suffix, spec in self.param_shardings.items():
+            if name.endswith(suffix):
+                return spec
+        return P()
 
     def _collect(self, x_example):
         # ensure deferred params materialize
         with autograd.predict_mode():
             self.block(x_example)
-        self._param_handles = [p for p in self.block.collect_params().values()
+        named = list(self.block.collect_params().items())
+        self._param_names = [n for n, p in named
+                             if p._data is not None and p.grad_req != "null"]
+        self._param_handles = [p for n, p in named
                                if p._data is not None and p.grad_req != "null"]
-        self._aux_handles = [p for p in self.block.collect_params().values()
+        self._aux_handles = [p for n, p in named
                              if p._data is not None and p.grad_req == "null"]
-        # replicate across the mesh
-        for p in self._param_handles + self._aux_handles:
+        # place across the mesh: replicated unless a tp sharding was requested
+        self._param_sh = [NamedSharding(self.mesh, self._spec_for(n))
+                          for n in self._param_names]
+        for p, sh in zip(self._param_handles, self._param_sh):
+            p._data._set_data(jax.device_put(p.data().data, sh))
+        for p in self._aux_handles:
             p._data._set_data(jax.device_put(p.data().data,
                                              NamedSharding(self.mesh, P())))
+        repl = NamedSharding(self.mesh, P())
         self._states = [self.optimizer.create_state(i, p.data())
                         for i, p in enumerate(self._param_handles)]
-        self._states = [tuple(jax.device_put(s, NamedSharding(self.mesh, P()))
-                              for s in st) for st in self._states]
+        # optimizer state follows its param's sharding (same-shape moments etc.)
+        self._states = [tuple(jax.device_put(
+            s, sh if getattr(s, "shape", None) == p.data().shape else repl)
+            for s in st)
+            for p, sh, st in zip(self._param_handles, self._param_sh, self._states)]
+        self._state_sh = [tuple(
+            sh if getattr(s, "shape", None) == p.data().shape else repl
+            for s in st)
+            for p, sh, st in zip(self._param_handles, self._param_sh, self._states)]
 
     def _build(self):
         block, loss_fn, opt = self.block, self.loss_fn, self.optimizer
@@ -130,8 +162,9 @@ class DataParallelTrainer:
         # reclaimed by refcount anyway since the handles are swapped after the call.
         self._step_fn = jax.jit(
             step,
-            in_shardings=(repl, repl, repl, batch, batch, repl, repl, None),
-            out_shardings=(repl, repl, repl, repl))
+            in_shardings=(self._param_sh, repl, self._state_sh, batch, batch,
+                          repl, repl, None),
+            out_shardings=(self._param_sh, repl, self._state_sh, repl))
 
     def step(self, x, y) -> float:
         x = x if isinstance(x, NDArray) else nd_mod.array(x)
