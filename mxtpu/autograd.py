@@ -197,6 +197,50 @@ def _entry_key(entry):
     return ("out", id(node), j)
 
 
+def _accum(a, b):
+    """Cotangent accumulation; composes row-sparse carriers with dense arrays."""
+    from .ndarray.sparse import RawRowSparse
+    if isinstance(a, RawRowSparse):
+        return a + b
+    if isinstance(b, RawRowSparse):
+        return b + a
+    return a + b
+
+
+def _dense_cot(g):
+    """Densify a row-sparse cotangent before feeding it to a vjp."""
+    from .ndarray.sparse import RawRowSparse
+    return g.densify() if isinstance(g, RawRowSparse) else g
+
+
+def _flush_grad(h, entry, g):
+    """Write a backward result into a variable's ``.grad`` buffer, honoring
+    grad_req and materializing row-sparse cotangents as RowSparseNDArray (the
+    reference's grad_stype='row_sparse' surface for lazy optimizers)."""
+    from .ndarray.ndarray import NDArray
+    from .ndarray import sparse as sp
+    if isinstance(g, sp.RawRowSparse):
+        if entry.grad_req == "add":
+            if isinstance(h._grad, sp.RowSparseNDArray):
+                uniq, vals = g.dedup()
+                h._grad = sp.add(h._grad, sp.RowSparseNDArray(uniq, vals, g.shape))
+                return
+            if h._grad is not None:
+                h._grad._set_data(h._grad.data + g.densify())
+                return
+        uniq, vals = g.dedup()
+        h._grad = sp.RowSparseNDArray(uniq, vals.astype(h._data.dtype), g.shape)
+        return
+    dense_existing = (h._grad is not None
+                      and getattr(h._grad, "stype", "default") == "default")
+    if entry.grad_req == "add" and dense_existing:
+        h._grad._set_data(h._grad._data + g)
+    else:
+        if not dense_existing:
+            h._grad = NDArray(jnp.zeros_like(h._data))
+        h._grad._set_data(jnp.asarray(g, dtype=h._data.dtype))
+
+
 def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
                   collect_vars=None):
     st = _st()
@@ -217,7 +261,7 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
             hg.data if hasattr(hg, "data") and hasattr(hg, "_grad_entry") else hg,
             dtype=h.data.dtype)
         k = _entry_key(entry)
-        grads[k] = grads[k] + cot if k in grads else cot
+        grads[k] = _accum(grads[k], cot) if k in grads else cot
 
     for node in reversed(tape):
         out_keys = [("out", id(node), j) for j in range(node.n_outputs)]
@@ -225,7 +269,8 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
             continue
         if node.backward_fn is not None:
             out_grads = [grads.get(k) for k in out_keys]
-            out_grads = [g if g is not None else jnp.zeros_like(_out_like(node, j))
+            out_grads = [_dense_cot(g) if g is not None
+                         else jnp.zeros_like(_out_like(node, j))
                          for j, (g, k) in enumerate(zip(out_grads, out_keys))]
             in_grads = node.backward_fn(node.saved, out_grads)
         else:
@@ -233,17 +278,17 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
             multi = isinstance(outs, (tuple, list))
             if multi:
                 cots = tuple(
-                    grads.get(k, None) if grads.get(k, None) is not None
+                    _dense_cot(grads[k]) if grads.get(k, None) is not None
                     else jnp.zeros_like(o)
                     for k, o in zip(out_keys, outs))
             else:
-                cots = grads[out_keys[0]]
+                cots = _dense_cot(grads[out_keys[0]])
             in_grads = vjp_fn(cots)
         for entry, g in zip(node.parent_entries, in_grads):
             if entry is None or g is None:
                 continue
             k = _entry_key(entry)
-            grads[k] = grads[k] + g if k in grads else g
+            grads[k] = _accum(grads[k], g) if k in grads else g
 
     # flush into variable .grad buffers / collect for grad()
     from .ndarray.ndarray import NDArray
@@ -253,7 +298,7 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
         for v in collect_vars:
             entry = v._grad_entry
             k = _entry_key(entry) if isinstance(entry, _VariableEntry) else None
-            g = grads.get(k) if k else None
+            g = _dense_cot(grads.get(k)) if k and k in grads else None
             results.append(NDArray(g if g is not None else jnp.zeros_like(v._data)))
     else:
         seen = set()
@@ -262,15 +307,9 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
                 if isinstance(entry, _VariableEntry) and id(entry) not in seen:
                     seen.add(id(entry))
                     k = _entry_key(entry)
-                    if k not in grads:
+                    if k not in grads or entry.grad_req == "null":
                         continue
-                    h = entry.handle
-                    if entry.grad_req == "add" and h._grad is not None:
-                        h._grad._set_data(h._grad._data + grads[k])
-                    elif entry.grad_req != "null":
-                        if h._grad is None:
-                            h._grad = NDArray(jnp.zeros_like(h._data))
-                        h._grad._set_data(jnp.asarray(grads[k], dtype=h._data.dtype))
+                    _flush_grad(entry.handle, entry, grads[k])
         # heads that are themselves marked variables and were NOT flushed above
         # (skipping `seen` keeps this from clobbering grad_req='add' accumulation)
         for i, h in enumerate(heads):
@@ -279,12 +318,7 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
                 seen.add(id(entry))
                 k = _entry_key(entry)
                 if k in grads and entry.grad_req != "null":
-                    if entry.grad_req == "add" and h._grad is not None:
-                        h._grad._set_data(h._grad._data + grads[k])
-                    else:
-                        if h._grad is None:
-                            h._grad = NDArray(jnp.zeros_like(h._data))
-                        h._grad._set_data(jnp.asarray(grads[k], dtype=h._data.dtype))
+                    _flush_grad(h, entry, grads[k])
 
     if not retain_graph:
         st.tape = []

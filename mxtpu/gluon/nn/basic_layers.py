@@ -193,18 +193,45 @@ class HybridLambda(HybridBlock):
 
 
 class Embedding(HybridBlock):
+    """Embedding lookup; ``sparse_grad=True`` records a row-sparse weight gradient
+    (gluon Embedding sparse_grad parity → lazy optimizer updates touch only the
+    batch's rows; see ndarray/sparse.py). The sparse path is imperative-only — a
+    hybridized block traces with the tape paused and falls back to dense grads."""
+
     def __init__(self, input_dim: int, output_dim: int, dtype="float32",
                  weight_initializer=None, sparse_grad: bool = False,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._input_dim, self._output_dim = input_dim, output_dim
+        self._sparse_grad = sparse_grad
         with self.name_scope():
             self.weight = self.params.get("weight", shape=(input_dim, output_dim),
-                                          dtype=dtype, init=weight_initializer)
+                                          dtype=dtype, init=weight_initializer,
+                                          grad_stype="row_sparse" if sparse_grad
+                                          else "default")
 
     def forward(self, x):
-        return nd.Embedding(x, self.weight.data(), input_dim=self._input_dim,
-                            output_dim=self._output_dim)
+        from ... import autograd
+        if not (self._sparse_grad and autograd.is_recording()):
+            return nd.Embedding(x, self.weight.data(), input_dim=self._input_dim,
+                                output_dim=self._output_dim)
+        import jax.numpy as jnp
+        from ...ndarray.ndarray import NDArray
+        from ...ndarray.sparse import RawRowSparse
+        w = self.weight.data()
+        ids = x.data.astype(jnp.int32)
+        out = NDArray(w.data[ids])
+        wshape, outdim = w.shape, self._output_dim
+
+        def backward_fn(saved, out_grads):
+            (g,) = out_grads
+            flat_ids = saved["ids"].reshape(-1)
+            flat_g = g.reshape(-1, outdim)
+            return [None, RawRowSparse(flat_ids, flat_g, wshape)]
+
+        autograd.record_custom_node(None, [x, w], [out], backward_fn=backward_fn,
+                                    saved={"ids": ids, "outs": [out.data]})
+        return out
 
 
 class BatchNorm(HybridBlock):

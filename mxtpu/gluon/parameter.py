@@ -138,8 +138,14 @@ class Parameter:
         self._data._set_data(src.data.astype(self._data.dtype).reshape(self._data.shape))
 
     def zero_grad(self):
-        if self._data is not None and self._data._grad is not None:
-            self._data._grad._set_data(jnp.zeros_like(self._data._grad.data))
+        if self._data is None or self._data._grad is None:
+            return
+        g = self._data._grad
+        if getattr(g, "stype", "default") == "row_sparse":
+            from ..ndarray import sparse as _sparse
+            self._data._grad = _sparse.zeros("row_sparse", g.shape, dtype=g.dtype)
+        else:
+            g._set_data(jnp.zeros_like(g.data))
 
     def reset_ctx(self, ctx):
         pass  # single logical device; sharding handles placement

@@ -83,8 +83,29 @@ class KVStore:
     def push(self, key, value, priority: int = 0):
         """Accumulate: list-of-values are reduced (Comm::Reduce parity, comm.h:103);
         in dist mode the reduced grad is all-reduced across workers."""
+        from .ndarray import sparse as _sparse
         keys, values = self._normalize_push(key, value)
         for k, vlist in zip(keys, values):
+            if any(getattr(v, "stype", "default") == "row_sparse" for v in vlist):
+                # sparse push (kvstore_dist.h:436 DataHandleRowSparse semantics):
+                # reduce the pushed row-sparse grads, keep them sparse through the
+                # updater so lazy optimizers touch only the live rows
+                red = vlist[0]
+                for v in vlist[1:]:
+                    red = _sparse.add(red, v)
+                if self._distributed and jax.process_count() > 1:
+                    from .parallel import collectives
+                    red = _sparse.RowSparseNDArray(
+                        red.indices.data,
+                        collectives.allreduce_array(red.data.data), red.shape)
+                if self._updater is not None:
+                    self._updater(k, red, self._store[k])
+                else:
+                    rows, vals = red.indices.data, red.data.data
+                    self._store[k] = NDArray(
+                        self._store[k].data.at[rows].set(
+                            vals.astype(self._store[k].dtype)))
+                continue
             red = vlist[0].data
             for v in vlist[1:]:
                 red = red + v.data
@@ -111,22 +132,31 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out=None, priority: int = 0, row_ids=None):
-        """Sparse pull (kvstore_dist.h:436): fetch only the requested rows.
+        """Sparse pull (kvstore_dist.h:436-510): fetch ONLY the requested rows.
 
-        Dense storage underneath (XLA-friendly); the *semantics* — pulling a subset of
-        rows identified by ``row_ids`` — are preserved for Embedding-style workflows.
+        If ``out`` is a RowSparseNDArray it receives exactly the deduped requested
+        rows (true sparse pull — O(|rows|) transfer, the capability the reference row
+        exists for); a dense ``out`` gets the rows scattered in place.
         """
+        import numpy as np
+        from .ndarray import sparse as _sparse
         if row_ids is None:
             return self.pull(key, out, priority)
         keys, outs = self._normalize_push(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(outs[0])
         for k, olist in zip(keys, outs):
             src = self._store[k]
-            for o, rid in zip(olist, rids):
-                rows = jnp.unique(rid.data.astype(jnp.int32),
-                                  size=min(rid.size, src.shape[0]))
+            for i, (o, rid) in enumerate(zip(olist, rids)):
+                rid_host = np.unique(np.asarray(
+                    rid.asnumpy() if hasattr(rid, "asnumpy") else rid).astype(
+                        np.int64).reshape(-1))
+                rows = jnp.asarray(rid_host, jnp.int32)
                 gathered = src.data[rows]
-                o._set_data(o.data.at[rows].set(gathered.astype(o.dtype)))
+                if getattr(o, "stype", "default") == "row_sparse":
+                    o._indices = rows
+                    o._values = gathered.astype(o.dtype)
+                else:
+                    o._set_data(o.data.at[rows].set(gathered.astype(o.dtype)))
 
     # -- updater / optimizer ----------------------------------------------
     def set_optimizer(self, optimizer):

@@ -73,3 +73,8 @@ def add_n(*args):
 
 
 ElementWiseSum = add_n
+
+
+# sparse sub-namespace (mx.nd.sparse parity)
+from . import sparse  # noqa: E402
+sys.modules[__name__ + ".sparse"] = sparse

@@ -123,7 +123,12 @@ class NDArray:
 
     @property
     def stype(self) -> str:
-        return "default"  # dense; sparse (row_sparse/csr) handled by sparse module
+        return "default"  # dense; row_sparse/csr live in ndarray.sparse
+
+    def tostype(self, stype: str):
+        """Convert storage type (mx.nd.NDArray.tostype parity)."""
+        from . import sparse as _sparse
+        return _sparse.cast_storage(self, stype)
 
     # -- sync -------------------------------------------------------------
     def wait_to_read(self):

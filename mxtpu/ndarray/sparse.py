@@ -1,0 +1,436 @@
+"""Sparse NDArrays — row_sparse + CSR, TPU-native.
+
+Capability parity with the reference's sparse storage types
+(``include/mxnet/ndarray.h:62-66`` kRowSparseStorage/kCSRStorage,
+``python/mxnet/ndarray/sparse.py``, ``src/operator/tensor/dot-inl.h`` sparse dot,
+``src/operator/tensor/cast_storage-inl.h``), re-designed for XLA:
+
+* A ``RowSparseNDArray`` holds ``indices`` (sorted unique int32 row ids) + ``values``
+  (``(nnz_rows, *row_shape)``). This is exactly the shape of an embedding gradient —
+  the dominant sparse workload — and maps to TPU-friendly gather/scatter +
+  ``segment_sum`` (no dynamic shapes inside a jit: nnz is a trace-time constant per
+  bucket, like the reference's per-batch kernel launches).
+* A ``CSRNDArray`` holds ``data``/``indices``/``indptr``; ``dot(csr, dense)`` lowers to
+  one ``segment_sum`` over expanded rows (MXU-adjacent: the inner product stays a
+  vectorized multiply), ``dot(csr, dense, transpose_a=True)`` produces a
+  ``RowSparseNDArray`` touching only the referenced columns — the sparse
+  backward-of-embedding/linear pattern (dot-inl.h DotCsrTransDnsRsp parity).
+* Gradients: ``RawRowSparse`` is the tape-level cotangent carrier; the autograd flush
+  materializes it as a ``RowSparseNDArray`` in ``param.grad`` so lazy optimizers
+  (optimizer.py:445 SGD lazy_update parity) touch only the live rows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype_np
+from ..context import Context
+from .ndarray import NDArray
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "BaseSparseNDArray", "RawRowSparse",
+           "row_sparse_array", "csr_matrix", "cast_storage", "dot", "retain",
+           "zeros", "add", "elemwise_add"]
+
+_INT = jnp.int32  # TPU-native index dtype (the reference uses int64 on host)
+
+
+class RawRowSparse:
+    """Tape-level row-sparse cotangent: (indices, values, dense shape).
+
+    Produced by sparse-grad backward rules; supports ``+`` so the autograd
+    accumulation loop composes sparse+sparse (concat, dedup deferred to
+    materialization) and sparse+dense (densify) without special cases.
+    """
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices
+        self.values = values
+        self.shape = tuple(shape)
+
+    def densify(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def __add__(self, other):
+        if isinstance(other, RawRowSparse):
+            return RawRowSparse(jnp.concatenate([self.indices, other.indices]),
+                                jnp.concatenate([self.values, other.values]),
+                                self.shape)
+        return self.densify() + other
+
+    __radd__ = __add__
+
+    def dedup(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Unique sorted rows + segment-summed values (eager: nnz is data-dependent)."""
+        idx_host = np.asarray(jax.device_get(self.indices))
+        uniq, inv = np.unique(idx_host, return_inverse=True)
+        vals = jax.ops.segment_sum(self.values, jnp.asarray(inv, _INT),
+                                   num_segments=len(uniq))
+        return jnp.asarray(uniq, _INT), vals
+
+
+class BaseSparseNDArray:
+    """Common surface of the sparse handle types (mx.nd.sparse parity)."""
+
+    stype = "undefined"
+
+    @property
+    def dtype(self):
+        return np.dtype(self._values.dtype)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._shape)) if self._shape else 0
+
+    @property
+    def context(self) -> Context:
+        return NDArray(self._values).context
+
+    ctx = context
+
+    @property
+    def grad(self):
+        return None
+
+    def wait_to_read(self):
+        jax.block_until_ready(self._values)
+        return self
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self._dense()))
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype else a
+
+    def astype(self, dtype):
+        out = self.copy()
+        out._values = out._values.astype(dtype_np(dtype))
+        return out
+
+    def tostype(self, stype: str):
+        return cast_storage(self, stype)
+
+    def todense(self) -> NDArray:
+        return NDArray(self._dense())
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {self._shape} "
+                f"dtype={self.dtype.name}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse: a subset of rows is stored; absent rows are zero.
+
+    ``.indices`` → NDArray of sorted unique row ids, ``.data`` → NDArray of the
+    stored rows (mx.nd.sparse.RowSparseNDArray surface).
+    """
+
+    stype = "row_sparse"
+
+    def __init__(self, indices, values, shape):
+        self._indices = jnp.asarray(
+            indices.data if isinstance(indices, NDArray) else indices, _INT)
+        self._values = jnp.asarray(
+            values.data if isinstance(values, NDArray) else values)
+        self._shape = tuple(int(s) for s in shape)
+        if self._values.ndim != len(self._shape):
+            raise ValueError(
+                f"row_sparse values ndim {self._values.ndim} != shape ndim "
+                f"{len(self._shape)} (values carry the full row shape)")
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices)
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._values)
+
+    @property
+    def num_rows(self) -> int:
+        return int(self._indices.shape[0])
+
+    def _dense(self):
+        out = jnp.zeros(self._shape, self._values.dtype)
+        return out.at[self._indices].set(self._values)
+
+    def copy(self) -> "RowSparseNDArray":
+        return RowSparseNDArray(jnp.array(self._indices, copy=True),
+                                jnp.array(self._values, copy=True), self._shape)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._indices, other._values = self._indices, self._values
+            return other
+        if isinstance(other, NDArray):
+            other._set_data(self._dense().astype(other.dtype))
+            return other
+        raise TypeError(f"copyto: unsupported target {type(other)}")
+
+    def retain(self, indices) -> "RowSparseNDArray":
+        return retain(self, indices)
+
+    def __add__(self, other):
+        return add(self, other)
+
+    __radd__ = __add__
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix: ``data``/``indices``/``indptr`` (2-D only)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self._values = jnp.asarray(data.data if isinstance(data, NDArray) else data)
+        self._indices = jnp.asarray(
+            indices.data if isinstance(indices, NDArray) else indices, _INT)
+        self._indptr = jnp.asarray(
+            indptr.data if isinstance(indptr, NDArray) else indptr, _INT)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("CSRNDArray is 2-D (reference cast_storage parity)")
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._values)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._indptr)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def _row_ids(self):
+        """Per-nonzero row id, from indptr (the CSR→COO expansion)."""
+        nnz = self.nnz
+        return jnp.asarray(
+            np.repeat(np.arange(self._shape[0]),
+                      np.diff(np.asarray(jax.device_get(self._indptr)))), _INT) \
+            if nnz else jnp.zeros((0,), _INT)
+
+    def _dense(self):
+        out = jnp.zeros(self._shape, self._values.dtype)
+        if self.nnz == 0:
+            return out
+        return out.at[self._row_ids(), self._indices].set(self._values)
+
+    def copy(self) -> "CSRNDArray":
+        return CSRNDArray(jnp.array(self._values, copy=True),
+                          jnp.array(self._indices, copy=True),
+                          jnp.array(self._indptr, copy=True), self._shape)
+
+    def asscipy(self):
+        import scipy.sparse as sps
+        return sps.csr_matrix(
+            (np.asarray(jax.device_get(self._values)),
+             np.asarray(jax.device_get(self._indices)),
+             np.asarray(jax.device_get(self._indptr))), shape=self._shape)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            start, stop, step = i.indices(self._shape[0])
+            if step != 1:
+                raise ValueError("csr slicing supports contiguous row ranges")
+            ptr = self._indptr[start:stop + 1]
+            lo, hi = int(ptr[0]), int(ptr[-1])
+            return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],
+                              ptr - lo, (stop - start, self._shape[1]))
+        raise TypeError("csr indexing supports row slices")
+
+
+# ---------------------------------------------------------------------------
+# constructors (mx.nd.sparse.row_sparse_array / csr_matrix parity)
+# ---------------------------------------------------------------------------
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    """From ``(data, indices)``, a dense array/NDArray, or another RowSparseNDArray."""
+    if isinstance(arg, RowSparseNDArray):
+        return arg.copy() if shape is None else RowSparseNDArray(
+            arg._indices, arg._values, shape)
+    if isinstance(arg, tuple) and len(arg) == 2:
+        values, indices = arg
+        values = jnp.asarray(np.asarray(values),
+                             dtype=dtype_np(dtype) if dtype else None)
+        if shape is None:
+            indices_np = np.asarray(indices)
+            nrows = int(indices_np.max()) + 1 if indices_np.size else 0
+            shape = (nrows,) + tuple(values.shape[1:])
+        return RowSparseNDArray(jnp.asarray(np.asarray(indices), _INT), values, shape)
+    # dense input
+    dense = arg.data if isinstance(arg, NDArray) else jnp.asarray(
+        np.asarray(arg), dtype=dtype_np(dtype) if dtype else None)
+    return _dense_to_rsp(dense)
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """From ``(data, indices, indptr)``, scipy.sparse, dense, or (data,(row,col))."""
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(arg):
+            m = arg.tocsr()
+            return CSRNDArray(m.data, m.indices, m.indptr, m.shape)
+    except ImportError:
+        pass
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        if shape is None:
+            raise ValueError("csr_matrix((data, indices, indptr)) requires shape=")
+        return CSRNDArray(jnp.asarray(np.asarray(data),
+                                      dtype=dtype_np(dtype) if dtype else None),
+                          np.asarray(indices), np.asarray(indptr), shape)
+    if isinstance(arg, tuple) and len(arg) == 2 and isinstance(arg[1], tuple):
+        data, (row, col) = arg
+        import scipy.sparse as sps
+        m = sps.coo_matrix((np.asarray(data), (np.asarray(row), np.asarray(col))),
+                           shape=shape).tocsr()
+        return CSRNDArray(m.data, m.indices, m.indptr, m.shape)
+    dense = arg.data if isinstance(arg, NDArray) else jnp.asarray(
+        np.asarray(arg), dtype=dtype_np(dtype) if dtype else None)
+    return _dense_to_csr(dense)
+
+
+def zeros(stype: str, shape, ctx=None, dtype="float32"):
+    """mx.nd.sparse.zeros parity: an empty sparse array."""
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    dt = dtype_np(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,), _INT),
+                                jnp.zeros((0,) + shape[1:], dt), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), _INT),
+                          jnp.zeros((shape[0] + 1,), _INT), shape)
+    if stype == "default":
+        return NDArray(jnp.zeros(shape, dt))
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+# ---------------------------------------------------------------------------
+# cast_storage (src/operator/tensor/cast_storage-inl.h parity)
+# ---------------------------------------------------------------------------
+
+
+def _dense_to_rsp(dense) -> RowSparseNDArray:
+    host = np.asarray(jax.device_get(dense))
+    nz_rows = np.nonzero(host.reshape(host.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(nz_rows, _INT),
+                            jnp.asarray(host[nz_rows]), host.shape)
+
+
+def _dense_to_csr(dense) -> CSRNDArray:
+    host = np.asarray(jax.device_get(dense))
+    if host.ndim != 2:
+        raise ValueError("cast_storage to csr requires a 2-D array")
+    rows, cols = np.nonzero(host)
+    indptr = np.zeros(host.shape[0] + 1, np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr)
+    return CSRNDArray(jnp.asarray(host[rows, cols]), jnp.asarray(cols, _INT),
+                      jnp.asarray(indptr, _INT), host.shape)
+
+
+def cast_storage(arr, stype: str):
+    """Convert between default/row_sparse/csr storage."""
+    cur = getattr(arr, "stype", "default")
+    if cur == stype:
+        return arr
+    if stype == "default":
+        return arr.todense()
+    dense = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr._dense())
+    if stype == "row_sparse":
+        return _dense_to_rsp(dense)
+    if stype == "csr":
+        return _dense_to_csr(dense)
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+# ---------------------------------------------------------------------------
+# sparse ops (dot-inl.h, sparse_retain, elemwise)
+# ---------------------------------------------------------------------------
+
+
+def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    """Sparse dot (mx.nd.sparse.dot parity, src/operator/tensor/dot-inl.h):
+
+    * ``dot(csr, dense)`` → dense — one ``segment_sum`` over the COO expansion.
+    * ``dot(csr, dense, transpose_a=True)`` → **row_sparse** touching only columns
+      referenced by the csr (DotCsrTransDnsRsp parity — the sparse-linear backward).
+    * dense×dense falls through to the registered dense op.
+    """
+    if isinstance(lhs, CSRNDArray):
+        if transpose_b:
+            raise NotImplementedError("dot(csr, dense, transpose_b=True)")
+        rhs_raw = rhs.data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        row_ids = lhs._row_ids()
+        if not transpose_a:
+            # out[i] = Σ_nz data * rhs[col]   (segment over row ids)
+            contrib = lhs._values[:, None] * rhs_raw[lhs._indices]
+            out = jax.ops.segment_sum(contrib, row_ids,
+                                      num_segments=lhs._shape[0])
+            return NDArray(out.astype(rhs_raw.dtype))
+        # transpose_a: out[col] += data * rhs[row]; only touched cols stored
+        contrib = lhs._values[:, None] * rhs_raw[row_ids]
+        raw = RawRowSparse(lhs._indices, contrib,
+                           (lhs._shape[1],) + tuple(rhs_raw.shape[1:]))
+        uniq, vals = raw.dedup()
+        return RowSparseNDArray(uniq, vals.astype(rhs_raw.dtype), raw.shape)
+    if isinstance(lhs, RowSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        raise NotImplementedError(
+            "sparse dot supports csr×dense (optionally transpose_a) — "
+            "densify other operand combinations explicitly with .todense()")
+    from ..ops import registry as _reg
+    return _reg.invoke(_reg.get_op("dot"), lhs, rhs, transpose_a=transpose_a,
+                       transpose_b=transpose_b)
+
+
+def retain(rsp: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """Keep only the requested rows (sparse_retain op parity)."""
+    want = np.asarray(indices.asnumpy() if hasattr(indices, "asnumpy")
+                      else indices).astype(np.int64)
+    have = np.asarray(jax.device_get(rsp._indices))
+    mask = np.isin(have, want)
+    keep = np.nonzero(mask)[0]
+    return RowSparseNDArray(rsp._indices[jnp.asarray(keep)],
+                            rsp._values[jnp.asarray(keep)], rsp._shape)
+
+
+def add(lhs, rhs):
+    """elemwise add: rsp+rsp → rsp; any dense operand → dense."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        if lhs._shape != rhs._shape:
+            raise ValueError(f"shape mismatch {lhs._shape} vs {rhs._shape}")
+        raw = RawRowSparse(jnp.concatenate([lhs._indices, rhs._indices]),
+                           jnp.concatenate([lhs._values, rhs._values]), lhs._shape)
+        uniq, vals = raw.dedup()
+        return RowSparseNDArray(uniq, vals, lhs._shape)
+    l = lhs._dense() if isinstance(lhs, BaseSparseNDArray) else (
+        lhs.data if isinstance(lhs, NDArray) else jnp.asarray(lhs))
+    r = rhs._dense() if isinstance(rhs, BaseSparseNDArray) else (
+        rhs.data if isinstance(rhs, NDArray) else jnp.asarray(rhs))
+    return NDArray(l + r)
+
+
+elemwise_add = add

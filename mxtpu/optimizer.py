@@ -124,7 +124,51 @@ class Optimizer:
             self._jitted[clipped] = jax.jit(stepfn, donate_argnums=(0,))
         return self._jitted[clipped]
 
+    # -- lazy (row-sparse) update -----------------------------------------
+    # Reference parity: optimizer.py:445 SGD lazy_update / sparse adam — only rows
+    # present in the row_sparse gradient are touched, including their optimizer
+    # state. On TPU this is one fused gather → kernel-on-rows → scatter program; the
+    # dense kernel is reused on the row slab, so every optimizer gets a lazy variant
+    # for free.
+    def _get_sparse_jitted(self, clipped: bool):
+        key = ("sparse", clipped)
+        if self._jitted is None:
+            self._jitted = {}
+        if key not in self._jitted:
+            def stepfn(w, rows, vals, lr, wd, rescale, clip, t, *st):
+                g = self._preprocess_grad(vals.astype(w.dtype), rescale,
+                                          clip if clipped else None)
+                w_rows = w[rows]
+                row_like = [getattr(s, "shape", None) == w.shape for s in st]
+                st_rows = [s[rows] if rl else s for s, rl in zip(st, row_like)]
+                out = self._kernel(w_rows, g, lr, wd, t, *st_rows)
+                new_rows, *new_st_rows = out if isinstance(out, tuple) else (out,)
+                new_w = w.at[rows].set(new_rows)
+                new_st = [s.at[rows].set(ns) if rl else ns
+                          for s, ns, rl in zip(st, new_st_rows, row_like)]
+                return (new_w, *new_st)
+            self._jitted[key] = jax.jit(stepfn, donate_argnums=(0,))
+        return self._jitted[key]
+
+    def _update_rowsparse(self, index, weight: NDArray, grad, state: Tuple) -> Tuple:
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clipped = self.clip_gradient is not None
+        clip = self.clip_gradient if clipped else 0.0
+        jitted = self._get_sparse_jitted(clipped)
+        dt = weight.data.dtype
+        out = jitted(weight.data, grad.indices.data, grad.data.data,
+                     jnp.asarray(lr, dt), jnp.asarray(wd, dt),
+                     jnp.asarray(self.rescale_grad, dt), jnp.asarray(clip, dt),
+                     t, *state)
+        new_w, *new_state = out if isinstance(out, tuple) else (out,)
+        weight._set_data(new_w)
+        return tuple(new_state)
+
     def update(self, index, weight: NDArray, grad: NDArray, state: Tuple) -> Tuple:
+        if getattr(grad, "stype", "default") == "row_sparse":
+            return self._update_rowsparse(index, weight, grad, state)
         self._update_count(index)
         t = self._index_update_count[index]
         lr, wd = self._get_lr(index), self._get_wd(index)

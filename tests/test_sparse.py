@@ -1,0 +1,230 @@
+"""Sparse end-to-end tests — scipy is the oracle (the reference's numpy-oracle
+strategy, SURVEY.md §4, applied to tests/python/unittest/test_sparse_*)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd, optimizer
+from mxtpu.gluon import nn
+from mxtpu.ndarray import sparse
+
+
+def _rand_dense(shape, density=0.3, seed=0):
+    rs = np.random.RandomState(seed)
+    m = rs.randn(*shape).astype(np.float32)
+    m[rs.rand(*shape) >= density] = 0
+    return m
+
+
+def test_row_sparse_roundtrip():
+    dense = _rand_dense((10, 4))
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    # (data, indices) constructor
+    rsp2 = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), [1, 4]), shape=(6, 3))
+    expect = np.zeros((6, 3), np.float32)
+    expect[[1, 4]] = 1
+    np.testing.assert_allclose(rsp2.asnumpy(), expect)
+    assert rsp2.indices.asnumpy().tolist() == [1, 4]
+
+
+def test_csr_roundtrip_scipy():
+    dense = _rand_dense((7, 9), seed=1)
+    ref = sps.csr_matrix(dense)
+    csr = sparse.csr_matrix(ref)
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    back = csr.asscipy()
+    np.testing.assert_allclose(back.toarray(), dense)
+    assert csr.nnz == ref.nnz
+
+
+def test_cast_storage_all_directions():
+    dense = _rand_dense((6, 5), seed=2)
+    x = nd.array(dense)
+    rsp = x.tostype("row_sparse")
+    csr = x.tostype("csr")
+    assert rsp.stype == "row_sparse" and csr.stype == "csr"
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    np.testing.assert_allclose(rsp.tostype("csr").asnumpy(), dense)
+    np.testing.assert_allclose(csr.tostype("row_sparse").asnumpy(), dense)
+    d2 = csr.tostype("default")
+    assert d2.stype == "default"
+    np.testing.assert_allclose(d2.asnumpy(), dense)
+    # rsp stores only non-zero rows
+    nz_rows = np.nonzero(dense.any(axis=1))[0]
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), nz_rows)
+
+
+def test_sparse_dot_csr_dense():
+    a = _rand_dense((5, 8), seed=3)
+    b = np.random.RandomState(4).randn(8, 6).astype(np.float32)
+    csr = sparse.csr_matrix(sps.csr_matrix(a))
+    out = sparse.dot(csr, nd.array(b))
+    assert out.stype == "default"
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_dot_transpose_a_returns_row_sparse():
+    a = _rand_dense((5, 8), seed=5)
+    b = np.random.RandomState(6).randn(5, 3).astype(np.float32)
+    csr = sparse.csr_matrix(sps.csr_matrix(a))
+    out = sparse.dot(csr, nd.array(b), transpose_a=True)
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5, atol=1e-5)
+    # only columns referenced by the csr appear as stored rows
+    touched = np.unique(sps.csr_matrix(a).indices)
+    assert set(out.indices.asnumpy()).issubset(set(touched))
+
+
+def test_retain():
+    rsp = sparse.row_sparse_array(
+        (np.arange(12, dtype=np.float32).reshape(4, 3), [0, 2, 5, 7]), shape=(9, 3))
+    kept = sparse.retain(rsp, [2, 7])
+    assert kept.indices.asnumpy().tolist() == [2, 7]
+    expect = np.zeros((9, 3), np.float32)
+    expect[2] = [3, 4, 5]
+    expect[7] = [9, 10, 11]
+    np.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_sparse_add():
+    a = sparse.row_sparse_array((np.ones((2, 2), np.float32), [1, 3]), shape=(5, 2))
+    b = sparse.row_sparse_array((np.full((2, 2), 2, np.float32), [3, 4]), shape=(5, 2))
+    c = sparse.add(a, b)
+    assert c.stype == "row_sparse"
+    assert c.indices.asnumpy().tolist() == [1, 3, 4]
+    np.testing.assert_allclose(c.asnumpy(), a.asnumpy() + b.asnumpy())
+    d = sparse.add(a, nd.array(np.ones((5, 2), np.float32)))
+    assert d.stype == "default"
+    np.testing.assert_allclose(d.asnumpy(), a.asnumpy() + 1)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.num_rows == 0
+    np.testing.assert_allclose(z.asnumpy(), 0)
+    zc = sparse.zeros("csr", (4, 3))
+    assert zc.nnz == 0
+    np.testing.assert_allclose(zc.asnumpy(), 0)
+
+
+def test_embedding_sparse_grad():
+    mx.rng.seed(0)
+    emb = nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize()
+    ids = nd.array(np.array([[1, 3], [3, 7]], np.float32))
+    with autograd.record():
+        out = emb(ids)
+        loss = nd.sum(out * out)
+    loss.backward()
+    g = emb.weight.grad()
+    assert g.stype == "row_sparse"
+    assert sorted(g.indices.asnumpy().tolist()) == [1, 3, 7]
+    # oracle: dense embedding gradient
+    emb_d = nn.Embedding(10, 4, sparse_grad=False)
+    emb_d.initialize()
+    emb_d.weight.set_data(emb.weight.data())
+    with autograd.record():
+        out = emb_d(ids)
+        loss = nd.sum(out * out)
+    loss.backward()
+    np.testing.assert_allclose(g.asnumpy(), emb_d.weight.grad().asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("optname,kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.1}),
+])
+def test_lazy_update_touches_only_live_rows(optname, kwargs):
+    """Lazy semantics (optimizer.py:445): rows absent from the row_sparse grad keep
+    their weight AND state; present rows match the dense kernel on those rows."""
+    rs = np.random.RandomState(0)
+    w0 = rs.randn(8, 3).astype(np.float32)
+    rows = np.array([1, 4, 6])
+    vals = rs.randn(3, 3).astype(np.float32)
+
+    w_sparse = nd.array(w0.copy())
+    opt_s = optimizer.create(optname, wd=0.01, **kwargs)
+    st_s = opt_s.create_state(0, w_sparse)
+    g_sparse = sparse.row_sparse_array((vals, rows), shape=(8, 3))
+    st_s = opt_s.update(0, w_sparse, g_sparse, st_s)
+
+    # dense oracle on the same rows (untouched rows get zero grad AND no update)
+    w_dense = nd.array(w0.copy())
+    opt_d = optimizer.create(optname, wd=0.01, **kwargs)
+    st_d = opt_d.create_state(0, w_dense)
+    gd = np.zeros((8, 3), np.float32)
+    gd[rows] = vals
+    opt_d.update(0, w_dense, nd.array(gd), st_d)
+
+    out = w_sparse.asnumpy()
+    np.testing.assert_allclose(out[rows], w_dense.asnumpy()[rows],
+                               rtol=1e-5, atol=1e-6)
+    untouched = np.setdiff1d(np.arange(8), rows)
+    # lazy: untouched rows are bit-identical to the original (no wd decay applied)
+    np.testing.assert_array_equal(out[untouched], w0[untouched])
+
+
+def test_trainer_sparse_embedding_end_to_end():
+    """Embedding-LM style step: only the batch's rows move (the riskiest-parity-item
+    acceptance test from SURVEY §7)."""
+    mx.rng.seed(1)
+    net = nn.HybridSequential()
+    emb = nn.Embedding(50, 8, sparse_grad=True)
+    net.add(emb, nn.Dense(4, in_units=8, flatten=False))
+    net.initialize()
+    w_before = emb.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9}, kvstore=None)
+    ids = nd.array(np.array([[2, 9, 2], [17, 9, 31]], np.float32))
+    y = nd.array(np.zeros((2, 3), np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = nd.mean(loss_fn(net(ids), y))
+    loss.backward()
+    assert emb.weight.grad().stype == "row_sparse"
+    trainer.step(1)
+    w_after = emb.weight.data().asnumpy()
+    batch_rows = [2, 9, 17, 31]
+    other = np.setdiff1d(np.arange(50), batch_rows)
+    np.testing.assert_array_equal(w_after[other], w_before[other])
+    assert np.abs(w_after[batch_rows] - w_before[batch_rows]).max() > 1e-6
+
+
+def test_kvstore_row_sparse_pull_sparse_out():
+    kv = mx.kvstore.create("local")
+    w = np.arange(20, dtype=np.float32).reshape(10, 2)
+    kv.init("emb", nd.array(w))
+    out = sparse.zeros("row_sparse", (10, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([3.0, 7.0, 3.0]))
+    assert out.indices.asnumpy().tolist() == [3, 7]
+    np.testing.assert_allclose(out.data.asnumpy(), w[[3, 7]])
+
+
+def test_kvstore_sparse_push_with_updater():
+    kv = mx.kvstore.create("local")
+    kv.init("w", nd.array(np.ones((6, 2), np.float32)))
+    seen = {}
+
+    def updater(key, grad, weight):
+        seen["stype"] = grad.stype
+        rows, vals = grad.indices.data, grad.data.data
+        weight._set_data(weight.data.at[rows].add(-vals))
+
+    kv._set_updater(updater)
+    g1 = sparse.row_sparse_array((np.ones((1, 2), np.float32), [2]), shape=(6, 2))
+    g2 = sparse.row_sparse_array((np.ones((1, 2), np.float32), [4]), shape=(6, 2))
+    kv.push("w", [g1, g2])
+    assert seen["stype"] == "row_sparse"
+    out = nd.zeros((6, 2))
+    kv.pull("w", out=out)
+    expect = np.ones((6, 2), np.float32)
+    expect[[2, 4]] = 0
+    np.testing.assert_allclose(out.asnumpy(), expect)
