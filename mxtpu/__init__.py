@@ -33,7 +33,7 @@ from .ndarray import NDArray
 import importlib as _importlib
 
 _SUBSYSTEMS = ["initializer", "optimizer", "lr_scheduler", "metric", "callback",
-               "io", "recordio", "kvstore", "gluon", "module", "parallel",
+               "io", "recordio", "kvstore", "symbol", "gluon", "module", "parallel",
                "profiler", "test_utils", "model", "image", "visualization"]
 for _name in _SUBSYSTEMS:
     try:
@@ -44,6 +44,9 @@ for _name in _SUBSYSTEMS:
 
 if "kvstore" in globals():
     kv = globals()["kvstore"]
+if "symbol" in globals():
+    sym = globals()["symbol"]
+    Symbol = sym.Symbol
 if "module" in globals():
     mod = globals()["module"]
     Module = mod.Module

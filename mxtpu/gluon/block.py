@@ -331,13 +331,101 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Reference SymbolBlock wraps an exported symbol graph; here a saved callable."""
+    """Gluon block over a Symbol graph (block.py:950 SymbolBlock parity).
 
-    def __init__(self, fn: Callable, params: Sequence[Parameter] = (), prefix=None):
+    ``outputs`` is a Symbol (or list → Group); ``inputs`` names the free variables
+    fed by ``forward(*args)``; every other argument becomes a Parameter (exact
+    symbol name, deferred shape completed by ``infer_shape`` at first forward).
+    Forward evaluates the DAG on raw arrays and records ONE tape node whose replay
+    closure reuses the forward's resolved RNG/flag state — the same single-node
+    contract the CachedOp path uses (autograd.record_custom_node).
+    """
+
+    def __init__(self, outputs, inputs, params=None, prefix=None):
         super().__init__(prefix=prefix)
-        self._fn = fn
-        for p in params:
-            self._params._params[p.name] = p
+        from ..symbol import Group, Symbol
+        from ..symbol.symbol import _AUX_PARAMS  # noqa: F401 (doc pointer)
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        self._sym = outputs
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._input_names = [i if isinstance(i, str) else i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        self._sym_param_names = [n for n in arg_names
+                                 if n not in self._input_names] + aux_names
+        given = dict(params.items()) if params is not None else {}
+        for n in self._sym_param_names:
+            if n in given:
+                self._params._params[n] = given[n]
+            else:
+                self._params._params[n] = Parameter(
+                    n, shape=None, allow_deferred_init=True,
+                    grad_req="null" if n in aux_names else "write")
+        self._shapes_done = False
+
+    @staticmethod
+    def imports(symbol_file: str, input_names, param_file: Optional[str] = None,
+                ctx=None):
+        """Load an exported (symbol-json, params) pair (SymbolBlock.imports parity)."""
+        from .. import symbol as sym_mod
+        from .. import ndarray as nd_mod
+        net = SymbolBlock(sym_mod.load(symbol_file), input_names)
+        if param_file is not None:
+            loaded = nd_mod.load(param_file)
+            for name, arr in loaded.items():
+                short = name.split(":", 1)[1] if ":" in name else name
+                if short in net._params._params:
+                    p = net._params._params[short]
+                    p.shape = tuple(arr.shape)
+                    p._init_impl(p.init or "zeros", None)
+                    p.set_data(arr)
+        return net
+
+    def _complete_shapes(self, args):
+        from ..symbol.symbol import _req_of  # noqa: F401
+        shapes = {n: tuple(a.shape) for n, a in zip(self._input_names, args)}
+        arg_shapes, _, aux_shapes = self._sym.infer_shape(**shapes)
+        arg_names = self._sym.list_arguments()
+        aux_names = self._sym.list_auxiliary_states()
+        for n, s in list(zip(arg_names, arg_shapes)) + \
+                list(zip(aux_names, aux_shapes)):
+            if n in self._params._params and s is not None:
+                p = self._params._params[n]
+                if p._data is None:
+                    p._finish_deferred_init(s)
+                    if p._data is None:  # initialize() never called on the block
+                        p.shape = tuple(s)
+                        p.initialize()
+        self._shapes_done = True
 
     def forward(self, *args):
-        return self._fn(*args)
+        from .. import autograd
+        from ..symbol.symbol import eval_graph
+        if not self._shapes_done:
+            self._complete_shapes(args)
+        param_handles = [self._params._params[n].data()
+                         for n in self._sym_param_names]
+        names = self._input_names + self._sym_param_names
+        feed = {n: a.data for n, a in
+                zip(names, list(args) + param_handles)}
+        resolved: dict = {}
+        aux_updates: dict = {}
+        is_train = autograd.is_training()
+        with autograd.pause(train_mode=is_train):
+            outs_raw = eval_graph(self._sym._heads, feed, is_train,
+                                  aux_updates=aux_updates, resolved=resolved)
+        outs = [NDArray(o) for o in outs_raw]
+        if autograd.is_recording():
+            heads = self._sym._heads
+
+            def pure_fn(*raws):
+                feed2 = dict(zip(names, raws))
+                res = eval_graph(heads, feed2, is_train, resolved=resolved)
+                return tuple(res) if len(res) > 1 else res[0]
+
+            autograd.record_custom_node(pure_fn, list(args) + param_handles, outs)
+        for name, new in aux_updates.items():
+            if name in self._params._params:
+                self._params._params[name].data()._set_data(new)
+        return outs[0] if len(outs) == 1 else tuple(outs)

@@ -15,11 +15,19 @@ from .ndarray.ndarray import NDArray
 
 def save_checkpoint(prefix: str, epoch: int, symbol=None, arg_params: Dict = None,
                     aux_params: Dict = None, remove_amp_cast: bool = True):
-    """``prefix-symbol.json`` + ``prefix-####.params`` layout parity (model.py:384)."""
+    """``prefix-symbol.json`` + ``prefix-####.params`` layout parity (model.py:384).
+
+    A real Symbol serializes its graph (Symbol.tojson) and round-trips through
+    ``load_checkpoint`` → ``Module(symbol)``; non-symbol blocks store a descriptor
+    (their graph is re-traced from code; jit.export_stablehlo is the portable form).
+    """
     if symbol is not None:
         with open(f"{prefix}-symbol.json", "w") as f:
-            json.dump({"framework": "mxtpu", "block": type(symbol).__name__,
-                       "repr": repr(symbol)}, f)
+            if hasattr(symbol, "tojson"):
+                f.write(symbol.tojson())
+            else:
+                json.dump({"framework": "mxtpu", "block": type(symbol).__name__,
+                           "repr": repr(symbol)}, f)
     payload = {}
     for k, v in (arg_params or {}).items():
         payload[f"arg:{k}"] = v
@@ -34,7 +42,12 @@ def load_checkpoint(prefix: str, epoch: int):
     sym_file = f"{prefix}-symbol.json"
     if os.path.exists(sym_file):
         with open(sym_file) as f:
-            symbol = json.load(f)
+            raw = f.read()
+        try:
+            from .symbol import load_json
+            symbol = load_json(raw)
+        except Exception:
+            symbol = json.loads(raw)  # legacy block descriptor
     loaded = nd.load(f"{prefix}-{epoch:04d}.params")
     arg_params, aux_params = {}, {}
     for k, v in loaded.items():

@@ -158,13 +158,27 @@ def _as_list(x):
 class Module(BaseModule):
     """Module over a Block (module.py:40 Module-over-Symbol parity)."""
 
-    def __init__(self, block: Block, data_names: Sequence[str] = ("data",),
+    def __init__(self, block, data_names: Sequence[str] = ("data",),
                  label_names: Sequence[str] = ("softmax_label",), logger=logging,
                  context=None, loss=None):
         super().__init__(logger)
-        self._block = block
         self._data_names = list(data_names)
         self._label_names = list(label_names or [])
+        self._symbolic = False
+        self._symbol_obj = None
+        from .symbol import Symbol
+        if isinstance(block, Symbol):
+            # Module-over-Symbol (module.py:40 native case): wrap the graph in a
+            # SymbolBlock; data + any label arguments are graph inputs, the
+            # loss-fused head (SoftmaxOutput et al.) owns the backward semantics.
+            from .gluon.block import SymbolBlock
+            self._symbol_obj = block
+            args = block.list_arguments()
+            self._sym_inputs = [n for n in self._data_names if n in args] + \
+                [n for n in self._label_names if n in args]
+            block = SymbolBlock(block, self._sym_inputs)
+            self._symbolic = True
+        self._block = block
         self._context = context
         from .gluon.loss import SoftmaxCrossEntropyLoss
         self._loss = loss if loss is not None else SoftmaxCrossEntropyLoss()
@@ -175,7 +189,7 @@ class Module(BaseModule):
 
     @property
     def symbol(self):
-        return self._block
+        return self._symbol_obj if self._symbolic else self._block
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -193,8 +207,16 @@ class Module(BaseModule):
         if self.params_initialized and not force_init:
             return
         self._block.initialize(init=initializer, force_reinit=force_init)
-        # run one forward on zeros to complete deferred shapes (all declared inputs)
+        # run one forward on zeros to complete deferred shapes (all declared inputs;
+        # symbolic graphs also need their label arguments fed)
         dummies = [nd.zeros(tuple(d.shape)) for d in self._data_shapes]
+        if self._symbolic:
+            by_name = {d.name: tuple(d.shape)
+                       for d in list(self._data_shapes) +
+                       list(self._label_shapes or [])}
+            dummies = [nd.zeros(by_name[n]) if n in by_name
+                       else nd.zeros(dummies[0].shape[:1])
+                       for n in self._sym_inputs]
         with autograd.predict_mode():
             self._block(*dummies)
         if arg_params:
@@ -246,12 +268,23 @@ class Module(BaseModule):
         label = data_batch.label[0] if data_batch.label else None
         self._batch_size = data[0].shape[0]
         is_train = self._for_training if is_train is None else is_train
+        if self._symbolic:
+            # feed label args too; absent labels get zeros (forward output of a
+            # loss-fused head does not depend on the label)
+            n_label = len(self._sym_inputs) - len(data)
+            extra = [label] * n_label if label is not None else \
+                [nd.zeros((self._batch_size,))] * n_label
+            data = data + extra
         if is_train:
             with autograd.record():
                 out = self._block(*data)
                 self._outputs = [out] if isinstance(out, NDArray) else list(out)
-                if label is not None:
+                if label is not None and not self._symbolic:
                     self._loss_val = self._loss(self._outputs[0], label)
+                elif self._symbolic:
+                    # the loss-fused head injects its own gradient; backward seeds
+                    # the output with ones (GraphExecutor::Backward parity)
+                    self._loss_val = None
         else:
             with autograd.predict_mode():
                 out = self._block(*data)
@@ -259,7 +292,10 @@ class Module(BaseModule):
             self._loss_val = None
 
     def backward(self, out_grads=None):
-        if self._loss_val is not None:
+        if self._symbolic:
+            autograd.backward(list(self._outputs),
+                              list(out_grads) if out_grads is not None else None)
+        elif self._loss_val is not None:
             autograd.backward([self._loss_val])
 
     def update(self):
@@ -270,6 +306,8 @@ class Module(BaseModule):
         # classification modules output probabilities (SoftmaxOutput-symbol parity);
         # other losses pass raw outputs through
         from .gluon.loss import SoftmaxCrossEntropyLoss
+        if self._symbolic:
+            return list(self._outputs)  # loss-fused heads already emit probabilities
         if self._outputs and isinstance(self._loss, SoftmaxCrossEntropyLoss):
             return [self._outputs[0].softmax()] + self._outputs[1:]
         return list(self._outputs)
@@ -283,7 +321,7 @@ class Module(BaseModule):
     def save_checkpoint(self, prefix: str, epoch: int, save_optimizer_states=False):
         from .model import save_checkpoint
         arg, aux = self.get_params()
-        save_checkpoint(prefix, epoch, None, arg, aux)
+        save_checkpoint(prefix, epoch, self._symbol_obj, arg, aux)
         if save_optimizer_states and self._trainer is not None:
             self._trainer.save_states(f"{prefix}-{epoch:04d}.states")
 

@@ -186,3 +186,45 @@ def _smooth_l1(data, scalar: float = 1.0):
     s2 = scalar * scalar
     a = jnp.abs(data)
     return jnp.where(a < 1.0 / s2, 0.5 * s2 * data * data, a - 0.5 / s2)
+
+
+# scalar-operand internal ops (reference _plus_scalar family, elemwise_binary_scalar_op*
+# — the symbolic frontend encodes the scalar as an attr, so these must be real ops)
+@register("_plus_scalar")
+def _plus_scalar(data, scalar: float = 0.0):
+    return data + scalar
+
+
+@register("_minus_scalar")
+def _minus_scalar(data, scalar: float = 0.0):
+    return data - scalar
+
+
+@register("_rminus_scalar")
+def _rminus_scalar(data, scalar: float = 0.0):
+    return scalar - data
+
+
+@register("_mul_scalar")
+def _mul_scalar(data, scalar: float = 1.0):
+    return data * scalar
+
+
+@register("_div_scalar")
+def _div_scalar(data, scalar: float = 1.0):
+    return data / scalar
+
+
+@register("_rdiv_scalar")
+def _rdiv_scalar(data, scalar: float = 1.0):
+    return scalar / data
+
+
+@register("_power_scalar")
+def _power_scalar(data, scalar: float = 1.0):
+    return jnp.power(data, scalar)
+
+
+@register("_rpower_scalar")
+def _rpower_scalar(data, scalar: float = 1.0):
+    return jnp.power(scalar, data)

@@ -1,0 +1,147 @@
+"""Executor — Symbol binding (mx.executor parity).
+
+Capability parity with ``include/mxnet/executor.h`` Forward/Backward/Bind/SimpleBind
+and ``python/mxnet/executor.py``. The reference's GraphExecutor machinery (Gradient
+pass, PlaceDevice, PlanMemory, op-executor attach, engine push — graph_executor.cc)
+collapses: forward is one topological evaluation of registry ops (XLA compiles and
+fuses per op; the hybridized path in jit.py is the whole-graph compile), backward is
+ONE ``jax.vjp`` over the same evaluation — loss-fused heads (SoftmaxOutput) keep the
+reference's custom backward via their ``jax.custom_vjp`` rules.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..ndarray.ndarray import NDArray
+from .symbol import Symbol, eval_graph, _req_of
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol: Symbol, ctx, arg_dict: Dict[str, NDArray],
+                 aux_dict: Dict[str, NDArray], grad_dict: Dict[str, NDArray],
+                 grad_req="write"):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = {k: v if isinstance(v, NDArray) else NDArray(v)
+                         for k, v in arg_dict.items()}
+        self.aux_dict = {k: v if isinstance(v, NDArray) else NDArray(v)
+                         for k, v in aux_dict.items()}
+        self.grad_dict = {k: v if isinstance(v, NDArray) else NDArray(v)
+                          for k, v in grad_dict.items()}
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._grad_req = {n: _req_of(grad_req, n, self._arg_names)
+                          for n in self._arg_names}
+        self.outputs: List[NDArray] = []
+        self._is_train = False
+        self._resolved: Optional[dict] = None
+
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def output_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def forward(self, is_train: bool = False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                self.arg_dict[k] = v if isinstance(v, NDArray) else NDArray(v)
+            else:
+                self.arg_dict[k]._set_data(
+                    v.data if isinstance(v, NDArray) else jnp.asarray(v))
+        self._is_train = is_train
+        self._resolved = {}  # fresh RNG/flag resolution per step; backward replays it
+        feed = {n: a.data for n, a in self.arg_dict.items()}
+        feed.update({n: a.data for n, a in self.aux_dict.items()})
+        aux_updates: dict = {}
+        scope = autograd.train_mode() if is_train else autograd.predict_mode()
+        with scope, autograd.pause(train_mode=is_train):
+            outs = eval_graph(self._symbol._heads, feed, is_train,
+                              aux_updates=aux_updates, resolved=self._resolved)
+        for name, new in aux_updates.items():
+            self.aux_dict[name]._set_data(new)
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """One jax.vjp over the whole bound graph, accumulated per grad_req."""
+        live = [n for n in self._arg_names if self._grad_req[n] != "null"]
+        if not live:
+            return
+        if self._resolved is None:
+            raise RuntimeError("backward before forward")
+        fixed = {n: self.arg_dict[n].data for n in self._arg_names
+                 if n not in live}
+        fixed.update({n: a.data for n, a in self.aux_dict.items()})
+        heads, is_train, resolved = (self._symbol._heads, self._is_train,
+                                     self._resolved)
+
+        def pure(vals):
+            feed = dict(fixed)
+            feed.update(zip(live, vals))
+            return tuple(eval_graph(heads, feed, is_train, resolved=resolved))
+
+        with autograd.pause(train_mode=is_train):
+            outs, vjp_fn = jax.vjp(pure, [self.arg_dict[n].data for n in live])
+            if out_grads is None:
+                cots = tuple(jnp.ones_like(o) for o in outs)
+            else:
+                og = out_grads if isinstance(out_grads, (list, tuple)) \
+                    else [out_grads]
+                cots = tuple(
+                    jnp.asarray(g.data if isinstance(g, NDArray) else g,
+                                dtype=o.dtype)
+                    for g, o in zip(og, outs))
+            (grads,) = vjp_fn(cots)
+        for name, g in zip(live, grads):
+            req = self._grad_req[name]
+            tgt = self.grad_dict.get(name)
+            if tgt is None:
+                tgt = self.grad_dict[name] = NDArray(jnp.zeros_like(g))
+            if req == "add":
+                tgt._set_data(tgt.data + g)
+            else:
+                tgt._set_data(g.astype(tgt.dtype))
+
+    def copy_params_from(self, arg_params: Dict, aux_params: Optional[Dict] = None,
+                         allow_extra_params: bool = False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v.data if isinstance(v, NDArray) else jnp.asarray(v))
+            elif not allow_extra_params:
+                raise ValueError(f"unknown argument {k!r}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set_data(
+                    v.data if isinstance(v, NDArray) else jnp.asarray(v))
+            elif not allow_extra_params:
+                raise ValueError(f"unknown aux state {k!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes (executor.py reshape parity): shape
+        inference reruns; param arrays are kept."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = dict(self.arg_dict)
+        for n, s in zip(self._arg_names, arg_shapes):
+            if s is not None and n in kwargs:
+                new_args[n] = NDArray(jnp.zeros(s, jnp.float32))
+        return Executor(self._symbol, self._ctx, new_args, dict(self.aux_dict),
+                        dict(self.grad_dict), self._grad_req)

@@ -1,0 +1,678 @@
+"""Symbol — the declarative graph frontend (mx.sym parity).
+
+Capability parity with ``python/mxnet/symbol/symbol.py``: Variable/op composition,
+``infer_shape``/``infer_type`` (:841-1021), ``bind``/``simple_bind`` (:1288,1552),
+JSON save/load (``tojson`` :1218, load :2549-2582), Group, get_internals.
+
+Re-design for this stack: the reference Symbol wraps an NNVM graph handle and its
+passes (InferShape/InferType as C++ graph passes, GraphExecutor for binding). Here a
+Symbol is a small Python DAG over the SAME op registry the imperative layer uses:
+
+* shape/type inference = a topological walk that calls ``jax.eval_shape`` per node
+  (XLA's abstract evaluation IS the InferShape pass) plus per-op *parameter shape
+  rules* for the learnable inputs the reference infers backwards (conv weight from
+  data channels etc. — the only genuinely bidirectional part of nnvm's pass);
+* ``bind`` returns an Executor that evaluates the DAG on raw jax arrays (forward) and
+  differentiates it with one ``jax.vjp`` (backward) — the GraphExecutor's Gradient +
+  PlanMemory + engine-push machinery collapses into XLA;
+* loss-fused heads (SoftmaxOutput) keep their reference backward semantics because
+  the registered ops already carry ``jax.custom_vjp`` rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype_np, dtype_name
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson"]
+
+# aux-state parameter names (reference: op-declared mutable inputs; BatchNorm's
+# moving stats are the only instance in the op corpus)
+_AUX_PARAMS = {"moving_mean", "moving_var"}
+
+_name_lock = threading.Lock()
+_name_counters: Dict[str, int] = {}
+
+
+def _auto_name(base: str) -> str:
+    with _name_lock:
+        n = _name_counters.get(base, 0)
+        _name_counters[base] = n + 1
+    return f"{base}{n}"
+
+
+def _reset_names():  # test helper (NameManager parity)
+    with _name_lock:
+        _name_counters.clear()
+
+
+class _Node:
+    """One DAG node: a variable (op_key None) or an op application."""
+
+    __slots__ = ("op_key", "name", "attrs", "inputs", "input_params", "is_aux",
+                 "num_outputs")
+
+    def __init__(self, op_key, name, attrs=None, inputs=(), input_params=(),
+                 is_aux=False, num_outputs=1):
+        self.op_key = op_key
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs)           # [(node, out_idx)]
+        self.input_params = list(input_params)  # param name per input; "*" varargs
+        self.is_aux = is_aux
+        self.num_outputs = num_outputs
+
+
+def _tensor_params(op) -> List[str]:
+    """Which signature params of an op fn are tensor inputs (vs attrs)."""
+    out = []
+    for p in inspect.signature(op.fn).parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            out.append("*")
+        elif p.kind == inspect.Parameter.POSITIONAL_OR_KEYWORD and (
+                p.default is inspect.Parameter.empty
+                or p.name in ("bias", "gamma", "beta", "moving_mean", "moving_var",
+                              "weight", "label")):
+            out.append(p.name)
+    return out
+
+
+def _topo(heads) -> List[_Node]:
+    seen, order = set(), []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for child, _ in node.inputs:
+            visit(child)
+        order.append(node)
+
+    for node, _ in heads:
+        visit(node)
+    return order
+
+
+# ---------------------------------------------------------------------------
+# parameter shape rules — the "backward" half of InferShape
+# (reference: per-op FInferShape filling unknown arg shapes, e.g.
+# src/operator/nn/convolution.cc ConvolutionShape)
+# ---------------------------------------------------------------------------
+
+
+def _fc_rule(ins, attrs):
+    d = ins["data"]
+    nh = int(attrs.get("num_hidden", 0))
+    in_units = int(np.prod(d[1:])) if attrs.get("flatten", True) else d[-1]
+    return {"weight": (nh, in_units), "bias": (nh,)}
+
+
+def _conv_rule(ins, attrs):
+    d = ins["data"]
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs.get("kernel", ()))
+    return {"weight": (nf, d[1] // ng) + kernel, "bias": (nf,)}
+
+
+def _deconv_rule(ins, attrs):
+    d = ins["data"]
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs.get("kernel", ()))
+    return {"weight": (d[1], nf // ng) + kernel, "bias": (nf,)}
+
+
+def _norm_rule(ins, attrs):
+    c = ins["data"][attrs.get("axis", 1)]
+    return {k: (c,) for k in ("gamma", "beta", "moving_mean", "moving_var")}
+
+
+def _ln_rule(ins, attrs):
+    c = ins["data"][attrs.get("axis", -1)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _embedding_rule(ins, attrs):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+def _softmax_output_rule(ins, attrs):
+    d = ins["data"]
+    return {"label": d[:-1] if len(d) > 1 else d}
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_rule,
+    "Convolution": _conv_rule,
+    "Deconvolution": _deconv_rule,
+    "BatchNorm": _norm_rule,
+    "InstanceNorm": _ln_rule,
+    "LayerNorm": _ln_rule,
+    "Embedding": _embedding_rule,
+    "SoftmaxOutput": _softmax_output_rule,
+    "LinearRegressionOutput": _softmax_output_rule,
+    "LogisticRegressionOutput": _softmax_output_rule,
+    "MAERegressionOutput": _softmax_output_rule,
+}
+
+
+# ---------------------------------------------------------------------------
+# graph evaluation (shared by Executor and SymbolBlock)
+# ---------------------------------------------------------------------------
+
+
+def eval_graph(heads, feed: Dict[str, Any], is_train: bool = False,
+               aux_updates: Optional[dict] = None,
+               resolved: Optional[dict] = None):
+    """Topologically evaluate the DAG on raw arrays.
+
+    ``resolved`` caches per-node resolved attrs (RNG keys, training flags) so a
+    backward vjp replay sees the identical program as the forward pass.
+    ``aux_updates`` (name → new value) collects BatchNorm moving-stat updates — the
+    reference mutates aux NDArrays inside the op; here the executor owns the write.
+    """
+    cache: Dict[int, tuple] = {}
+
+    def ev(node: _Node):
+        got = cache.get(id(node))
+        if got is not None:
+            return got
+        if node.op_key is None:
+            if node.name not in feed:
+                raise ValueError(f"eval_graph: no value bound for argument "
+                                 f"{node.name!r}")
+            out = (feed[node.name],)
+            cache[id(node)] = out
+            return out
+        op = _reg.get_op(node.op_key)
+        var_args, kw = [], {}
+        for (child, idx), pname in zip(node.inputs, node.input_params):
+            val = ev(child)[idx]
+            if pname == "*":
+                var_args.append(val)
+            else:
+                kw[pname] = val
+        attrs = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+        if node.op_key == "BatchNorm" and is_train \
+                and not attrs.get("use_global_stats", False):
+            res, mean, v = _reg.get_op("batch_norm_train").fn(
+                kw["data"], kw["gamma"], kw["beta"],
+                eps=attrs.get("eps", 1e-3),
+                fix_gamma=attrs.get("fix_gamma", True),
+                axis=attrs.get("axis", 1))
+            if aux_updates is not None:
+                mom = attrs.get("momentum", 0.9)
+                for pname, new in (("moving_mean", mean), ("moving_var", v)):
+                    i = node.input_params.index(pname)
+                    aux_node = node.inputs[i][0]
+                    aux_updates[aux_node.name] = mom * kw[pname] + (1 - mom) * new
+            out = (res,)
+        else:
+            if op.resolve_kwargs is not None:
+                if resolved is not None and id(node) in resolved:
+                    attrs = resolved[id(node)]
+                else:
+                    attrs = op.resolve_kwargs(attrs)
+                    if resolved is not None:
+                        resolved[id(node)] = attrs
+            res = op.fn(*var_args, **kw, **attrs)
+            out = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+        cache[id(node)] = out
+        return out
+
+    return [ev(node)[idx] for node, idx in heads]
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+
+
+class Symbol:
+    """One or more DAG heads (a Group is just a multi-head Symbol)."""
+
+    __slots__ = ("_heads",)
+
+    def __init__(self, heads):
+        self._heads = list(heads)
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self) -> Optional[str]:
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def __repr__(self):
+        names = ", ".join(n.name for n, _ in self._heads)
+        return f"<Symbol {names}>"
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self.list_outputs())))
+
+    # -- graph views -------------------------------------------------------
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in _topo(self._heads)
+                if n.op_key is None and not n.is_aux]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in _topo(self._heads) if n.op_key is None and n.is_aux]
+
+    def list_outputs(self) -> List[str]:
+        out = []
+        for node, idx in self._heads:
+            suffix = "" if node.num_outputs == 1 else str(idx)
+            out.append(f"{node.name}_output{suffix}" if node.op_key is not None
+                       else node.name)
+        return out
+
+    def list_inputs(self) -> List[str]:
+        return self.list_arguments() + self.list_auxiliary_states()
+
+    def get_internals(self) -> "Symbol":
+        heads = []
+        for node in _topo(self._heads):
+            for i in range(max(1, node.num_outputs)):
+                heads.append((node, i))
+        return Symbol(heads)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node, _ = self._heads[0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError(f"no output named {index!r}; have {names}")
+            index = names.index(index)
+        return Symbol([self._heads[index]])
+
+    # -- attrs -------------------------------------------------------------
+    def attr(self, key: str):
+        v = self._heads[0][0].attrs.get(key)
+        return None if v is None else str(v)
+
+    def list_attr(self) -> Dict[str, str]:
+        return {k: str(v) for k, v in self._heads[0][0].attrs.items()
+                if not k.startswith("__")}
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        return {n.name: {k: str(v) for k, v in n.attrs.items()}
+                for n in _topo(self._heads) if n.attrs}
+
+    # -- inference -----------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes) (symbol.py:841 parity).
+
+        Known shapes are given as kwargs ``name=shape``; unknown learnable-input
+        shapes are derived by per-op parameter rules + ``jax.eval_shape``.
+        """
+        if args:
+            kwargs.update(zip(self.list_arguments(), args))
+        known: Dict[str, tuple] = {}
+        for node in _topo(self._heads):
+            if node.op_key is None and node.attrs.get("__shape__") is not None:
+                known[node.name] = tuple(node.attrs["__shape__"])
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        memo: Dict[int, tuple] = {}
+
+        def shapes_of(node: _Node):
+            got = memo.get(id(node))
+            if got is not None:
+                return got
+            if node.op_key is None:
+                if node.name not in known:
+                    return None
+                out = (known[node.name],)
+                memo[id(node)] = out
+                return out
+            op = _reg.get_op(node.op_key)
+            in_shapes: Dict[str, tuple] = {}
+            var_shapes: List[tuple] = []
+            unknown: List[tuple] = []
+            for (child, idx), pname in zip(node.inputs, node.input_params):
+                s = shapes_of(child)
+                if s is None:
+                    if child.op_key is None:
+                        unknown.append((pname, child))
+                        in_shapes[pname] = None
+                    else:
+                        return None
+                elif pname == "*":
+                    var_shapes.append(s[idx])
+                else:
+                    in_shapes[pname] = s[idx]
+            if unknown:
+                rule = _PARAM_SHAPE_RULES.get(node.op_key)
+                if rule is None:
+                    raise ValueError(
+                        f"infer_shape: cannot infer shape of "
+                        f"{[c.name for _, c in unknown]} for op {node.op_key} "
+                        f"(no parameter rule; declare the shape on the Variable)")
+                derived = rule({k: v for k, v in in_shapes.items()
+                                if v is not None}, node.attrs)
+                for pname, child in unknown:
+                    if pname not in derived:
+                        raise ValueError(f"infer_shape: rule for {node.op_key} "
+                                         f"cannot derive {pname!r}")
+                    known[child.name] = tuple(int(x) for x in derived[pname])
+                    memo[id(child)] = (known[child.name],)
+                    in_shapes[pname] = known[child.name]
+            attrs = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+            if op.resolve_kwargs is not None:
+                attrs = op.resolve_kwargs(attrs)
+
+            def f(*va, **kw):
+                return op.fn(*va, **kw, **attrs)
+
+            structs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in var_shapes]
+            kw_structs = {k: jax.ShapeDtypeStruct(v, jnp.float32)
+                          for k, v in in_shapes.items() if v is not None}
+            res = jax.eval_shape(f, *structs, **kw_structs)
+            out = tuple(tuple(r.shape) for r in res) \
+                if isinstance(res, (tuple, list)) else (tuple(res.shape),)
+            memo[id(node)] = out
+            return out
+
+        out_shapes = []
+        for node, idx in self._heads:
+            s = shapes_of(node)
+            if s is None:
+                return None, None, None
+            out_shapes.append(s[idx])
+        arg_shapes = [known.get(n) for n in self.list_arguments()]
+        aux_shapes = [known.get(n) for n in self.list_auxiliary_states()]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """All-float32 default typing (the registry ops are dtype-polymorphic;
+        mixed-precision symbolic typing is driven by the executor's array dtypes)."""
+        n_args = len(self.list_arguments())
+        return ([np.float32] * n_args,
+                [np.float32] * len(self._heads),
+                [np.float32] * len(self.list_auxiliary_states()))
+
+    # -- binding -------------------------------------------------------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        return Executor(self, ctx, dict(args or {}), dict(aux_states or {}),
+                        dict(args_grad or {}), grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None, **kwargs):
+        """Infer shapes from the given input shapes and allocate all arrays
+        (symbol.py:1552 parity)."""
+        from ..ndarray.ndarray import NDArray
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names, aux_names = self.list_arguments(), self.list_auxiliary_states()
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes or []) if s is None]
+            raise ValueError(f"simple_bind: could not infer shapes for {missing}")
+        args = {n: NDArray(jnp.zeros(s, jnp.float32))
+                for n, s in zip(arg_names, arg_shapes)}
+        auxs = {n: NDArray(jnp.zeros(s, jnp.float32))
+                for n, s in zip(aux_names, aux_shapes)}
+        grads = {n: NDArray(jnp.zeros(s, jnp.float32))
+                 for n, s in zip(arg_names, arg_shapes)
+                 if _req_of(grad_req, n, arg_names) != "null"}
+        return self.bind(ctx, args, grads, grad_req, auxs)
+
+    def eval(self, ctx=None, **kwargs):
+        """One-shot evaluation with named NDArray inputs (symbol.py eval parity)."""
+        from ..ndarray.ndarray import NDArray
+        feed = {k: (v.data if isinstance(v, NDArray) else jnp.asarray(v))
+                for k, v in kwargs.items()}
+        outs = eval_graph(self._heads, feed)
+        return [NDArray(o) for o in outs]
+
+    # -- gradient ------------------------------------------------------------
+    def gradient(self, wrt: Sequence[str]):
+        raise NotImplementedError(
+            "Symbol.gradient: bind an executor and call backward() — gradients "
+            "come from jax.vjp, there is no separate grad graph to return")
+
+    # -- serialization -------------------------------------------------------
+    def tojson(self) -> str:
+        nodes = _topo(self._heads)
+        index = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            out_nodes.append({
+                "op": n.op_key if n.op_key is not None else "null",
+                "name": n.name,
+                "attrs": {k: repr(v) for k, v in n.attrs.items()},
+                "inputs": [[index[id(c)], i] for c, i in n.inputs],
+                "param_names": list(n.input_params),
+                "is_aux": n.is_aux,
+                "num_outputs": n.num_outputs,
+            })
+        payload = {
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.op_key is None],
+            "heads": [[index[id(n)], i] for n, i in self._heads],
+            "attrs": {"mxtpu_version": "1", "format": "mxtpu-symbol-json"},
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- operator overloads --------------------------------------------------
+    def _scalar_op(self, op_name, scalar):
+        return _apply_op(_reg.get_op(op_name), op_name, (self,),
+                         {"scalar": float(scalar)})
+
+    def _binary_op(self, op_name, other, rop_name=None):
+        if isinstance(other, Symbol):
+            return _apply_op(_reg.get_op(op_name), op_name, (self, other), {})
+        raise TypeError(f"unsupported operand {type(other)}")
+
+    def __add__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_plus_scalar", other)
+        return self._binary_op("broadcast_add", other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_minus_scalar", other)
+        return self._binary_op("broadcast_sub", other)
+
+    def __rsub__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_rminus_scalar", other)
+        return NotImplemented
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_mul_scalar", other)
+        return self._binary_op("broadcast_mul", other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_div_scalar", other)
+        return self._binary_op("broadcast_div", other)
+
+    def __rtruediv__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_rdiv_scalar", other)
+        return NotImplemented
+
+    def __pow__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_power_scalar", other)
+        return self._binary_op("broadcast_power", other)
+
+    def __neg__(self):
+        return self._scalar_op("_mul_scalar", -1.0)
+
+
+def _req_of(grad_req, name, arg_names):
+    if isinstance(grad_req, str):
+        return grad_req
+    if isinstance(grad_req, dict):
+        return grad_req.get(name, "null")
+    return dict(zip(arg_names, grad_req)).get(name, "null")
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+
+def Variable(name: str, attr=None, shape=None, dtype=None, init=None,
+             stype=None, **kwargs) -> Symbol:
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = dtype_name(dtype_np(dtype))
+    node = _Node(None, name, attrs)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def _apply_op(op, op_key: str, sym_args: Sequence[Symbol], attrs: dict,
+              name: Optional[str] = None) -> Symbol:
+    """Create an op node from positional Symbol inputs + attr kwargs."""
+    base = {"SoftmaxOutput": "softmax"}.get(op_key, op_key.lower().lstrip("_"))
+    name = name or _auto_name(base)
+    tparams = _tensor_params(op)
+    inputs, input_params = [], []
+    if tparams and tparams[0] == "*":
+        for s in sym_args:
+            inputs.append(s._heads[0])
+            input_params.append("*")
+    else:
+        for pname, s in zip(tparams, sym_args):
+            inputs.append(s._heads[0])
+            input_params.append(pname)
+    n_out = op.num_outputs if op.num_outputs > 0 else \
+        int(attrs.get("num_outputs", 1))
+    node = _Node(op_key, name, attrs, inputs, input_params, num_outputs=n_out)
+    if n_out == 1:
+        return Symbol([(node, 0)])
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def make_op_wrapper(op_key: str):
+    """Build the mx.sym.<Op> composition wrapper: Symbol inputs positionally or by
+    parameter name; missing learnable inputs become auto-named Variables
+    (reference: sym.Convolution auto-creates convN_weight/convN_bias)."""
+    op = _reg.get_op(op_key)
+    tparams = _tensor_params(op)
+
+    def wrapper(*args, name: Optional[str] = None, attr=None, **kwargs):
+        sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        attrs = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, Symbol) and v is not None}
+        base = {"SoftmaxOutput": "softmax"}.get(op_key,
+                                                op_key.lower().lstrip("_"))
+        name = name or _auto_name(base)
+        inputs, input_params = [], []
+        if tparams and tparams[0] == "*":
+            seq = list(args) or [sym_kwargs[k] for k in sorted(sym_kwargs)]
+            for s in seq:
+                inputs.append(s._heads[0])
+                input_params.append("*")
+        else:
+            supplied = dict(zip(tparams, args))
+            supplied.update(sym_kwargs)
+            for pname in tparams:
+                if pname in supplied:
+                    inputs.append(supplied[pname]._heads[0])
+                    input_params.append(pname)
+                    continue
+                if pname == "bias" and (attrs.get("no_bias", False)):
+                    continue
+                if pname == "data":
+                    raise ValueError(f"sym.{op_key}: 'data' input required")
+                node = _Node(None, f"{name}_{pname}",
+                             is_aux=pname in _AUX_PARAMS)
+                inputs.append((node, 0))
+                input_params.append(pname)
+        n_out = op.num_outputs if op.num_outputs > 0 else \
+            int(attrs.get("num_outputs", 1))
+        node = _Node(op_key, name, dict(attr or {}, **attrs), inputs,
+                     input_params, num_outputs=n_out)
+        if n_out == 1:
+            return Symbol([(node, 0)])
+        return Symbol([(node, i) for i in range(n_out)])
+
+    wrapper.__name__ = op_key
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# JSON load
+# ---------------------------------------------------------------------------
+
+
+def load_json(json_str: str) -> Symbol:
+    payload = json.loads(json_str)
+    if payload.get("attrs", {}).get("format") != "mxtpu-symbol-json":
+        raise ValueError("not an mxtpu symbol json (reference-format graphs must "
+                         "be re-exported from this framework)")
+    nodes: List[_Node] = []
+    for spec in payload["nodes"]:
+        attrs = {k: _parse_attr(v) for k, v in spec.get("attrs", {}).items()}
+        node = _Node(None if spec["op"] == "null" else spec["op"], spec["name"],
+                     attrs, is_aux=spec.get("is_aux", False),
+                     num_outputs=spec.get("num_outputs", 1))
+        node.inputs = [(nodes[i], j) for i, j in spec.get("inputs", [])]
+        node.input_params = list(spec.get("param_names", []))
+        nodes.append(node)
+    heads = [(nodes[i], j) for i, j in payload["heads"]]
+    return Symbol(heads)
+
+
+fromjson = load_json
+
+
+def _parse_attr(v: str):
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
