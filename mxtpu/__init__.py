@@ -17,6 +17,14 @@ Top-level layout mirrors the ``mx.*`` namespaces:
 * ``mxtpu.parallel`` — device meshes, collectives, sharded training (TPU-first, new)
 """
 
+import os as _os
+
+# pod bring-up MUST precede any backend-initializing import (see mxtpu/dist.py);
+# reference parity: ps-lite InitPSEnv runs at library load (kvstore.h:257)
+if _os.environ.get("DMLC_NUM_WORKER", "1") not in ("", "0", "1"):
+    from . import dist as _dist
+    _dist.auto_initialize()
+
 from .base import __version__
 from . import base
 from . import context

@@ -1,0 +1,88 @@
+"""Multi-process bring-up — the TPU-native replacement for ps-lite role bootstrap
+(``include/mxnet/kvstore.h:257 InitPSEnv``, ``python/mxnet/kvstore_server.py``).
+
+The reference starts scheduler/server/worker processes wired by DMLC_* env vars and
+speaks ZMQ push/pull. Here every process is a *worker* peer: ``jax.distributed``
+connects them to one coordinator, after which cross-process reduction is an XLA
+collective over DCN/ICI (no server role exists — the "server" was only ever the
+reduction + updater, which dist-mode KVStore runs identically on every rank).
+
+Env contract (reference DMLC names kept for launcher parity, tools/launch.py):
+  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT — coordinator host/port
+  DMLC_NUM_WORKER                      — number of processes
+  DMLC_WORKER_ID                       — this process's rank
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["initialize", "auto_initialize", "is_initialized", "rank", "size",
+           "shutdown"]
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized or jax.process_count() > 1
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Connect this process to the pod (jax.distributed.initialize wrapper)."""
+    global _initialized
+    if _initialized:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def auto_initialize() -> bool:
+    """Initialize from the DMLC_* env contract if present; returns whether this is
+    a multi-process run.
+
+    Runs at ``import mxtpu`` (InitPSEnv-at-lib-load parity) so it executes BEFORE
+    any XLA backend initialization — jax.distributed.initialize rejects later
+    calls. Also called defensively by dist-type KVStore construction."""
+    global _initialized
+    if _initialized:
+        return True
+    n = os.environ.get("DMLC_NUM_WORKER")
+    if n is not None and int(n) > 1 \
+            and os.environ.get("DMLC_ROLE", "worker") == "worker":
+        uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+        wid = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        try:
+            initialize(f"{uri}:{port}", int(n), wid)
+        except RuntimeError as e:
+            if jax.process_count() > 1:
+                _initialized = True  # someone else already connected the pod
+                return True
+            raise RuntimeError(
+                "mxtpu.dist: DMLC_* env set but the XLA backend was initialized "
+                "before the pod connection — import mxtpu (or call "
+                "dist.auto_initialize) before any jax computation") from e
+        return True
+    return jax.process_count() > 1
+
+
+def rank() -> int:
+    return jax.process_index()
+
+
+def size() -> int:
+    return jax.process_count()
+
+
+def shutdown():
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False

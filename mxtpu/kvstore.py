@@ -41,6 +41,10 @@ class KVStore:
         kv_type = {"nccl": "tpu", "device": "tpu"}.get(kv_type, kv_type)
         if kv_type.startswith("dist"):
             self._distributed = True
+            # connect the pod if the launcher's DMLC_* env contract is present
+            # (tools/launch.py local mode; InitPSEnv parity kvstore.h:257)
+            from . import dist as dist_mod
+            dist_mod.auto_initialize()
         elif kv_type in ("local", "local_allreduce_cpu", "local_allreduce_device",
                          "tpu"):
             self._distributed = False
@@ -69,7 +73,7 @@ class KVStore:
         if self._distributed and jax.process_count() > 1:
             # a tiny psum over all processes is the canonical XLA barrier
             from .parallel import collectives
-            collectives.barrier()
+            collectives.process_barrier()
 
     # -- data --------------------------------------------------------------
     def init(self, key, value):
@@ -94,10 +98,12 @@ class KVStore:
                 for v in vlist[1:]:
                     red = _sparse.add(red, v)
                 if self._distributed and jax.process_count() > 1:
+                    # cross-worker row-sparse reduce (DataHandleRowSparse parity):
+                    # ranks may hold different rows — densify local, allreduce,
+                    # re-sparsify to the union of rows
                     from .parallel import collectives
-                    red = _sparse.RowSparseNDArray(
-                        red.indices.data,
-                        collectives.allreduce_array(red.data.data), red.shape)
+                    dense = collectives.allreduce_processes(red._dense())
+                    red = _sparse.cast_storage(NDArray(dense), "row_sparse")
                 if self._updater is not None:
                     self._updater(k, red, self._store[k])
                 else:
@@ -111,7 +117,7 @@ class KVStore:
                 red = red + v.data
             if self._distributed and jax.process_count() > 1:
                 from .parallel import collectives
-                red = collectives.allreduce_array(red)
+                red = collectives.allreduce_processes(red)
             if self._compression_params is not None:
                 red = self._compress(k, red)
             if self._updater is not None:

@@ -9,8 +9,10 @@ parallelism (ring attention) lives in ``ring_attention``.
 from . import collectives
 from . import mesh
 from .collectives import (all_gather, all_to_all, allgather_array, allreduce,
-                          allreduce_array, barrier, broadcast_array, pmean, ppermute,
-                          psum, reduce_scatter, reduce_scatter_array)
+                          allreduce_array, allreduce_processes, barrier,
+                          broadcast_array, broadcast_processes, pmean, ppermute,
+                          process_barrier, psum, reduce_scatter,
+                          reduce_scatter_array)
 from .data_parallel import DataParallelTrainer, replicate, shard_batch
 from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, get_default_mesh,
                    make_mesh, set_default_mesh)

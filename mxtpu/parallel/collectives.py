@@ -119,3 +119,57 @@ def barrier(mesh: Optional[Mesh] = None):
     out = allreduce_array(jnp.ones(()), mesh)
     jax.block_until_ready(out)
     return float(out)
+
+
+# -- cross-process collectives (kvstore dist_sync backbone) -----------------
+# The reference's worker→server push/pull (kvstore_dist.h, ps-lite/ZMQ) becomes one
+# XLA collective over a process-spanning mesh: each process contributes its local
+# value on a leading "proc" axis; the reduction rides DCN/ICI.
+
+def _process_mesh() -> Mesh:
+    import numpy as np
+    devs = jax.devices()
+    nproc = jax.process_count()
+    per = len(devs) // nproc
+    # one device per process is enough for host-value reduction
+    picked = [d for d in devs if d.id % per == 0] if per > 1 else devs
+    return Mesh(np.array(picked[:nproc]), ("proc",))
+
+
+def allreduce_processes(x, op: str = "sum"):
+    """Reduce a per-process host value across ALL processes; returns a host-local
+    array every rank can read (dist_sync push semantics, kvstore_dist_server.h:283)."""
+    import numpy as np
+    nproc = jax.process_count()
+    xs = jnp.asarray(x)
+    if nproc == 1:
+        return xs
+    mesh = _process_mesh()
+    sh = NamedSharding(mesh, P("proc"))
+    arr = jax.make_array_from_process_local_data(
+        sh, np.asarray(jax.device_get(xs))[None])
+
+    @partial(jax.jit, out_shardings=NamedSharding(mesh, P()))
+    def _sum(a):
+        s = jnp.sum(a, axis=0)
+        return s / nproc if op == "mean" else s
+
+    out = _sum(arr)
+    jax.block_until_ready(out)
+    return jnp.asarray(jax.device_get(out))
+
+
+def broadcast_processes(x, root: int = 0):
+    """Every rank receives root's value (ps-lite init-broadcast parity)."""
+    import numpy as np
+    if jax.process_count() == 1:
+        return jnp.asarray(x)
+    xs = np.asarray(jax.device_get(jnp.asarray(x)))
+    contrib = xs if jax.process_index() == root else np.zeros_like(xs)
+    return allreduce_processes(contrib)
+
+
+def process_barrier():
+    """Block until every process arrives (ps::Postoffice::Barrier parity)."""
+    out = allreduce_processes(jnp.ones(()))
+    return float(out)

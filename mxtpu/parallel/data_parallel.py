@@ -24,20 +24,40 @@ from .mesh import Mesh, get_default_mesh
 __all__ = ["shard_batch", "replicate", "DataParallelTrainer"]
 
 
+def _place(raw, sharding: NamedSharding):
+    """Host→mesh placement that works in both single- and multi-process runs.
+
+    Multi-process (jax.distributed): a process can only device_put to its own
+    devices, so each rank contributes its LOCAL slice and JAX assembles the global
+    array (the SPMD per-host-feed convention; replaces the reference's per-worker
+    batch slicing in executor_group.py:281-310)."""
+    import jax.numpy as _jnp
+    raw = _jnp.asarray(raw)
+    if jax.process_count() > 1 and any(
+            not d.process_index == jax.process_index()
+            for d in sharding.mesh.devices.flat):
+        import numpy as np
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(jax.device_get(raw)))
+    return jax.device_put(raw, sharding)
+
+
 def shard_batch(array, mesh: Optional[Mesh] = None, axis: int = 0) -> NDArray:
     """Place a host batch as a dp-sharded jax.Array (≈ decide_slices/_split_input_slice,
-    executor_group.py:281-310 — but one logical array, no per-device copies)."""
+    executor_group.py:281-310 — but one logical array, no per-device copies).
+
+    Multi-process: ``array`` is this rank's LOCAL batch shard."""
     mesh = mesh or get_default_mesh()
     spec = [None] * (array.ndim if hasattr(array, "ndim") else len(array.shape))
     spec[axis] = mesh.axis_names[0]
     raw = array.data if isinstance(array, NDArray) else jnp.asarray(array)
-    return NDArray(jax.device_put(raw, NamedSharding(mesh, P(*spec))))
+    return NDArray(_place(raw, NamedSharding(mesh, P(*spec))))
 
 
 def replicate(array, mesh: Optional[Mesh] = None) -> NDArray:
     mesh = mesh or get_default_mesh()
     raw = array.data if isinstance(array, NDArray) else jnp.asarray(array)
-    return NDArray(jax.device_put(raw, NamedSharding(mesh, P())))
+    return NDArray(_place(raw, NamedSharding(mesh, P())))
 
 
 class DataParallelTrainer:
@@ -93,15 +113,14 @@ class DataParallelTrainer:
         self._param_sh = [NamedSharding(self.mesh, self._spec_for(n))
                           for n in self._param_names]
         for p, sh in zip(self._param_handles, self._param_sh):
-            p._data._set_data(jax.device_put(p.data().data, sh))
+            p._data._set_data(_place(p.data().data, sh))
         for p in self._aux_handles:
-            p._data._set_data(jax.device_put(p.data().data,
-                                             NamedSharding(self.mesh, P())))
+            p._data._set_data(_place(p.data().data, NamedSharding(self.mesh, P())))
         repl = NamedSharding(self.mesh, P())
         self._states = [self.optimizer.create_state(i, p.data())
                         for i, p in enumerate(self._param_handles)]
         # optimizer state follows its param's sharding (same-shape moments etc.)
-        self._states = [tuple(jax.device_put(
+        self._states = [tuple(_place(
             s, sh if getattr(s, "shape", None) == p.data().shape else repl)
             for s in st)
             for p, sh, st in zip(self._param_handles, self._param_sh, self._states)]

@@ -1,0 +1,82 @@
+"""Multi-process worker for the dist_sync tests — the reference's
+``tests/nightly/dist_sync_kvstore.py`` (:36-62 consistency checks) re-imagined.
+
+Launched by tools/launch.py with 2 workers × 4 virtual CPU devices. Checks:
+  1. dist_sync kvstore push/pull: every rank sees the sum of all ranks' pushes.
+  2. row_sparse push across ranks holding different rows.
+  3. barrier.
+  4. DataParallelTrainer over the process-spanning dp mesh: per-rank local batches,
+     identical losses and parameters on every rank after steps.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+# env set by tools/launch.py. The sitecustomize pins JAX_PLATFORMS=axon, so force
+# cpu via the config BEFORE mxtpu's import-time pod bring-up initializes a backend.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxtpu as mx
+from mxtpu import autograd, dist, gluon, nd, optimizer, parallel
+from mxtpu.gluon import nn
+from mxtpu.ndarray import sparse
+
+dist.auto_initialize()
+rank, size = dist.rank(), dist.size()
+assert size == 2, f"expected 2 processes, got {size}"
+assert len(jax.devices()) == 8, len(jax.devices())
+
+kv = mx.kvstore.create("dist_sync")
+assert kv.rank == rank and kv.num_workers == 2
+
+# --- 1. dense push/pull consistency ---------------------------------------
+kv.init("w", nd.array(np.zeros((4, 3), np.float32)))
+kv.push("w", nd.array(np.full((4, 3), float(rank + 1), np.float32)))
+out = nd.zeros((4, 3))
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), 3.0)  # 1 + 2 summed across ranks
+
+# --- 2. row_sparse push: ranks hold different rows -------------------------
+kv2 = mx.kvstore.create("dist_sync")
+kv2.init("emb", nd.array(np.zeros((6, 2), np.float32)))
+got = {}
+kv2._set_updater(lambda k, g, w: got.__setitem__("g", g))
+rows = [0, 2] if rank == 0 else [2, 5]
+g = sparse.row_sparse_array((np.ones((2, 2), np.float32), rows), shape=(6, 2))
+kv2.push("emb", g)
+gred = got["g"]
+assert gred.stype == "row_sparse", gred
+expect = np.zeros((6, 2), np.float32)
+expect[[0, 5]] = 1
+expect[2] = 2
+np.testing.assert_allclose(gred.asnumpy(), expect)
+
+# --- 3. barrier ------------------------------------------------------------
+kv.barrier()
+
+# --- 4. DataParallelTrainer over process-spanning mesh ---------------------
+mesh = parallel.make_mesh((8,), ("dp",))
+mx.rng.seed(0)
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(2, in_units=16))
+net.initialize(init=mx.initializer.Xavier())
+dpt = parallel.DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                   optimizer.SGD(learning_rate=0.1), mesh)
+rs = np.random.RandomState(7)  # same stream on both ranks; split per rank below
+X = rs.randn(16, 8).astype(np.float32)
+y = (X.sum(1) > 0).astype(np.float32)
+lo, hi = (0, 8) if rank == 0 else (8, 16)
+losses = [dpt.step(nd.array(X[lo:hi]), nd.array(y[lo:hi])) for _ in range(3)]
+# every rank must see the identical global loss and identical params
+all_losses = parallel.allreduce_processes(np.asarray(losses, np.float32), op="mean")
+np.testing.assert_allclose(np.asarray(all_losses), np.asarray(losses), rtol=1e-5)
+for p in net.collect_params().values():
+    local = p.data().asnumpy()
+    avg = parallel.allreduce_processes(local, op="mean")
+    np.testing.assert_allclose(np.asarray(avg), local, rtol=1e-5, atol=1e-6)
+
+print(f"DIST_WORKER_OK rank={rank}", flush=True)

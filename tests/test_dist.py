@@ -1,0 +1,27 @@
+"""Multi-process dist_sync test — launches 2 real worker processes on this host via
+tools/launch.py (the reference's dmlc-tracker `--launcher local` tier,
+tests/nightly/dist_sync_kvstore.py)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dist_sync_two_processes():
+    worker = os.path.join(ROOT, "tests", "dist", "dist_worker.py")
+    launcher = os.path.join(ROOT, "tools", "launch.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}  # workers get their own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, launcher, "-n", "2", "--devices-per-worker", "4",
+         sys.executable, worker],
+        capture_output=True, text=True, timeout=280, env=env, cwd=ROOT)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert out.count("DIST_WORKER_OK") == 2, out[-4000:]

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Multi-process launcher — parity with the reference's ``tools/launch.py``
+(dmlc-tracker local mode, launch.py:1-80).
+
+Spawns ``-n`` worker processes on this host with the DMLC_* env contract that
+``mxtpu.dist.auto_initialize`` consumes (DMLC_PS_ROOT_URI/PORT, DMLC_NUM_WORKER,
+DMLC_WORKER_ID). There is no server/scheduler role: rank 0's port doubles as the
+jax.distributed coordinator, and "server-side" reduction is an XLA collective on
+every rank (see mxtpu/dist.py). ssh/mpi/yarn launchers are out of scope — multi-host
+pods should use the platform's pod launcher with the same env contract.
+
+Usage:
+  python tools/launch.py -n 2 [--devices-per-worker 4] python train.py ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(num_workers: int, command, devices_per_worker: int = 0,
+           env_extra=None) -> int:
+    port = _free_port()
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_WORKER_ID": str(rank),
+        })
+        if devices_per_worker:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count="
+                                f"{devices_per_worker}").strip()
+            env["JAX_PLATFORMS"] = "cpu"
+        env.update(env_extra or {})
+        procs.append(subprocess.Popen(list(command), env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    if rc:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--devices-per-worker", type=int, default=0,
+                    help="force N virtual CPU devices per worker (testing)")
+    ap.add_argument("--launcher", default="local", choices=["local"],
+                    help="only local (single-host multi-process) is supported")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    sys.exit(launch(args.num_workers, args.command, args.devices_per_worker))
+
+
+if __name__ == "__main__":
+    main()
