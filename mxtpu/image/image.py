@@ -203,18 +203,48 @@ class ImageIter:
     def __init__(self, batch_size: int, data_shape, label_width: int = 1,
                  path_imgrec: Optional[str] = None, path_imglist: Optional[str] = None,
                  path_root: str = "", shuffle: bool = False, aug_list=None,
-                 imglist=None, **kwargs):
+                 imglist=None, preprocess_threads: int = 4, **kwargs):
         from ..io import DataBatch, DataDesc
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
-        self.auglist = aug_list if aug_list is not None else CreateAugmenter(
-            (batch_size,) + self.data_shape, **{k: v for k, v in kwargs.items()
-                                               if k in ("resize", "rand_crop",
-                                                        "rand_mirror", "mean", "std")})
+        # CreateAugmenter takes the (C, H, W) sample shape, NOT batch-prefixed
+        # (crop_size reads indices [2], [1] as (W, H) — image.py:1248 parity)
+        self._fused_norm = None
+        if aug_list is None:
+            mean, std = kwargs.get("mean"), kwargs.get("std")
+            from .. import native
+            if (mean is not None or std is not None) and native.available():
+                # native fast path: keep the aug chain on uint8 HWC and do the
+                # cast+normalize+CHW transpose as ONE threaded C kernel over the
+                # batch (iter_image_recordio_2.cc fused copy loop parity)
+                self.auglist = [a for a in CreateAugmenter(
+                    self.data_shape, **{k: v for k, v in kwargs.items()
+                                        if k in ("resize", "rand_crop",
+                                                 "rand_mirror")})
+                    if not isinstance(a, CastAug)]
+                self._fused_norm = (None if mean is None
+                                    else np.asarray(mean, np.float32),
+                                    None if std is None
+                                    else np.asarray(std, np.float32))
+            else:
+                self.auglist = CreateAugmenter(
+                    self.data_shape, **{k: v for k, v in kwargs.items()
+                                        if k in ("resize", "rand_crop",
+                                                 "rand_mirror", "mean", "std")})
+        else:
+            self.auglist = aug_list
+        # decode/augment thread pool (OMP preprocess_threads parity — PIL decode
+        # releases the GIL, so host decode parallelizes across the pool)
+        self._pool = None
+        if preprocess_threads and preprocess_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
         self._items = []
         if path_imgrec:
+            import threading
             from ..gluon.data import RecordFileDataset
             self._rec = RecordFileDataset(path_imgrec)
+            self._rec_lock = threading.Lock()  # file reads serialize; decode doesn't
             self._items = list(range(len(self._rec)))
             self._mode = "rec"
         elif imglist is not None:
@@ -235,7 +265,9 @@ class ImageIter:
     def _read(self, idx):
         from .. import recordio
         if self._mode == "rec":
-            header, payload = recordio.unpack(self._rec[idx])
+            with self._rec_lock:  # seek+read on the shared handle serializes
+                raw = self._rec[idx]
+            header, payload = recordio.unpack(raw)
             img = imdecode(payload)
             label = header.label
         else:
@@ -252,20 +284,26 @@ class ImageIter:
         from ..io import DataBatch
         if self._cursor >= len(self._items):
             raise StopIteration
-        imgs, labels = [], []
-        pad = 0
-        for i in range(self.batch_size):
-            if self._cursor + i < len(self._items):
-                img, label = self._read(self._items[self._cursor + i])
-                arr = img.asnumpy().astype(np.float32)
-                imgs.append(arr.transpose(2, 0, 1))
-                labels.append(label)
-            else:
-                pad += 1
-                imgs.append(imgs[-1])
-                labels.append(labels[-1])
+        take = self._items[self._cursor:self._cursor + self.batch_size]
+        pad = self.batch_size - len(take)
+        take = take + [take[-1]] * pad
+        if self._pool is not None:
+            results = list(self._pool.map(self._read, take))
+        else:
+            results = [self._read(i) for i in take]
+        labels = [r[1] for r in results]
+        arrs = [r[0].asnumpy() if isinstance(r[0], NDArray) else np.asarray(r[0])
+                for r in results]
         self._cursor += self.batch_size
-        return DataBatch(data=[nd.array(np.stack(imgs))],
+        if self._fused_norm is not None and arrs[0].dtype == np.uint8:
+            from .. import native
+            data = native.nhwc_u8_to_nchw_f32(np.stack(arrs),
+                                              self._fused_norm[0],
+                                              self._fused_norm[1])
+        else:
+            data = np.stack([a.astype(np.float32).transpose(2, 0, 1)
+                             for a in arrs])
+        return DataBatch(data=[nd.array(data)],
                          label=[nd.array(np.stack(labels))], pad=pad)
 
     next = __next__

@@ -115,6 +115,25 @@ class MXIndexedRecordIO(MXRecordIO):
                     key = key_type(parts[0])
                     self.idx[key] = int(parts[1])
                     self.keys.append(key)
+        elif not self.writable:
+            # no .idx sidecar: index by scanning the file — native C scan when
+            # built (rio_index, ≈ the reference's InputSplit chunk walk), python
+            # fallback otherwise; keys are sequential ints
+            try:
+                from . import native
+                offsets, _ = native.rio_index(uri)
+                positions = offsets - 8  # record start = payload start − header
+            except Exception:
+                positions = []
+                pos = self.tell()
+                while self.read() is not None:
+                    positions.append(pos)
+                    pos = self.tell()
+                self.seek(0)
+            for i, p in enumerate(positions):
+                key = key_type(i)
+                self.idx[key] = int(p)
+                self.keys.append(key)
 
     def close(self):
         if self.writable and not getattr(self, "_closed", True):
