@@ -78,3 +78,9 @@ ElementWiseSum = add_n
 # sparse sub-namespace (mx.nd.sparse parity)
 from . import sparse  # noqa: E402
 sys.modules[__name__ + ".sparse"] = sparse
+
+# control flow lives under nd.contrib (reference: mxnet.ndarray.contrib)
+from ..ops import control_flow as _control_flow  # noqa: E402
+contrib.foreach = _control_flow.foreach
+contrib.while_loop = _control_flow.while_loop
+contrib.cond = _control_flow.cond

@@ -39,6 +39,22 @@ def _wrap_out(raw) -> "NDArray":
     return NDArray(raw)
 
 
+# Read-capture hook: while a capture list is pushed, every NDArray whose buffer is
+# read is appended. Control-flow ops (ops/control_flow.py) use this to discover
+# handles their body closes over (e.g. RNN-cell weights) so gradients flow to them
+# — the imperative analogue of the reference's subgraph input capture
+# (control_flow.cc `_foreach` collecting the body CachedOp's inputs).
+_capture_stack: List[list] = []
+
+
+def _push_capture(lst: list):
+    _capture_stack.append(lst)
+
+
+def _pop_capture():
+    _capture_stack.pop()
+
+
 class NDArray:
     """Mutable tensor handle over an immutable ``jax.Array``."""
 
@@ -68,6 +84,8 @@ class NDArray:
     def data(self):
         """Current buffer; views re-slice lazily if the base was mutated since."""
         self._sync()
+        if _capture_stack:  # control-flow subgraph input discovery (see ops/control_flow.py)
+            _capture_stack[-1].append(self)
         return self._data
 
     def _sync(self):
