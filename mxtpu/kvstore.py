@@ -107,10 +107,10 @@ class KVStore:
                 if self._updater is not None:
                     self._updater(k, red, self._store[k])
                 else:
-                    rows, vals = red.indices.data, red.data.data
+                    # KVStoreLocal::PushImpl assigns local = merged: unpushed
+                    # rows become zero, not stale (kvstore_local.h:162-189)
                     self._store[k] = NDArray(
-                        self._store[k].data.at[rows].set(
-                            vals.astype(self._store[k].dtype)))
+                        red._dense().astype(self._store[k].dtype))
                 continue
             red = vlist[0].data
             for v in vlist[1:]:

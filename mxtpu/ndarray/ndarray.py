@@ -21,6 +21,7 @@ views, autograd attachment, serialization. The re-design (SURVEY.md §7 hard-par
 from __future__ import annotations
 
 import struct
+import threading
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -44,15 +45,22 @@ def _wrap_out(raw) -> "NDArray":
 # handles their body closes over (e.g. RNN-cell weights) so gradients flow to them
 # — the imperative analogue of the reference's subgraph input capture
 # (control_flow.cc `_foreach` collecting the body CachedOp's inputs).
-_capture_stack: List[list] = []
+_capture_tls = threading.local()  # per-thread: other threads' reads must not leak in
+
+
+def _captures() -> List[list]:
+    stack = getattr(_capture_tls, "stack", None)
+    if stack is None:
+        stack = _capture_tls.stack = []
+    return stack
 
 
 def _push_capture(lst: list):
-    _capture_stack.append(lst)
+    _captures().append(lst)
 
 
 def _pop_capture():
-    _capture_stack.pop()
+    _captures().pop()
 
 
 class NDArray:
@@ -84,8 +92,9 @@ class NDArray:
     def data(self):
         """Current buffer; views re-slice lazily if the base was mutated since."""
         self._sync()
-        if _capture_stack:  # control-flow subgraph input discovery (see ops/control_flow.py)
-            _capture_stack[-1].append(self)
+        stack = getattr(_capture_tls, "stack", None)
+        if stack:  # control-flow subgraph input discovery (see ops/control_flow.py)
+            stack[-1].append(self)
         return self._data
 
     def _sync(self):

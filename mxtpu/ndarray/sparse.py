@@ -271,6 +271,9 @@ def row_sparse_array(arg, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
     if isinstance(arg, RowSparseNDArray):
         return arg.copy() if shape is None else RowSparseNDArray(
             arg._indices, arg._values, shape)
+    if isinstance(arg, tuple) and all(isinstance(d, (int, np.integer)) for d in arg):
+        # shape tuple → empty sparse array (reference row_sparse_array(shape))
+        return zeros("row_sparse", arg, ctx=ctx, dtype=dtype or "float32")
     if isinstance(arg, tuple) and len(arg) == 2:
         values, indices = arg
         values = jnp.asarray(np.asarray(values),
@@ -288,6 +291,8 @@ def row_sparse_array(arg, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
 
 def csr_matrix(arg, shape=None, ctx=None, dtype=None) -> CSRNDArray:
     """From ``(data, indices, indptr)``, scipy.sparse, dense, or (data,(row,col))."""
+    if isinstance(arg, tuple) and all(isinstance(d, (int, np.integer)) for d in arg):
+        return zeros("csr", arg, ctx=ctx, dtype=dtype or "float32")
     try:
         import scipy.sparse as sps
         if sps.issparse(arg):

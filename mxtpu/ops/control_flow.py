@@ -103,7 +103,9 @@ def foreach(body, data, init_states, name: str = "foreach"):
         def step(carry, xs):
             s_nd = [NDArray(c) for c in carry]
             x_nd = [NDArray(x) for x in xs]
-            with autograd.pause():
+            # keep the ambient training mode: the reference runs the subgraph in
+            # the caller's train/predict mode (control_flow.cc subgraph exec)
+            with autograd.pause(train_mode=autograd.is_training()):
                 out, new_states = body(x_nd[0] if single_data else x_nd,
                                        s_nd[0] if single_state else s_nd)
             outs, struct["single_out"] = _as_list(out)
@@ -139,7 +141,7 @@ def while_loop(cond, func, loop_vars, max_iterations: int = None):
         def step(carry, _):
             vals, active = carry
             v_nd = [NDArray(v) for v in vals]
-            with autograd.pause():
+            with autograd.pause(train_mode=autograd.is_training()):
                 c = cond(*v_nd)
                 out, new_vars = func(*v_nd)
             c_raw = jnp.reshape(

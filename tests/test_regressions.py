@@ -253,3 +253,61 @@ def test_head_variable_grad_req_add_not_clobbered():
         y2 = x * x
     y2.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), 2 * 2 * x.asnumpy())
+
+
+def test_foreach_body_sees_training_mode():
+    """ADVICE r2: control-flow bodies must run in the ambient training mode so
+    Dropout/BatchNorm behave as in the reference's subgraph execution."""
+    modes = []
+
+    def body(x, s):
+        modes.append(autograd.is_training())
+        return x + s, x + s
+
+    x = nd.array(np.ones((3, 2), np.float32))
+    s = nd.array(np.zeros((2,), np.float32))
+    with autograd.record():  # record() implies train_mode=True
+        nd.contrib.foreach(body, x, s)
+    assert modes and all(modes)
+
+
+def test_kvstore_rowsparse_push_replaces_store():
+    """ADVICE r2: row_sparse push without an updater assigns local = merged —
+    unpushed rows must read zero, not stale values."""
+    from mxtpu import kvstore as kv_mod
+    from mxtpu.ndarray import sparse as sp
+    kv = kv_mod.create("local")
+    kv.init("w", nd.array(np.ones((4, 2), np.float32)))
+    g = sp.row_sparse_array((np.full((1, 2), 5.0, np.float32), [1]), shape=(4, 2))
+    kv.push("w", g)
+    out = nd.zeros((4, 2))
+    kv.pull("w", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], [5.0, 5.0])
+    np.testing.assert_allclose(got[0], [0.0, 0.0])
+
+
+def test_sparse_shape_tuple_constructors():
+    """ADVICE r2: row_sparse_array((D0,D1)) / csr_matrix((M,N)) build empty arrays."""
+    from mxtpu.ndarray import sparse as sp
+    rs = sp.row_sparse_array((4, 3))
+    assert rs.shape == (4, 3) and rs.indices.shape[0] == 0
+    cs = sp.csr_matrix((2, 5))
+    assert cs.shape == (2, 5)
+    np.testing.assert_allclose(cs.asnumpy(), np.zeros((2, 5)))
+
+
+def test_capture_stack_is_thread_local():
+    """ADVICE r2: NDArray reads on other threads must not leak into an active
+    control-flow capture window."""
+    import threading
+    from mxtpu.ndarray import ndarray as nd_core
+    other = nd.array([1.0, 2.0])
+    cap = []
+    nd_core._push_capture(cap)
+    try:
+        t = threading.Thread(target=lambda: other.data)
+        t.start(); t.join()
+    finally:
+        nd_core._pop_capture()
+    assert not any(h is other for h in cap)

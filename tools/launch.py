@@ -20,6 +20,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port() -> int:
@@ -49,14 +50,27 @@ def launch(num_workers: int, command, devices_per_worker: int = 0,
             env["JAX_PLATFORMS"] = "cpu"
         env.update(env_extra or {})
         procs.append(subprocess.Popen(list(command), env=env))
+    # Poll: the first non-zero exit tears the job down immediately — peers would
+    # otherwise block forever inside jax.distributed collectives.
     rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    if rc:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    live = list(procs)
+    while live:
+        time.sleep(0.2)
+        still = []
+        for p in live:
+            code = p.poll()
+            if code is None:
+                still.append(p)
+            elif code != 0:
+                rc = rc or code
+        live = still
+        if rc:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                p.wait()
+            return rc
     return rc
 
 
