@@ -1,16 +1,32 @@
-"""Model zoo — parity with ``python/mxnet/gluon/model_zoo/vision`` (SURVEY.md §2.5):
-ResNet v1/v2 (18/34/50/101/152), VGG 11/13/16/19 (±BN), AlexNet, SqueezeNet 1.0/1.1,
-DenseNet 121/161/169/201, MobileNet v1 (multipliers) & v2, Inception-V3, plus LeNet
-(the reference's canonical MNIST example network, example/image-classification
-train_mnist.py).
+"""Model zoo — capability parity with ``python/mxnet/gluon/model_zoo/vision``
+(SURVEY.md §2.5): ResNet v1/v2 (18/34/50/101/152), VGG 11/13/16/19 (±BN),
+AlexNet, SqueezeNet 1.0/1.1, DenseNet 121/161/169/201, MobileNet v1
+(multipliers) & v2, Inception-V3, plus LeNet (the reference's canonical MNIST
+network, example/image-classification/symbols/lenet.py).
+
+Design: unlike the reference (one hand-written ``HybridBlock`` subclass per
+block variant), every architecture here is assembled from a declarative spec by
+a handful of generic cells:
+
+* ``_cna``       — conv[+norm][+act] unit appended to a sequence
+* ``_Residual``  — y = tail(main(stem(x)) + shortcut(stem(x))), covering both
+                   post-activation (v1) and pre-activation (v2) residual styles
+* ``_Fork``      — channel-concat of parallel branches (SqueezeNet Fire,
+                   Inception mixed blocks)
+* ``_DenseCell`` — y = concat(x, body(x)) (DenseNet)
+* ``_Net``       — features → output container shared by all families
+
+Family tables (``_RESNET_SPEC``, ``_VGG_SPEC``, …) carry the published layer
+counts/widths (architectural constants from the papers). Deviation from the
+reference: all convolutions feeding a BatchNorm use ``use_bias=False`` (the
+reference leaves default biases on a few 1x1 convs in BottleneckV1 — redundant
+before BN).
 
 ``pretrained=True`` requires a local weight mirror (zero-egress env) — see
 gluon/utils.download.
 """
 
 from __future__ import annotations
-
-from typing import List, Optional
 
 from ... import ndarray as nd
 from .. import nn
@@ -27,367 +43,292 @@ __all__ = ["get_model", "get_resnet", "resnet18_v1", "resnet34_v1", "resnet50_v1
 
 
 # ---------------------------------------------------------------------------
-# ResNet (model_zoo/vision/resnet.py parity)
+# generic cells
 # ---------------------------------------------------------------------------
 
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+def _seq(*blocks, prefix=""):
+    s = nn.HybridSequential(prefix=prefix)
+    for b in blocks:
+        s.add(b)
+    return s
 
 
-class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+def _act(name):
+    if name == "relu6":
+        return nn.HybridLambda(lambda x: nd.clip(x, 0.0, 6.0))
+    return nn.Activation(name)
+
+
+def _cna(seq, ch, k=1, s=1, p=0, *, g=1, norm=True, act="relu", bias=None,
+         eps=1e-5):
+    """Append a conv[+BatchNorm][+activation] unit to ``seq``.
+
+    ``bias`` defaults to False when a norm follows (redundant otherwise) and
+    True for bare convs.
+    """
+    if bias is None:
+        bias = not norm
+    seq.add(nn.Conv2D(ch, kernel_size=k, strides=s, padding=p, groups=g,
+                      use_bias=bias))
+    if norm:
+        seq.add(nn.BatchNorm(epsilon=eps))
+    if act:
+        seq.add(_act(act))
+    return seq
+
+
+class _Residual(HybridBlock):
+    """Generic residual cell: ``y = tail(main(h) + shortcut(h))`` where
+    ``h = stem(x)`` and the identity path bypasses the stem.
+
+    * post-activation style (ResNet v1): stem=None, shortcut=proj+BN,
+      tail='relu'
+    * pre-activation style (ResNet v2): stem=BN+relu (shared by main and
+      projection shortcut), tail=None, identity = original ``x``
+    * plain additive skip (MobileNetV2): only ``main``
+    """
+
+    def __init__(self, main, shortcut=None, stem=None, tail=None, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                          use_bias=False, in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
+        self.main = main
+        self.shortcut = shortcut
+        self.stem = stem
+        self._tail = tail
 
     def forward(self, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return nd.Activation(x + residual, act_type="relu")
+        identity = x
+        h = self.stem(x) if self.stem is not None else x
+        if self.shortcut is not None:
+            identity = self.shortcut(h)
+        y = self.main(h) + identity
+        if self._tail:
+            y = nd.Activation(y, act_type=self._tail)
+        return y
 
 
-class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+class _Fork(HybridBlock):
+    """Run branches in parallel on the same input and concat along channels."""
+
+    def __init__(self, *branches, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                          use_bias=False, in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
+        self.branches = list(branches)
+        for i, b in enumerate(self.branches):
+            self.register_child(b, f"branch{i}")
 
     def forward(self, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return nd.Activation(x + residual, act_type="relu")
+        return nd.concat(*[b(x) for b in self.branches], dim=1)
 
 
-class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+class _DenseCell(HybridBlock):
+    """DenseNet connectivity: output is ``concat(x, body(x))``."""
+
+    def __init__(self, body, **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+        self.body = body
 
     def forward(self, x):
-        residual = x
-        x = self.bn1(x)
-        x = nd.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = nd.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+        return nd.concat(x, self.body(x), dim=1)
 
 
-class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+class _Net(HybridBlock):
+    """features → output container shared by every zoo family.
 
-    def forward(self, x):
-        residual = x
-        x = self.bn1(x)
-        x = nd.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = nd.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = nd.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
+    Takes a ``build`` thunk returning ``(features, output)`` and runs it inside
+    this block's ``name_scope`` so parameter names are net-relative and
+    deterministic (required for save_parameters/load_parameters round-trips
+    between instances)."""
 
-
-class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+    def __init__(self, build, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(block, num_layer, channels[i + 1],
-                                                   stride, i + 1,
-                                                   in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.Dense(classes, in_units=channels[-1])
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix=f"stage{stage_index}_")
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
-        return layer
+            self.features, self.output = build()
 
     def forward(self, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
-class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(block, num_layer, channels[i + 1],
-                                                   stride, i + 1,
-                                                   in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
+# ---------------------------------------------------------------------------
+# ResNet v1/v2 — spec-driven (capability parity: model_zoo/vision/resnet.py)
+# ---------------------------------------------------------------------------
 
-    _make_layer = ResNetV1._make_layer
-
-    def forward(self, x):
-        x = self.features(x)
-        return self.output(x)
-
-
-resnet_spec = {
-    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+# depth -> (unit kind, units per stage, stage widths incl. stem width)
+_RESNET_SPEC = {
+    18: ("basic", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: ("basic", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: ("neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: ("neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: ("neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
 }
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [
-    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
-    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
-]
+
+# unit kind -> conv stack as (width(out_ch), kernel, stride, pad) rows;
+# `s` marks where the stage stride lands, matching the reference placement
+# (v1 bottleneck strides its first 1x1; v2 bottleneck strides the 3x3).
+
+
+def _resnet_convs(kind, c, s, version):
+    if kind == "basic":
+        return [(c, 3, s, 1), (c, 3, 1, 1)]
+    if version == 1:
+        return [(c // 4, 1, s, 0), (c // 4, 3, 1, 1), (c, 1, 1, 0)]
+    return [(c // 4, 1, 1, 0), (c // 4, 3, s, 1), (c, 1, 1, 0)]
+
+
+def _resnet_unit(version, kind, c, s, project):
+    convs = _resnet_convs(kind, c, s, version)
+    main = nn.HybridSequential(prefix="")
+    if version == 1:
+        # conv-BN pairs, relu between pairs, residual add then relu (tail)
+        for i, (w, k, st, pd) in enumerate(convs):
+            _cna(main, w, k, st, pd, act="relu" if i < len(convs) - 1 else None)
+        shortcut = _cna(nn.HybridSequential(prefix=""), c, 1, s,
+                        act=None) if project else None
+        return _Residual(main, shortcut, tail="relu")
+    # v2: shared BN+relu stem, then conv / (BN+relu+conv)* — no norm after the
+    # last conv; the projection shortcut consumes the stem output.
+    stem = _seq(nn.BatchNorm(), nn.Activation("relu"))
+    for i, (w, k, st, pd) in enumerate(convs):
+        if i > 0:
+            main.add(nn.BatchNorm())
+            main.add(nn.Activation("relu"))
+        main.add(nn.Conv2D(w, kernel_size=k, strides=st, padding=pd,
+                           use_bias=False))
+    shortcut = nn.Conv2D(c, kernel_size=1, strides=s,
+                         use_bias=False) if project else None
+    return _Residual(main, shortcut, stem=stem)
+
+
+def _resnet_stage(version, kind, n_units, c, in_c, stride, index):
+    stage = nn.HybridSequential(prefix=f"stage{index}_")
+    with stage.name_scope():
+        stage.add(_resnet_unit(version, kind, c, stride,
+                               project=(stride != 1 or in_c != c)))
+        for _ in range(n_units - 1):
+            stage.add(_resnet_unit(version, kind, c, 1, project=False))
+    return stage
 
 
 def get_resnet(version: int, num_layers: int, pretrained: bool = False, ctx=None,
-               **kwargs) -> HybridBlock:
-    block_type, layers, channels = resnet_spec[num_layers]
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+               classes: int = 1000, thumbnail: bool = False, **kwargs) -> HybridBlock:
+    """Build a ResNet. ``thumbnail=True`` swaps the 7x7/maxpool stem for a bare
+    3x3 (CIFAR-style input)."""
+    if version not in (1, 2):
+        raise ValueError(f"resnet version must be 1 or 2, got {version}")
+    kind, units, widths = _RESNET_SPEC[num_layers]
+
+    def build():
+        feats = nn.HybridSequential(prefix="")
+        if version == 2:
+            feats.add(nn.BatchNorm(scale=False, center=False))  # input standardizer
+        if thumbnail:
+            _cna(feats, widths[0], 3, 1, 1, norm=False, act=None, bias=False)
+        else:
+            _cna(feats, widths[0], 7, 2, 3, act="relu")
+            feats.add(nn.MaxPool2D(3, 2, 1))
+        in_c = widths[0]
+        for i, (n, c) in enumerate(zip(units, widths[1:])):
+            feats.add(_resnet_stage(version, kind, n, c, in_c,
+                                    1 if i == 0 else 2, i + 1))
+            in_c = c
+        if version == 2:
+            feats.add(nn.BatchNorm())
+            feats.add(nn.Activation("relu"))
+        feats.add(nn.GlobalAvgPool2D())
+        feats.add(nn.Flatten())
+        return feats, nn.Dense(classes, in_units=in_c)
+
+    net = _Net(build, **kwargs)
     if pretrained:
         from .model_store import load_pretrained
         load_pretrained(net, f"resnet{num_layers}_v{version}", ctx)
     return net
 
 
-def resnet18_v1(**kw):
-    return get_resnet(1, 18, **kw)
+def _resnet_factory(version, depth):
+    def make(**kw):
+        return get_resnet(version, depth, **kw)
+    make.__name__ = f"resnet{depth}_v{version}"
+    return make
 
 
-def resnet34_v1(**kw):
-    return get_resnet(1, 34, **kw)
-
-
-def resnet50_v1(**kw):
-    return get_resnet(1, 50, **kw)
-
-
-def resnet101_v1(**kw):
-    return get_resnet(1, 101, **kw)
-
-
-def resnet152_v1(**kw):
-    return get_resnet(1, 152, **kw)
-
-
-def resnet18_v2(**kw):
-    return get_resnet(2, 18, **kw)
-
-
-def resnet34_v2(**kw):
-    return get_resnet(2, 34, **kw)
-
-
-def resnet50_v2(**kw):
-    return get_resnet(2, 50, **kw)
-
-
-def resnet101_v2(**kw):
-    return get_resnet(2, 101, **kw)
-
-
-def resnet152_v2(**kw):
-    return get_resnet(2, 152, **kw)
+resnet18_v1 = _resnet_factory(1, 18)
+resnet34_v1 = _resnet_factory(1, 34)
+resnet50_v1 = _resnet_factory(1, 50)
+resnet101_v1 = _resnet_factory(1, 101)
+resnet152_v1 = _resnet_factory(1, 152)
+resnet18_v2 = _resnet_factory(2, 18)
+resnet34_v2 = _resnet_factory(2, 34)
+resnet50_v2 = _resnet_factory(2, 50)
+resnet101_v2 = _resnet_factory(2, 101)
+resnet152_v2 = _resnet_factory(2, 152)
 
 
 # ---------------------------------------------------------------------------
-# VGG (model_zoo/vision/vgg.py parity)
+# VGG — spec-driven (capability parity: model_zoo/vision/vgg.py)
 # ---------------------------------------------------------------------------
 
-vgg_spec = {
-    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
-}
+# depth -> convs-per-stage; widths are fixed across depths
+_VGG_SPEC = {11: [1, 1, 2, 2, 2], 13: [2, 2, 2, 2, 2],
+             16: [2, 2, 3, 3, 3], 19: [2, 2, 4, 4, 4]}
+_VGG_WIDTHS = [64, 128, 256, 512, 512]
 
 
-class VGG(HybridBlock):
-    def __init__(self, layers, filters, classes=1000, batch_norm=False, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            for i, num in enumerate(layers):
-                for _ in range(num):
-                    self.features.add(nn.Conv2D(filters[i], kernel_size=3, padding=1))
-                    if batch_norm:
-                        self.features.add(nn.BatchNorm())
-                    self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(strides=2))
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(0.5))
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(0.5))
-            self.output = nn.Dense(classes)
+def _vgg(depth, batch_norm=False, pretrained=False, ctx=None, classes=1000,
+         **kwargs):
+    def build():
+        feats = nn.HybridSequential(prefix="")
+        for reps, width in zip(_VGG_SPEC[depth], _VGG_WIDTHS):
+            for _ in range(reps):
+                _cna(feats, width, 3, 1, 1, norm=batch_norm, act="relu",
+                     bias=True)
+            feats.add(nn.MaxPool2D(strides=2))
+        for _ in range(2):
+            feats.add(nn.Dense(4096, activation="relu"))
+            feats.add(nn.Dropout(0.5))
+        return feats, nn.Dense(classes)
 
-    def forward(self, x):
-        return self.output(self.features(x))
-
-
-def _vgg(num_layers, batch_norm=False, pretrained=False, ctx=None, **kwargs):
-    layers, filters = vgg_spec[num_layers]
-    net = VGG(layers, filters, batch_norm=batch_norm, **kwargs)
+    net = _Net(build, **kwargs)
     if pretrained:
         from .model_store import load_pretrained
-        load_pretrained(net, f"vgg{num_layers}{'_bn' if batch_norm else ''}", ctx)
+        load_pretrained(net, f"vgg{depth}{'_bn' if batch_norm else ''}", ctx)
     return net
 
 
-def vgg11(**kw):
-    return _vgg(11, **kw)
+def _vgg_factory(depth, bn):
+    def make(**kw):
+        return _vgg(depth, batch_norm=bn, **kw)
+    make.__name__ = f"vgg{depth}{'_bn' if bn else ''}"
+    return make
 
 
-def vgg13(**kw):
-    return _vgg(13, **kw)
-
-
-def vgg16(**kw):
-    return _vgg(16, **kw)
-
-
-def vgg19(**kw):
-    return _vgg(19, **kw)
-
-
-def vgg11_bn(**kw):
-    return _vgg(11, batch_norm=True, **kw)
-
-
-def vgg13_bn(**kw):
-    return _vgg(13, batch_norm=True, **kw)
-
-
-def vgg16_bn(**kw):
-    return _vgg(16, batch_norm=True, **kw)
-
-
-def vgg19_bn(**kw):
-    return _vgg(19, batch_norm=True, **kw)
+vgg11, vgg13, vgg16, vgg19 = (_vgg_factory(d, False) for d in (11, 13, 16, 19))
+vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn = (_vgg_factory(d, True)
+                                          for d in (11, 13, 16, 19))
 
 
 # ---------------------------------------------------------------------------
-# AlexNet (model_zoo/vision/alexnet.py parity)
+# AlexNet — spec-driven (capability parity: model_zoo/vision/alexnet.py)
 # ---------------------------------------------------------------------------
 
-
-class AlexNet(HybridBlock):
-    def __init__(self, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(64, 11, 4, 2, activation="relu"))
-            self.features.add(nn.MaxPool2D(3, 2))
-            self.features.add(nn.Conv2D(192, 5, padding=2, activation="relu"))
-            self.features.add(nn.MaxPool2D(3, 2))
-            self.features.add(nn.Conv2D(384, 3, padding=1, activation="relu"))
-            self.features.add(nn.Conv2D(256, 3, padding=1, activation="relu"))
-            self.features.add(nn.Conv2D(256, 3, padding=1, activation="relu"))
-            self.features.add(nn.MaxPool2D(3, 2))
-            self.features.add(nn.Flatten())
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(0.5))
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(0.5))
-            self.output = nn.Dense(classes)
-
-    def forward(self, x):
-        return self.output(self.features(x))
+# (out_ch, kernel, stride, pad, maxpool-after?)
+_ALEXNET_SPEC = [(64, 11, 4, 2, True), (192, 5, 1, 2, True), (384, 3, 1, 1, False),
+                 (256, 3, 1, 1, False), (256, 3, 1, 1, True)]
 
 
-def alexnet(pretrained=False, ctx=None, **kwargs):
-    net = AlexNet(**kwargs)
+def alexnet(pretrained=False, ctx=None, classes=1000, **kwargs):
+    def build():
+        feats = nn.HybridSequential(prefix="")
+        for ch, k, s, p, pool in _ALEXNET_SPEC:
+            _cna(feats, ch, k, s, p, norm=False, act="relu", bias=True)
+            if pool:
+                feats.add(nn.MaxPool2D(3, 2))
+        feats.add(nn.Flatten())
+        for _ in range(2):
+            feats.add(nn.Dense(4096, activation="relu"))
+            feats.add(nn.Dropout(0.5))
+        return feats, nn.Dense(classes)
+
+    net = _Net(build, **kwargs)
     if pretrained:
         from .model_store import load_pretrained
         load_pretrained(net, "alexnet", ctx)
@@ -395,114 +336,70 @@ def alexnet(pretrained=False, ctx=None, **kwargs):
 
 
 # ---------------------------------------------------------------------------
-# SqueezeNet (model_zoo/vision/squeezenet.py parity)
+# SqueezeNet — spec-driven (capability parity: model_zoo/vision/squeezenet.py)
 # ---------------------------------------------------------------------------
 
 
-class _Fire(HybridBlock):
-    def __init__(self, squeeze, expand1x1, expand3x3, **kwargs):
-        super().__init__(**kwargs)
-        self.squeeze = nn.Conv2D(squeeze, 1, activation="relu")
-        self.expand1 = nn.Conv2D(expand1x1, 1, activation="relu")
-        self.expand3 = nn.Conv2D(expand3x3, 3, padding=1, activation="relu")
-
-    def forward(self, x):
-        x = self.squeeze(x)
-        return nd.concat(self.expand1(x), self.expand3(x), dim=1)
+def _fire(squeeze, expand):
+    """Fire module: 1x1 squeeze then parallel 1x1/3x3 expand, concatenated."""
+    e1 = _cna(nn.HybridSequential(prefix=""), expand, 1, norm=False, bias=True)
+    e3 = _cna(nn.HybridSequential(prefix=""), expand, 3, 1, 1, norm=False,
+              bias=True)
+    return _seq(
+        _cna(nn.HybridSequential(prefix=""), squeeze, 1, norm=False, bias=True),
+        _Fork(e1, e3))
 
 
-class SqueezeNet(HybridBlock):
-    def __init__(self, version: str = "1.0", classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, 7, 2, activation="relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                for sq, e1, e3 in [(16, 64, 64), (16, 64, 64), (32, 128, 128)]:
-                    self.features.add(_Fire(sq, e1, e3))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                for sq, e1, e3 in [(32, 128, 128), (48, 192, 192), (48, 192, 192),
-                                   (64, 256, 256)]:
-                    self.features.add(_Fire(sq, e1, e3))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_Fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, 3, 2, activation="relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                for sq, e1, e3 in [(16, 64, 64), (16, 64, 64)]:
-                    self.features.add(_Fire(sq, e1, e3))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                for sq, e1, e3 in [(32, 128, 128), (32, 128, 128)]:
-                    self.features.add(_Fire(sq, e1, e3))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                for sq, e1, e3 in [(48, 192, 192), (48, 192, 192), (64, 256, 256),
-                                   (64, 256, 256)]:
-                    self.features.add(_Fire(sq, e1, e3))
-            self.features.add(nn.Dropout(0.5))
-            self.output = nn.HybridSequential(prefix="")
-            self.output.add(nn.Conv2D(classes, 1, activation="relu"))
-            self.output.add(nn.GlobalAvgPool2D())
-            self.output.add(nn.Flatten())
+# version -> (stem (ch,k,s), fire squeeze widths grouped by pool boundaries)
+_SQUEEZENET_SPEC = {
+    "1.0": ((96, 7, 2), [[16, 16, 32], [32, 48, 48, 64], [64]]),
+    "1.1": ((64, 3, 2), [[16, 16], [32, 32], [48, 48, 64, 64]]),
+}
 
-    def forward(self, x):
-        return self.output(self.features(x))
+
+def _squeezenet(version, classes=1000, **kwargs):
+    (ch, k, s), groups = _SQUEEZENET_SPEC[version]
+
+    def build():
+        feats = nn.HybridSequential(prefix="")
+        _cna(feats, ch, k, s, norm=False, act="relu", bias=True)
+        for squeezes in groups:
+            feats.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            for sq in squeezes:
+                feats.add(_fire(sq, sq * 4))
+        feats.add(nn.Dropout(0.5))
+        out = nn.HybridSequential(prefix="")
+        _cna(out, classes, 1, norm=False, act="relu", bias=True)
+        out.add(nn.GlobalAvgPool2D())
+        out.add(nn.Flatten())
+        return feats, out
+
+    return _Net(build, **kwargs)
 
 
 def squeezenet1_0(**kw):
-    return SqueezeNet("1.0", **_strip(kw))
+    return _squeezenet("1.0", **_strip(kw))
 
 
 def squeezenet1_1(**kw):
-    return SqueezeNet("1.1", **_strip(kw))
+    return _squeezenet("1.1", **_strip(kw))
 
 
 def _strip(kw):
-    kw.pop("pretrained", None)
+    if kw.pop("pretrained", False):
+        raise NotImplementedError(
+            "pretrained weights are not published for this family; load a local "
+            "checkpoint via net.load_parameters() instead")
     kw.pop("ctx", None)
     return kw
 
 
 # ---------------------------------------------------------------------------
-# DenseNet (model_zoo/vision/densenet.py parity)
+# DenseNet — spec-driven (capability parity: model_zoo/vision/densenet.py)
 # ---------------------------------------------------------------------------
 
-
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix=f"stage{stage_index}_")
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_DenseLayer(growth_rate, bn_size, dropout))
-    return out
-
-
-class _DenseLayer(HybridBlock):
-    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, 1, use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, 3, padding=1, use_bias=False))
-        if dropout:
-            self.body.add(nn.Dropout(dropout))
-
-    def forward(self, x):
-        return nd.concat(x, self.body(x), dim=1)
-
-
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, 1, use_bias=False))
-    out.add(nn.AvgPool2D(2, 2))
-    return out
-
-
-densenet_spec = {
+# depth -> (stem width, growth rate, layers per dense block)
+_DENSENET_SPEC = {
     121: (64, 32, [6, 12, 24, 16]),
     161: (96, 48, [6, 12, 36, 24]),
     169: (64, 32, [6, 12, 32, 32]),
@@ -510,291 +407,248 @@ densenet_spec = {
 }
 
 
-class DenseNet(HybridBlock):
-    def __init__(self, num_init_features, growth_rate, block_config, bn_size=4,
-                 dropout=0.0, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(num_init_features, 7, 2, 3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(num_layers, bn_size, growth_rate,
-                                                    dropout, i + 1))
-                num_features += num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    num_features //= 2
-                    self.features.add(_make_transition(num_features))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes)
-
-    def forward(self, x):
-        return self.output(self.features(x))
+def _bn_relu_conv(seq, ch, k, p=0):
+    seq.add(nn.BatchNorm())
+    seq.add(nn.Activation("relu"))
+    seq.add(nn.Conv2D(ch, kernel_size=k, padding=p, use_bias=False))
+    return seq
 
 
-def _densenet(num_layers, **kwargs):
-    init_f, growth, cfg = densenet_spec[num_layers]
-    return DenseNet(init_f, growth, cfg, **_strip(kwargs))
+def _dense_block(n_layers, growth, bn_size, dropout, index):
+    block = nn.HybridSequential(prefix=f"stage{index}_")
+    with block.name_scope():
+        for _ in range(n_layers):
+            body = nn.HybridSequential(prefix="")
+            _bn_relu_conv(body, bn_size * growth, 1)
+            _bn_relu_conv(body, growth, 3, 1)
+            if dropout:
+                body.add(nn.Dropout(dropout))
+            block.add(_DenseCell(body))
+    return block
+
+
+def _densenet(depth, bn_size=4, dropout=0.0, classes=1000, **kwargs):
+    stem_w, growth, blocks = _DENSENET_SPEC[depth]
+
+    def build():
+        feats = nn.HybridSequential(prefix="")
+        _cna(feats, stem_w, 7, 2, 3, act="relu")
+        feats.add(nn.MaxPool2D(3, 2, 1))
+        width = stem_w
+        for i, n in enumerate(blocks):
+            feats.add(_dense_block(n, growth, bn_size, dropout, i + 1))
+            width += n * growth
+            if i != len(blocks) - 1:
+                width //= 2
+                feats.add(_bn_relu_conv(nn.HybridSequential(prefix=""), width, 1))
+                feats.add(nn.AvgPool2D(2, 2))
+        feats.add(nn.BatchNorm())
+        feats.add(nn.Activation("relu"))
+        feats.add(nn.GlobalAvgPool2D())
+        feats.add(nn.Flatten())
+        return feats, nn.Dense(classes)
+
+    return _Net(build, **kwargs)
 
 
 def densenet121(**kw):
-    return _densenet(121, **kw)
+    return _densenet(121, **_strip(kw))
 
 
 def densenet161(**kw):
-    return _densenet(161, **kw)
+    return _densenet(161, **_strip(kw))
 
 
 def densenet169(**kw):
-    return _densenet(169, **kw)
+    return _densenet(169, **_strip(kw))
 
 
 def densenet201(**kw):
-    return _densenet(201, **kw)
+    return _densenet(201, **_strip(kw))
 
 
 # ---------------------------------------------------------------------------
-# MobileNet v1/v2 (model_zoo/vision/mobilenet.py parity)
+# MobileNet v1/v2 — spec-driven (capability parity: model_zoo/vision/mobilenet.py)
 # ---------------------------------------------------------------------------
 
+# v1: (pointwise out width, stride of the preceding depthwise) per unit
+_MOBILENET_V1_SPEC = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+                      (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+                      (1024, 2), (1024, 1)]
 
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1, active=True,
-              relu6=False):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group, use_bias=False))
-    out.add(nn.BatchNorm())
-    if active:
-        out.add(nn.HybridLambda(lambda x: nd.clip(x, 0.0, 6.0)) if relu6
-                else nn.Activation("relu"))
-
-
-class MobileNet(HybridBlock):
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            _add_conv(self.features, int(32 * multiplier), 3, 2, 1)
-            dw_channels = [int(x * multiplier) for x in
-                           [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
-            channels = [int(x * multiplier) for x in
-                        [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
-            strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
-            for dwc, c, s in zip(dw_channels, channels, strides):
-                _add_conv(self.features, dwc, 3, s, 1, num_group=dwc)  # depthwise
-                _add_conv(self.features, c, 1, 1, 0)  # pointwise
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes)
-
-    def forward(self, x):
-        return self.output(self.features(x))
+# v2: (expansion t, out width, stride) per inverted-residual unit
+_MOBILENET_V2_SPEC = [(1, 16, 1), (6, 24, 2), (6, 24, 1), (6, 32, 2), (6, 32, 1),
+                      (6, 32, 1), (6, 64, 2), (6, 64, 1), (6, 64, 1), (6, 64, 1),
+                      (6, 96, 1), (6, 96, 1), (6, 96, 1), (6, 160, 2),
+                      (6, 160, 1), (6, 160, 1), (6, 320, 1)]
 
 
-class _LinearBottleneck(HybridBlock):
-    def __init__(self, in_channels, channels, t, stride, **kwargs):
-        super().__init__(**kwargs)
-        self.use_shortcut = stride == 1 and in_channels == channels
-        self.out = nn.HybridSequential(prefix="")
-        _add_conv(self.out, in_channels * t, relu6=True)
-        _add_conv(self.out, in_channels * t, 3, stride, 1, num_group=in_channels * t,
-                  relu6=True)
-        _add_conv(self.out, channels, active=False)
+def _mobilenet_v1(multiplier=1.0, classes=1000, **kwargs):
+    def build():
+        feats = nn.HybridSequential(prefix="")
+        width = int(32 * multiplier)
+        _cna(feats, width, 3, 2, 1)
+        for out_w, stride in _MOBILENET_V1_SPEC:
+            out_w = int(out_w * multiplier)
+            _cna(feats, width, 3, stride, 1, g=width)   # depthwise
+            _cna(feats, out_w, 1)                       # pointwise
+            width = out_w
+        feats.add(nn.GlobalAvgPool2D())
+        feats.add(nn.Flatten())
+        return feats, nn.Dense(classes)
 
-    def forward(self, x):
-        out = self.out(x)
-        if self.use_shortcut:
-            out = out + x
-        return out
-
-
-class MobileNetV2(HybridBlock):
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="features_")
-            _add_conv(self.features, int(32 * multiplier), 3, 2, 1, relu6=True)
-            in_c = [int(multiplier * x) for x in
-                    [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
-                    + [160] * 3]
-            channels = [int(multiplier * x) for x in
-                        [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 + [160] * 3
-                        + [320]]
-            ts = [1] + [6] * 16
-            strides = [1, 2, 1, 2, 1, 1, 2, 1, 1, 1, 1, 1, 1, 2, 1, 1, 1]
-            for ic, c, t, s in zip(in_c, channels, ts, strides):
-                self.features.add(_LinearBottleneck(ic, c, t, s))
-            last = int(1280 * multiplier) if multiplier > 1.0 else 1280
-            _add_conv(self.features, last, relu6=True)
-            self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.HybridSequential(prefix="output_")
-            self.output.add(nn.Conv2D(classes, 1, use_bias=False))
-            self.output.add(nn.Flatten())
-
-    def forward(self, x):
-        return self.output(self.features(x))
+    return _Net(build, **kwargs)
 
 
-def mobilenet1_0(**kw):
-    return MobileNet(1.0, **_strip(kw))
+def _inverted_residual(in_w, t, out_w, stride):
+    body = nn.HybridSequential(prefix="")
+    mid = in_w * t
+    _cna(body, mid, 1, act="relu6")
+    _cna(body, mid, 3, stride, 1, g=mid, act="relu6")
+    _cna(body, out_w, 1, act=None)  # linear projection
+    if stride == 1 and in_w == out_w:
+        return _Residual(body)
+    return body
 
 
-def mobilenet0_75(**kw):
-    return MobileNet(0.75, **_strip(kw))
+def _mobilenet_v2(multiplier=1.0, classes=1000, **kwargs):
+    def build():
+        feats = nn.HybridSequential(prefix="features_")
+        width = int(32 * multiplier)
+        _cna(feats, width, 3, 2, 1, act="relu6")
+        for t, out_w, stride in _MOBILENET_V2_SPEC:
+            out_w = int(out_w * multiplier)
+            feats.add(_inverted_residual(width, t, out_w, stride))
+            width = out_w
+        last = int(1280 * multiplier) if multiplier > 1.0 else 1280
+        _cna(feats, last, 1, act="relu6")
+        feats.add(nn.GlobalAvgPool2D())
+        out = nn.HybridSequential(prefix="output_")
+        out.add(nn.Conv2D(classes, 1, use_bias=False))
+        out.add(nn.Flatten())
+        return feats, out
+
+    return _Net(build, **kwargs)
 
 
-def mobilenet0_5(**kw):
-    return MobileNet(0.5, **_strip(kw))
+def _mobilenet_factory(builder, multiplier, name):
+    def make(**kw):
+        return builder(multiplier, **_strip(kw))
+    make.__name__ = name
+    return make
 
 
-def mobilenet0_25(**kw):
-    return MobileNet(0.25, **_strip(kw))
-
-
-def mobilenet_v2_1_0(**kw):
-    return MobileNetV2(1.0, **_strip(kw))
-
-
-def mobilenet_v2_0_75(**kw):
-    return MobileNetV2(0.75, **_strip(kw))
-
-
-def mobilenet_v2_0_5(**kw):
-    return MobileNetV2(0.5, **_strip(kw))
-
-
-def mobilenet_v2_0_25(**kw):
-    return MobileNetV2(0.25, **_strip(kw))
+mobilenet1_0 = _mobilenet_factory(_mobilenet_v1, 1.0, "mobilenet1_0")
+mobilenet0_75 = _mobilenet_factory(_mobilenet_v1, 0.75, "mobilenet0_75")
+mobilenet0_5 = _mobilenet_factory(_mobilenet_v1, 0.5, "mobilenet0_5")
+mobilenet0_25 = _mobilenet_factory(_mobilenet_v1, 0.25, "mobilenet0_25")
+mobilenet_v2_1_0 = _mobilenet_factory(_mobilenet_v2, 1.0, "mobilenet_v2_1_0")
+mobilenet_v2_0_75 = _mobilenet_factory(_mobilenet_v2, 0.75, "mobilenet_v2_0_75")
+mobilenet_v2_0_5 = _mobilenet_factory(_mobilenet_v2, 0.5, "mobilenet_v2_0_5")
+mobilenet_v2_0_25 = _mobilenet_factory(_mobilenet_v2, 0.25, "mobilenet_v2_0_25")
 
 
 # ---------------------------------------------------------------------------
-# Inception V3 (model_zoo/vision/inception.py parity)
+# Inception V3 — spec-driven (capability parity: model_zoo/vision/inception.py)
 # ---------------------------------------------------------------------------
+#
+# Branch mini-language: a branch is a list of unit specs; each unit is either
+# ("conv", ch, kernel, stride, pad), ("avg", k, s, p), ("max", k, s), or a
+# nested ("fork", [branch, ...]) for the v3 "E" split-concat tails.
 
 
-def _make_basic_conv(channels, kernel, stride=1, padding=0):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel, stride, padding, use_bias=False))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def _inception_branch(units):
+    seq = nn.HybridSequential(prefix="")
+    for u in units:
+        kind = u[0]
+        if kind == "conv":
+            _, ch, k, s, p = u
+            _cna(seq, ch, k, s, p, eps=0.001)
+        elif kind == "avg":
+            seq.add(nn.AvgPool2D(u[1], u[2], u[3]))
+        elif kind == "max":
+            seq.add(nn.MaxPool2D(u[1], u[2]))
+        elif kind == "fork":
+            seq.add(_Fork(*[_inception_branch(b) for b in u[1]]))
+        else:
+            raise ValueError(f"unknown inception unit kind {kind!r}")
+    return seq
 
 
-class _Branch(HybridBlock):
-    def __init__(self, branches, **kwargs):
-        super().__init__(**kwargs)
-        self.branches = branches
-        for i, b in enumerate(branches):
-            self.register_child(b, f"branch{i}")
-
-    def forward(self, x):
-        return nd.concat(*[b(x) for b in self.branches], dim=1)
+def _mixed(*branches):
+    return _Fork(*[_inception_branch(b) for b in branches])
 
 
-def _make_A(pool_features, prefix):
-    b1 = _make_basic_conv(64, 1)
-    b2 = nn.HybridSequential(); b2.add(_make_basic_conv(48, 1)); b2.add(_make_basic_conv(64, 5, padding=2))
-    b3 = nn.HybridSequential(); b3.add(_make_basic_conv(64, 1)); b3.add(_make_basic_conv(96, 3, padding=1)); b3.add(_make_basic_conv(96, 3, padding=1))
-    b4 = nn.HybridSequential(); b4.add(nn.AvgPool2D(3, 1, 1)); b4.add(_make_basic_conv(pool_features, 1))
-    return _Branch([b1, b2, b3, b4])
+def _conv(ch, k, s=1, p=0):
+    return ("conv", ch, k, s, p)
 
 
-def _make_B():
-    b1 = _make_basic_conv(384, 3, 2)
-    b2 = nn.HybridSequential(); b2.add(_make_basic_conv(64, 1)); b2.add(_make_basic_conv(96, 3, padding=1)); b2.add(_make_basic_conv(96, 3, 2))
-    b3 = nn.HybridSequential(); b3.add(nn.MaxPool2D(3, 2))
-    return _Branch([b1, b2, b3])
+def _inception_a(pool_w):
+    return _mixed(
+        [_conv(64, 1)],
+        [_conv(48, 1), _conv(64, 5, 1, 2)],
+        [_conv(64, 1), _conv(96, 3, 1, 1), _conv(96, 3, 1, 1)],
+        [("avg", 3, 1, 1), _conv(pool_w, 1)])
 
 
-def _make_C(channels_7x7):
-    b1 = _make_basic_conv(192, 1)
-    c = channels_7x7
-    b2 = nn.HybridSequential()
-    for ch, k, p in [(c, (1, 7), (0, 3)), (192, (7, 1), (3, 0))]:
-        b2.add(_make_basic_conv(ch, k, padding=p))
-    b2_pre = nn.HybridSequential(); b2_pre.add(_make_basic_conv(c, 1)); b2_pre.add(b2)
-    b3 = nn.HybridSequential()
-    b3.add(_make_basic_conv(c, 1))
-    for ch, k, p in [(c, (7, 1), (3, 0)), (c, (1, 7), (0, 3)), (c, (7, 1), (3, 0)),
-                     (192, (1, 7), (0, 3))]:
-        b3.add(_make_basic_conv(ch, k, padding=p))
-    b4 = nn.HybridSequential(); b4.add(nn.AvgPool2D(3, 1, 1)); b4.add(_make_basic_conv(192, 1))
-    return _Branch([b1, b2_pre, b3, b4])
+def _inception_b():
+    return _mixed(
+        [_conv(384, 3, 2)],
+        [_conv(64, 1), _conv(96, 3, 1, 1), _conv(96, 3, 2)],
+        [("max", 3, 2)])
 
 
-def _make_D():
-    b1 = nn.HybridSequential(); b1.add(_make_basic_conv(192, 1)); b1.add(_make_basic_conv(320, 3, 2))
-    b2 = nn.HybridSequential()
-    b2.add(_make_basic_conv(192, 1))
-    b2.add(_make_basic_conv(192, (1, 7), padding=(0, 3)))
-    b2.add(_make_basic_conv(192, (7, 1), padding=(3, 0)))
-    b2.add(_make_basic_conv(192, 3, 2))
-    b3 = nn.HybridSequential(); b3.add(nn.MaxPool2D(3, 2))
-    return _Branch([b1, b2, b3])
+def _inception_c(w7):
+    return _mixed(
+        [_conv(192, 1)],
+        [_conv(w7, 1), _conv(w7, (1, 7), 1, (0, 3)), _conv(192, (7, 1), 1, (3, 0))],
+        [_conv(w7, 1), _conv(w7, (7, 1), 1, (3, 0)), _conv(w7, (1, 7), 1, (0, 3)),
+         _conv(w7, (7, 1), 1, (3, 0)), _conv(192, (1, 7), 1, (0, 3))],
+        [("avg", 3, 1, 1), _conv(192, 1)])
 
 
-class _SplitConcat(HybridBlock):
-    def __init__(self, pre, left, right, **kwargs):
-        super().__init__(**kwargs)
-        self.pre, self.left, self.right = pre, left, right
-        self.register_child(pre, "pre")
-        self.register_child(left, "left")
-        self.register_child(right, "right")
-
-    def forward(self, x):
-        x = self.pre(x)
-        return nd.concat(self.left(x), self.right(x), dim=1)
+def _inception_d():
+    return _mixed(
+        [_conv(192, 1), _conv(320, 3, 2)],
+        [_conv(192, 1), _conv(192, (1, 7), 1, (0, 3)),
+         _conv(192, (7, 1), 1, (3, 0)), _conv(192, 3, 2)],
+        [("max", 3, 2)])
 
 
-def _make_E():
-    b1 = _make_basic_conv(320, 1)
-    b2 = _SplitConcat(_make_basic_conv(384, 1),
-                      _make_basic_conv(384, (1, 3), padding=(0, 1)),
-                      _make_basic_conv(384, (3, 1), padding=(1, 0)))
-    pre3 = nn.HybridSequential()
-    pre3.add(_make_basic_conv(448, 1))
-    pre3.add(_make_basic_conv(384, 3, padding=1))
-    b3 = _SplitConcat(pre3, _make_basic_conv(384, (1, 3), padding=(0, 1)),
-                      _make_basic_conv(384, (3, 1), padding=(1, 0)))
-    b4 = nn.HybridSequential(); b4.add(nn.AvgPool2D(3, 1, 1)); b4.add(_make_basic_conv(192, 1))
-    return _Branch([b1, b2, b3, b4])
+def _inception_e():
+    split = [[_conv(384, (1, 3), 1, (0, 1))], [_conv(384, (3, 1), 1, (1, 0))]]
+    return _mixed(
+        [_conv(320, 1)],
+        [_conv(384, 1), ("fork", split)],
+        [_conv(448, 1), _conv(384, 3, 1, 1), ("fork", split)],
+        [("avg", 3, 1, 1), _conv(192, 1)])
 
 
-class Inception3(HybridBlock):
-    def __init__(self, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(32, 3, 2))
-            self.features.add(_make_basic_conv(32, 3))
-            self.features.add(_make_basic_conv(64, 3, padding=1))
-            self.features.add(nn.MaxPool2D(3, 2))
-            self.features.add(_make_basic_conv(80, 1))
-            self.features.add(_make_basic_conv(192, 3))
-            self.features.add(nn.MaxPool2D(3, 2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B())
-            for c in (128, 160, 160, 192):
-                self.features.add(_make_C(c))
-            self.features.add(_make_D())
-            self.features.add(_make_E())
-            self.features.add(_make_E())
-            self.features.add(nn.AvgPool2D(8))
-            self.features.add(nn.Dropout(0.5))
-            self.output = nn.Dense(classes)
+def inception_v3(classes=1000, **kw):
+    kw = _strip(kw)
 
-    def forward(self, x):
-        return self.output(self.features(x))
+    def build():
+        feats = nn.HybridSequential(prefix="")
+        for ch, k, s, p in [(32, 3, 2, 0), (32, 3, 1, 0), (64, 3, 1, 1)]:
+            _cna(feats, ch, k, s, p, eps=0.001)
+        feats.add(nn.MaxPool2D(3, 2))
+        for ch, k in [(80, 1), (192, 3)]:
+            _cna(feats, ch, k, eps=0.001)
+        feats.add(nn.MaxPool2D(3, 2))
+        for pool_w in (32, 64, 64):
+            feats.add(_inception_a(pool_w))
+        feats.add(_inception_b())
+        for w7 in (128, 160, 160, 192):
+            feats.add(_inception_c(w7))
+        feats.add(_inception_d())
+        feats.add(_inception_e())
+        feats.add(_inception_e())
+        feats.add(nn.AvgPool2D(8))
+        feats.add(nn.Dropout(0.5))
+        feats.add(nn.Flatten())
+        return feats, nn.Dense(classes)
 
-
-def inception_v3(**kw):
-    return Inception3(**_strip(kw))
+    return _Net(build, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -803,16 +657,18 @@ def inception_v3(**kw):
 
 
 class LeNet(HybridBlock):
+    """Classic LeNet-5-style MNIST network (conv-tanh-pool x2, dense-tanh)."""
+
     def __init__(self, classes=10, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(20, 5, activation="tanh"))
-            self.features.add(nn.MaxPool2D(2, 2))
-            self.features.add(nn.Conv2D(50, 5, activation="tanh"))
-            self.features.add(nn.MaxPool2D(2, 2))
-            self.features.add(nn.Flatten())
-            self.features.add(nn.Dense(500, activation="tanh"))
+            feats = nn.HybridSequential(prefix="")
+            for ch in (20, 50):
+                _cna(feats, ch, 5, norm=False, act="tanh", bias=True)
+                feats.add(nn.MaxPool2D(2, 2))
+            feats.add(nn.Flatten())
+            feats.add(nn.Dense(500, activation="tanh"))
+            self.features = feats
             self.output = nn.Dense(classes)
 
     def forward(self, x):
