@@ -42,7 +42,8 @@ import importlib as _importlib
 
 _SUBSYSTEMS = ["initializer", "optimizer", "lr_scheduler", "metric", "callback",
                "io", "recordio", "kvstore", "symbol", "gluon", "module", "parallel",
-               "profiler", "test_utils", "model", "image", "visualization"]
+               "profiler", "test_utils", "model", "image", "visualization",
+               "contrib", "operator", "monitor"]
 for _name in _SUBSYSTEMS:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)

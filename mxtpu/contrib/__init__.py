@@ -1,0 +1,4 @@
+"""Contrib python packages (parity with ``python/mxnet/contrib``): quantization
+driver here; contrib ops live under ``mxtpu.nd.contrib`` (ops/contrib_ops.py)."""
+
+from . import quantization  # noqa: F401

@@ -84,3 +84,15 @@ from ..ops import control_flow as _control_flow  # noqa: E402
 contrib.foreach = _control_flow.foreach
 contrib.while_loop = _control_flow.while_loop
 contrib.cond = _control_flow.cond
+
+
+def __getattr__(name):
+    """Resolve ops registered AFTER import (e.g. ``Custom`` from
+    mxtpu.operator, user-registered ops) straight from the registry."""
+    try:
+        _reg.get_op(name)
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    fn = _make_wrapper(name)
+    setattr(_this, name, fn)
+    return fn

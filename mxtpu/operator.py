@@ -1,0 +1,234 @@
+"""Custom-operator escape hatch — capability parity with
+``python/mxnet/operator.py:426-692`` (``CustomOp``/``CustomOpProp``/
+``mx.operator.register``) and ``src/operator/custom/custom-inl.h:50-170``.
+
+The reference executes frontend-defined ops through an ``MXCallbackList``
+dispatched on a dedicated thread pool inside the engine. The TPU-native
+equivalent: the user's Python ``forward``/``backward`` run on the **host** via
+``jax.pure_callback`` while the surrounding graph stays compiled — so a Custom
+op works inside ``hybridize()``d blocks, under ``Module.fit``, and under
+``jax.jit`` generally. Gradients route through ``jax.custom_vjp`` whose
+backward is itself a host callback into ``CustomOp.backward``.
+
+Shape/type inference comes from ``CustomOpProp.infer_shape``/``infer_type``
+exactly as in the reference (needed here to declare the callback's result
+avals before tracing proceeds).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+
+class CustomOp:
+    """Base class for custom imperative operators (operator.py:426 parity).
+
+    Subclasses implement ``forward(is_train, req, in_data, out_data, aux)`` and
+    ``backward(req, out_grad, in_data, out_data, in_grad, aux)``, writing
+    results with ``self.assign``. Tensors are host NDArrays (numpy-backed
+    views) — arbitrary Python/numpy/scipy code is allowed here."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """operator.py:449 assign parity: honor the write/add/null req."""
+        if req in ("null", 0):
+            return
+        src = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+        if req in ("add", "add_to", 3):
+            dst[:] = dst.asnumpy() + src
+        else:
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Op metadata provider (operator.py:526 CustomOpProp parity)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+        self.kwargs: Dict[str, str] = {}
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        t = in_type[0]
+        return ([t] * len(in_type),
+                [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        return out_grad + in_data + out_data
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[CustomOpProp]] = {}
+
+
+def register(reg_name: str):
+    """``mx.operator.register`` parity: class decorator for CustomOpProp."""
+
+    def _wrap(prop_cls: Type[CustomOpProp]):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return _wrap
+
+
+def get_prop(op_type: str) -> Type[CustomOpProp]:
+    if op_type not in _REGISTRY:
+        raise KeyError(f"custom op {op_type!r} not registered "
+                       f"(available: {sorted(_REGISTRY)})")
+    return _REGISTRY[op_type]
+
+
+class _HostND:
+    """Minimal host NDArray handed to CustomOp code inside callbacks: supports
+    .asnumpy(), .shape/.dtype, slicing assignment — enough for the reference's
+    documented CustomOp idioms."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = np.asarray(arr)
+
+    def asnumpy(self):
+        return self.arr
+
+    @property
+    def shape(self):
+        return self.arr.shape
+
+    @property
+    def dtype(self):
+        return self.arr.dtype
+
+    def __getitem__(self, k):
+        return self.arr[k]
+
+    def __setitem__(self, k, v):
+        self.arr[k] = np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+
+    def __array__(self, dtype=None):
+        return self.arr if dtype is None else self.arr.astype(dtype)
+
+
+def _build_custom_fn(op_type: str, num_inputs: int, kwargs: Dict[str, str],
+                     is_train: bool):
+    """Build the jittable (custom_vjp-wrapped, pure_callback-backed) function
+    for one Custom invocation signature."""
+    prop_cls = get_prop(op_type)
+    prop = prop_cls(**kwargs)
+    prop.kwargs = kwargs
+
+    n_out = len(prop.list_outputs())
+
+    def _shapes(raw_shapes, raw_dtypes):
+        in_shapes, out_shapes, _aux = prop.infer_shape(
+            [list(s) for s in raw_shapes])
+        _in_t, out_types, _aux_t = prop.infer_type(list(raw_dtypes))
+        return [tuple(s) for s in out_shapes], out_types
+
+    def _make_op(raw):
+        return prop.create_operator(None, [list(x.shape) for x in raw],
+                                    [x.dtype for x in raw])
+
+    def _fwd_host(*raw):
+        op = _make_op(raw)
+        in_data = [_HostND(x) for x in raw]
+        out_shapes, out_types = _shapes([x.shape for x in raw],
+                                        [x.dtype for x in raw])
+        out_data = [_HostND(np.zeros(s, t)) for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+        outs = tuple(o.arr for o in out_data)
+        return outs if n_out > 1 else outs[0]
+
+    def _bwd_host(*args):
+        raw_in = args[:num_inputs]
+        raw_out = args[num_inputs:num_inputs + n_out]
+        raw_og = args[num_inputs + n_out:]
+        op = _make_op(raw_in)
+        in_data = [_HostND(x) for x in raw_in]
+        out_data = [_HostND(x) for x in raw_out]
+        out_grad = [_HostND(x) for x in raw_og]
+        in_grad = [_HostND(np.zeros_like(x.arr)) for x in in_data]
+        op.backward(["write"] * num_inputs, out_grad, in_data, out_data,
+                    in_grad, [])
+        grads = tuple(g.arr for g in in_grad)
+        return grads if num_inputs > 1 else grads[0]
+
+    @jax.custom_vjp
+    def custom_fn(*raw):
+        out_shapes, out_types = _shapes([x.shape for x in raw],
+                                        [x.dtype for x in raw])
+        result_avals = tuple(jax.ShapeDtypeStruct(s, t)
+                             for s, t in zip(out_shapes, out_types))
+        if n_out == 1:
+            result_avals = result_avals[0]
+        return jax.pure_callback(_fwd_host, result_avals, *raw)
+
+    def custom_fwd(*raw):
+        outs = custom_fn(*raw)
+        return outs, (raw, outs if n_out > 1 else (outs,))
+
+    def custom_bwd(res, g):
+        raw, outs = res
+        gs = g if n_out > 1 else (g,)
+        grad_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in raw)
+        if num_inputs == 1:
+            grad_avals = grad_avals[0]
+        grads = jax.pure_callback(_bwd_host, grad_avals, *raw, *outs, *gs)
+        return grads if num_inputs > 1 else (grads,)
+
+    custom_fn.defvjp(custom_fwd, custom_bwd)
+    return custom_fn
+
+
+def _custom_impl(*raw, op_type: str, is_train: bool, **kwargs):
+    """The ``Custom`` op body (src/operator/custom/custom.cc parity): builds
+    (per signature) the callback-backed function and applies it."""
+    fn = _build_custom_fn(op_type, len(raw),
+                          {k: str(v) for k, v in kwargs.items()}, is_train)
+    return fn(*raw)
+
+
+def _register_custom_op():
+    from .ops.registry import register as op_register
+
+    def _resolve(kwargs):
+        # bake the ambient train mode into the recorded kwargs so a tape
+        # replay under jax.vjp reproduces the same host callback
+        if "_is_train" not in kwargs:
+            from . import autograd
+            kwargs["_is_train"] = bool(autograd.is_training())
+        return kwargs
+
+    @op_register("Custom", num_outputs=-1, aliases=("custom",),
+                 resolve_kwargs=_resolve)
+    def _custom(*raw, op_type: str = "", _is_train: bool = False, **kwargs):
+        return _custom_impl(*raw, op_type=op_type, is_train=_is_train, **kwargs)
+
+
+_register_custom_op()

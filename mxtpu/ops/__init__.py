@@ -14,5 +14,8 @@ from . import sequence  # noqa: F401
 from . import nn  # noqa: F401
 from . import random  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import quantization  # noqa: F401
+from . import detection  # noqa: F401
+from . import spatial  # noqa: F401
 from . import rnn  # noqa: F401
 from . import attention  # noqa: F401

@@ -1,14 +1,21 @@
-"""Attention ops — flash attention as a Pallas TPU kernel with an XLA fallback.
+"""Attention ops — flash attention as Pallas TPU kernels (fwd + bwd) with an
+XLA fallback.
 
 The reference predates fused attention (its transformer support is just
-``_contrib_div_sqrt_dim``, contrib/transformer.cc:33); for a TPU-native framework
-attention IS the hot op, so it gets the Pallas treatment per the long-context mandate
-(SURVEY.md §5): blockwise online-softmax (flash) keeps the T×T score matrix out of
-HBM — the kernel streams K/V tiles through VMEM and accumulates (m, l, o) running
-stats, so memory is O(T·d) instead of O(T²).
+``_contrib_div_sqrt_dim``, contrib/transformer.cc:33); for a TPU-native
+framework attention IS the hot op, so it gets the Pallas treatment per the
+long-context mandate (SURVEY.md §5): blockwise online-softmax (flash) keeps the
+T×T score matrix out of HBM — kernels stream K/V tiles through VMEM.
 
-``attention(q, k, v)`` dispatches: Pallas kernel on TPU backends (block sizes tuned to
-the MXU 128-lane layout), pure-XLA reference elsewhere (CPU tests, odd shapes).
+Production shapes engage the kernel: head dims 64/96/128/... (any D ≤ 512) are
+zero-padded to the 128-lane width inside the wrapper (padding columns
+contribute nothing to q·kᵀ and produce zero output columns, sliced off
+afterwards). Sequence lengths engage when T % 128 == 0, or T ≤ 128 with
+T % 8 == 0 (Mosaic block-tiling legality); anything else falls back to the
+XLA reference. The backward pass is the standard flash
+backward — forward saves the per-row log-sum-exp; two kernels recompute the
+probabilities per tile and accumulate dq (grid over q blocks) and dk/dv (grid
+over k blocks) without materializing T×T.
 """
 
 from __future__ import annotations
@@ -48,13 +55,25 @@ def attention_reference(q, k, v, causal: bool = False, scale: Optional[float] = 
 
 
 # ---------------------------------------------------------------------------
-# Pallas flash kernel
+# Pallas flash kernels
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
-    """One (batch·head, q-block) program: stream K/V tiles, online softmax."""
+def _pick_block(t: int, cap: int = 128) -> int:
+    """Largest legal q/k block: Mosaic requires the lse/delta row blocks'
+    last dim to be 128-divisible or equal to the full axis, so blocks are
+    either 128 (t % 128 == 0) or the whole axis (t <= 128, t % 8 == 0)."""
+    if t % 128 == 0:
+        return min(128, cap)
+    if t <= 128 and t % 8 == 0:
+        return t
+    return 0
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                      causal: bool, scale: float):
+    """One (batch·head, q-block) program: stream K/V tiles, online softmax.
+    Also writes the per-row log-sum-exp needed by the backward kernels."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
@@ -92,63 +111,237 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     else:
         num_iter = num_kb
     m, l, o = lax.fori_loop(0, num_iter, body, (m0, l0, o0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+    # lse travels broadcast over 8 sublanes — Mosaic requires the block's
+    # second-to-last dim to be 8-divisible (a bare (1, block_q) is illegal)
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, 0][None, :],
+                                  (8, block_q))
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float):
+    """dq for one q block: loop K/V tiles, recompute P from the saved lse."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+    kv_len = k_ref.shape[1]
+    num_kb = kv_len // block_k
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                       # masked entries underflow to 0
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    if causal:
+        last_kb = (q_start + block_q - 1) // block_k + 1
+        num_iter = jnp.minimum(num_kb, last_kb)
+    else:
+        num_iter = num_kb
+    dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    dq = lax.fori_loop(0, num_iter, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float):
+    """dk/dv for one k block: loop q tiles, recompute P from the saved lse."""
+    from jax.experimental import pallas as pl
+
+    k_blk = k_ref[0].astype(jnp.float32)           # (block_k, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    block_k = k_blk.shape[0]
+    kb = pl.program_id(1)
+    k_start = kb * block_k
+    t = q_ref.shape[1]
+    num_qb = t // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        qs = qb * block_q
+        q = q_ref[0, pl.dslice(qs, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.dslice(qs, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(qs, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.dslice(qs, block_q)][:, None]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = qs + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    start_qb = (k_start // block_q) if causal else 0
+    z = jnp.zeros((block_k, k_blk.shape[1]), jnp.float32)
+    dk, dv = lax.fori_loop(start_qb, num_qb, body, (z, z))
+    # dk absorbed one factor of scale through q; no extra factor needed
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pad_d(x):
+    d = x.shape[-1]
+    dp = -(-d // 128) * 128
+    if dp == d:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, dp - d)))
 
 
 def _flash_attention_pallas(q, k, v, causal: bool, scale: float,
                             block_q: int = 128, block_k: int = 128,
                             interpret: bool = False):
+    """Forward kernel launch; returns (out, lse). q,k,v: (B, H, T, D)."""
     from jax.experimental import pallas as pl
 
     B, H, T, D = q.shape
     Tk = k.shape[2]
-    block_q = min(block_q, T)
-    block_k = min(block_k, Tk)
-    qq = q.reshape(B * H, T, D)
-    kk = k.reshape(B * H, Tk, D)
-    vv = v.reshape(B * H, Tk, D)
+    block_q = _pick_block(T, block_q)
+    block_k = _pick_block(Tk, block_k)
+    qq = _pad_d(q.reshape(B * H, T, D))
+    kk = _pad_d(k.reshape(B * H, Tk, D))
+    vv = _pad_d(v.reshape(B * H, Tk, D))
+    Dp = qq.shape[-1]
     grid = (B * H, T // block_q)
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
                                scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, Dp), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 8, T), jnp.float32),
+        ],
         interpret=interpret,
     )(qq, kk, vv)
-    return out.reshape(B, H, T, D)
+    return out[..., :D].reshape(B, H, T, D), lse[:, 0, :]
 
 
-def _use_pallas(q) -> bool:
+def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
+                           block_q: int = 128, block_k: int = 128,
+                           interpret: bool = False):
+    """Flash backward: dq via q-block grid, dk/dv via k-block grid."""
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    block_q = _pick_block(T, block_q)
+    block_k = _pick_block(Tk, block_k)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # lse/delta ride (BH, 8, T): sublane-broadcast to satisfy Mosaic tiling
+    delta = jnp.broadcast_to(delta.reshape(B * H, 1, T), (B * H, 8, T))
+    lse = jnp.broadcast_to(lse.reshape(B * H, 1, T), (B * H, 8, T))
+    qq = _pad_d(q.reshape(B * H, T, D))
+    kk = _pad_d(k.reshape(B * H, Tk, D))
+    vv = _pad_d(v.reshape(B * H, Tk, D))
+    gg = _pad_d(g.reshape(B * H, T, D))
+    Dp = qq.shape[-1]
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                                  causal=causal, scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, Dp), q.dtype),
+        interpret=interpret,
+    )(qq, kk, vv, gg, lse, delta)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                                   causal=causal, scale=scale)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 8, T), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 8, T), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, Dp), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, Dp), v.dtype),
+        ],
+        interpret=interpret,
+    )(qq, kk, vv, gg, lse, delta)
+
+    return (dq[..., :D].reshape(B, H, T, D),
+            dk[..., :D].reshape(B, H, Tk, D),
+            dv[..., :D].reshape(B, H, Tk, D))
+
+
+def _use_pallas(q, k) -> bool:
     if jax.default_backend() not in ("tpu",):
         return False
     T, D = q.shape[2], q.shape[3]
-    return T % 128 == 0 and D % 128 == 0
+    Tk = k.shape[2]
+    return (T == Tk and D <= 512 and _pick_block(T) >= 8
+            and _pick_block(Tk) >= 8 and T >= 8)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_core(q, k, v, causal, scale):
-    if _use_pallas(q) and q.shape[2] == k.shape[2]:
-        return _flash_attention_pallas(q, k, v, causal, scale)
+    if _use_pallas(q, k):
+        out, _lse = _flash_attention_pallas(q, k, v, causal, scale)
+        return out
     return attention_reference(q, k, v, causal=causal, scale=scale)
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    return _flash_core(q, k, v, causal, scale), (q, k, v)
+    if _use_pallas(q, k):
+        out, lse = _flash_attention_pallas(q, k, v, causal, scale)
+        return out, (q, k, v, out, lse)
+    out = attention_reference(q, k, v, causal=causal, scale=scale)
+    return out, (q, k, v, out, None)
 
 
 def _flash_bwd(causal, scale, res, g):
-    # backward recomputes through the XLA reference formulation (a fused flash
-    # backward kernel is a later optimization; memory is still O(T²) only inside
-    # this bwd — acceptable until the Pallas bwd lands)
-    q, k, v = res
+    q, k, v, o, lse = res
+    if lse is not None and _use_pallas(q, k):
+        return _flash_backward_pallas(q, k, v, o, lse, g, causal, scale)
+    # fallback: recompute through the XLA reference formulation
     _, vjp = jax.vjp(lambda q_, k_, v_: attention_reference(
         q_, k_, v_, causal=causal, scale=scale), q, k, v)
     return vjp(g)
@@ -161,8 +354,9 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
     """Fused scaled-dot-product attention; q,k,v: (B, H, T, D).
 
-    Pallas forward on TPU when tile-aligned (T, D multiples of 128), XLA reference
-    otherwise; backward via custom_vjp recompute — numerically equivalent paths.
+    Pallas fwd+bwd on TPU at production shapes (any head dim ≤512 via lane
+    padding; T % 128 == 0 or T ≤ 128 with T % 8 == 0), XLA reference
+    otherwise — numerically equivalent paths.
     """
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     return _flash_core(q, k, v, causal, s)

@@ -1,0 +1,535 @@
+"""Detection ops — capability parity with the reference's SSD/RCNN operator set:
+``src/operator/contrib/multibox_prior.cc``, ``multibox_target.cc``,
+``multibox_detection.cc``, ``contrib/proposal.cc``, ``src/operator/roi_pooling.cc``,
+``contrib/psroi_pooling.cc``, ``contrib/deformable_convolution.cc``.
+
+TPU-native formulations: every op is a static-shape, jittable XLA program —
+the reference's sequential CPU loops (greedy bipartite matching, greedy NMS)
+become bounded ``lax.fori_loop``s over vectorized mask updates, so the whole
+detection head can live inside one compiled step. Suppressed/invalid rows use
+the reference's -1 convention instead of dynamic output shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+NS = "contrib"
+
+
+def _corner_to_center(b):
+    return ((b[..., 0] + b[..., 2]) * 0.5, (b[..., 1] + b[..., 3]) * 0.5,
+            b[..., 2] - b[..., 0], b[..., 3] - b[..., 1])
+
+
+def _pair_iou(anchors, gts):
+    """IoU matrix (A, G), corner format."""
+    tl = jnp.maximum(anchors[:, None, :2], gts[None, :, :2])
+    br = jnp.minimum(anchors[:, None, 2:4], gts[None, :, 2:4])
+    inter = jnp.prod(jnp.maximum(br - tl, 0.0), axis=-1)
+    area_a = jnp.prod(jnp.maximum(anchors[:, 2:4] - anchors[:, :2], 0.0), -1)
+    area_g = jnp.prod(jnp.maximum(gts[:, 2:4] - gts[:, :2], 0.0), -1)
+    return inter / jnp.maximum(area_a[:, None] + area_g[None, :] - inter, 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+
+
+@register("MultiBoxPrior", namespace=NS, differentiable=False,
+          aliases=("multibox_prior",))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip: bool = False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """multibox_prior.cc: SSD anchor generation over an (N,C,H,W) feature map.
+
+    Per location: ``len(sizes)`` boxes at ratio 1 then ``len(ratios)-1`` boxes
+    at sizes[0] — widths carry the reference's in_h/in_w aspect correction
+    (multibox_prior.cc:50-66). Output (1, H*W*num_anchors, 4), corner format."""
+    in_h, in_w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / in_h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / in_w
+    r = jnp.arange(in_h, dtype=jnp.float32)
+    c = jnp.arange(in_w, dtype=jnp.float32)
+    cy = (r + offsets[0]) * step_y                      # (H,)
+    cx = (c + offsets[1]) * step_x                      # (W,)
+    # half-extents per anchor kind
+    ws, hs = [], []
+    for s in sizes:
+        ws.append(s * in_h / in_w / 2.0)
+        hs.append(s / 2.0)
+    for ratio in ratios[1:]:
+        sq = float(np.sqrt(ratio))
+        ws.append(sizes[0] * in_h / in_w * sq / 2.0)
+        hs.append(sizes[0] / sq / 2.0)
+    w = jnp.asarray(ws, jnp.float32)                    # (A,)
+    h = jnp.asarray(hs, jnp.float32)
+    cxg = jnp.broadcast_to(cx[None, :, None], (in_h, in_w, w.size))
+    cyg = jnp.broadcast_to(cy[:, None, None], (in_h, in_w, w.size))
+    out = jnp.stack([cxg - w, cyg - h, cxg + w, cyg + h], axis=-1)
+    out = out.reshape(1, in_h * in_w * w.size, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+
+
+def _encode_loc(anchors, gt_boxes, variances):
+    """multibox_target.cc:32 AssignLocTargets."""
+    ax, ay, aw, ah = _corner_to_center(anchors)
+    gx, gy, gw, gh = _corner_to_center(gt_boxes)
+    vx, vy, vw, vh = variances
+    return jnp.stack([
+        (gx - ax) / jnp.maximum(aw, 1e-12) / vx,
+        (gy - ay) / jnp.maximum(ah, 1e-12) / vy,
+        jnp.log(jnp.maximum(gw, 1e-12) / jnp.maximum(aw, 1e-12)) / vw,
+        jnp.log(jnp.maximum(gh, 1e-12) / jnp.maximum(ah, 1e-12)) / vh,
+    ], axis=-1)
+
+
+@register("MultiBoxTarget", namespace=NS, num_outputs=3, differentiable=False,
+          aliases=("multibox_target",))
+def _multibox_target(anchors, labels, cls_preds, overlap_threshold: float = 0.5,
+                     ignore_label: float = -1.0,
+                     negative_mining_ratio: float = -1.0,
+                     negative_mining_thresh: float = 0.5,
+                     minimum_negative_samples: int = 0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """multibox_target.cc: anchor↔gt matching producing (loc_target (N,4A),
+    loc_mask (N,4A), cls_target (N,A)).
+
+    anchors (1,A,4); labels (N,G,5+) rows [cls,x1,y1,x2,y2] padded with -1;
+    cls_preds (N,num_cls,A). The reference's sequential greedy bipartite stage
+    runs as a G-iteration fori_loop over vectorized argmax; the threshold stage
+    and hard-negative mining are fully vectorized."""
+    anchors = anchors.reshape(-1, 4)
+    A = anchors.shape[0]
+    G = labels.shape[1]
+
+    def one_batch(label, cls_pred):
+        gt_valid = label[:, 0] != -1.0                        # (G,)
+        iou = _pair_iou(anchors, label[:, 1:5])               # (A, G)
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+
+        # stage 1: greedy bipartite matching (multibox_target.cc:110-148)
+        def bip_body(_, carry):
+            match_gt, match_iou, a_free, g_free = carry
+            m = iou * a_free[:, None] * g_free[None, :]
+            flat = jnp.argmax(m)
+            aj, gk = flat // G, flat % G
+            ok = m[aj, gk] > 1e-6
+            match_gt = jnp.where(ok, match_gt.at[aj].set(gk), match_gt)
+            match_iou = jnp.where(ok, match_iou.at[aj].set(m[aj, gk]), match_iou)
+            a_free = jnp.where(ok, a_free.at[aj].set(0.0), a_free)
+            g_free = jnp.where(ok, g_free.at[gk].set(0.0), g_free)
+            return match_gt, match_iou, a_free, g_free
+
+        match_gt0 = jnp.full((A,), -1, jnp.int32)
+        match_iou0 = jnp.full((A,), -1.0, jnp.float32)
+        match_gt, match_iou, a_free, _ = lax.fori_loop(
+            0, G, bip_body,
+            (match_gt0, match_iou0, jnp.ones((A,)), gt_valid.astype(jnp.float32)))
+
+        # stage 2: threshold matching for still-unmatched anchors (:151-180)
+        row_best = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        row_iou = jnp.max(iou, axis=1)
+        unmatched = a_free > 0.5
+        if overlap_threshold > 0:
+            thr_pos = unmatched & (row_iou > overlap_threshold)
+        else:
+            thr_pos = jnp.zeros((A,), bool)
+        positive = (~unmatched) | thr_pos
+        match_gt = jnp.where(unmatched, row_best, match_gt)
+        match_iou = jnp.where(unmatched, row_iou, match_iou)
+
+        # stage 3: negatives — mining (:182-243) or all
+        if negative_mining_ratio > 0:
+            num_pos = jnp.sum(positive)
+            num_neg = jnp.minimum(
+                jnp.maximum((num_pos * negative_mining_ratio).astype(jnp.int32),
+                            minimum_negative_samples),
+                A - num_pos)
+            logits = cls_pred.T                               # (A, num_cls)
+            prob_bg = jax.nn.softmax(logits, axis=-1)[:, 0]
+            cand = (~positive) & (match_iou < negative_mining_thresh)
+            score = jnp.where(cand, prob_bg, jnp.inf)         # hardest = lowest
+            order = jnp.argsort(score)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+            negative = cand & (rank < num_neg)
+        else:
+            negative = ~positive
+
+        valid_any = jnp.any(gt_valid)
+        cls_target = jnp.where(
+            positive, label[match_gt, 0] + 1.0,
+            jnp.where(negative, 0.0, ignore_label))
+        loc = _encode_loc(anchors, label[match_gt, 1:5], variances)
+        mask4 = jnp.broadcast_to(positive[:, None], (A, 4)).astype(jnp.float32)
+        loc_target = jnp.where(mask4 > 0, loc, 0.0)
+        # no valid gt → everything stays background/zero (reference skips batch)
+        cls_target = jnp.where(valid_any, cls_target, 0.0)
+        loc_target = jnp.where(valid_any, loc_target, 0.0)
+        mask4 = jnp.where(valid_any, mask4, 0.0)
+        return loc_target.reshape(-1), mask4.reshape(-1), cls_target
+
+    loc_t, loc_m, cls_t = jax.vmap(one_batch)(labels, cls_preds)
+    return loc_t, loc_m, cls_t
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection
+# ---------------------------------------------------------------------------
+
+
+def _decode_loc(anchors, loc_pred, variances, clip):
+    """multibox_detection.cc:46 TransformLocations."""
+    ax, ay, aw, ah = _corner_to_center(anchors)
+    vx, vy, vw, vh = variances
+    ox = loc_pred[..., 0] * vx * aw + ax
+    oy = loc_pred[..., 1] * vy * ah + ay
+    ow = jnp.exp(loc_pred[..., 2] * vw) * aw * 0.5
+    oh = jnp.exp(loc_pred[..., 3] * vh) * ah * 0.5
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("MultiBoxDetection", namespace=NS, differentiable=False,
+          aliases=("multibox_detection",))
+def _multibox_detection(cls_prob, loc_pred, anchors, clip: bool = True,
+                        threshold: float = 0.01, background_id: int = 0,
+                        nms_threshold: float = 0.5,
+                        force_suppress: bool = False, keep_topk: int = -1,
+                        nms_topk: int = -1, variances=(0.1, 0.1, 0.2, 0.2)):
+    """multibox_detection.cc: decode + per-class greedy NMS.
+
+    cls_prob (N,num_cls,A), loc_pred (N,4A), anchors (1,A,4) →
+    (N, A, 6) rows [cls_id, score, x1,y1,x2,y2]; invalid rows cls_id=-1."""
+    anchors = anchors.reshape(-1, 4)
+    A = anchors.shape[0]
+
+    def one_batch(probs, locs):
+        locs = locs.reshape(A, 4)
+        # drop background row, pick best foreground class per anchor
+        fg = jnp.concatenate([probs[:background_id], probs[background_id + 1:]],
+                             axis=0)                       # (C-1, A)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        valid = score > threshold
+        cls_id = jnp.where(valid, cls_id, -1.0)
+        score = jnp.where(valid, score, -1.0)
+        boxes = _decode_loc(anchors, locs, variances, clip)
+
+        order = jnp.argsort(-score)
+        if nms_topk > 0:
+            keep_rank = jnp.arange(A) < nms_topk
+        else:
+            keep_rank = jnp.ones((A,), bool)
+        cls_s, score_s, boxes_s = cls_id[order], score[order], boxes[order]
+        score_s = jnp.where(keep_rank, score_s, -1.0)
+        iou = _pair_iou(boxes_s, boxes_s)
+        if not force_suppress:
+            same = cls_s[:, None] == cls_s[None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(i, keep):
+            sup = (iou[i] > nms_threshold) & (jnp.arange(A) > i) & keep[i]
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, A, body, score_s > -1.0)
+        cls_out = jnp.where(keep, cls_s, -1.0)
+        score_out = jnp.where(keep, score_s, -1.0)
+        return jnp.concatenate([cls_out[:, None], score_out[:, None], boxes_s],
+                               axis=1)
+
+    return jax.vmap(one_batch)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# Proposal (RPN)
+# ---------------------------------------------------------------------------
+
+
+def _rpn_anchors(h, w, stride, scales, ratios):
+    """proposal.cc GenerateAnchors: base anchors at stride grid (image coords)."""
+    base = float(stride)
+    px, py = (base - 1) * 0.5, (base - 1) * 0.5
+    boxes = []
+    for r in ratios:
+        size = base * base / r
+        ws = round(float(np.sqrt(size)))
+        hs = round(float(ws * r))
+        for s in scales:
+            w2, h2 = ws * s * 0.5, hs * s * 0.5
+            boxes.append([px - w2 + 0.5, py - h2 + 0.5, px + w2 - 0.5,
+                          py + h2 - 0.5])
+    base_a = jnp.asarray(boxes, jnp.float32)                 # (A, 4)
+    sx = jnp.arange(w, dtype=jnp.float32) * stride
+    sy = jnp.arange(h, dtype=jnp.float32) * stride
+    shift = jnp.stack([
+        jnp.broadcast_to(sx[None, :], (h, w)),
+        jnp.broadcast_to(sy[:, None], (h, w)),
+        jnp.broadcast_to(sx[None, :], (h, w)),
+        jnp.broadcast_to(sy[:, None], (h, w))], axis=-1)     # (h, w, 4)
+    return (shift[:, :, None, :] + base_a[None, None, :, :]).reshape(-1, 4)
+
+
+@register("Proposal", namespace=NS, differentiable=False,
+          aliases=("proposal",))
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n: int = 6000,
+              rpn_post_nms_top_n: int = 300, threshold: float = 0.7,
+              rpn_min_size: int = 16, scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride: int = 16, output_score: bool = False,
+              iou_loss: bool = False):
+    """contrib/proposal.cc: RPN proposal generation.
+
+    cls_prob (N, 2A, h, w) (bg/fg per anchor), bbox_pred (N, 4A, h, w),
+    im_info (N, 3) [height, width, scale]. Output (N*post_nms, 5) rois
+    [batch_idx, x1,y1,x2,y2] (+ optional scores (N*post_nms, 1))."""
+    N, _, h, w = cls_prob.shape
+    A = len(scales) * len(ratios)
+    anchors = _rpn_anchors(h, w, feature_stride, scales, ratios)   # (hwA, 4)
+    K = anchors.shape[0]
+    pre_n = min(rpn_pre_nms_top_n, K) if rpn_pre_nms_top_n > 0 else K
+    post_n = rpn_post_nms_top_n
+
+    def one_batch(probs, deltas, info):
+        fg = probs[A:].transpose(1, 2, 0).reshape(-1)              # (hwA,)
+        d = deltas.transpose(1, 2, 0).reshape(-1, 4)
+        ax, ay, aw, ah = _corner_to_center(anchors)
+        aw, ah = aw + 1.0, ah + 1.0                                # pixel conv.
+        cx = d[:, 0] * aw + ax
+        cy = d[:, 1] * ah + ay
+        pw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        ph = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - 0.5 * (pw - 1), cy - 0.5 * (ph - 1),
+                           cx + 0.5 * (pw - 1), cy + 0.5 * (ph - 1)], -1)
+        boxes = jnp.clip(boxes, 0.0,
+                         jnp.stack([info[1] - 1, info[0] - 1,
+                                    info[1] - 1, info[0] - 1]))
+        min_size = rpn_min_size * info[2]
+        keep_size = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+                    ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        scores = jnp.where(keep_size, fg, -1.0)
+        top_scores, top_idx = lax.top_k(scores, pre_n)
+        top_boxes = boxes[top_idx]
+        iou = _pair_iou(top_boxes, top_boxes)
+
+        def body(i, keep):
+            sup = (iou[i] > threshold) & (jnp.arange(pre_n) > i) & keep[i]
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, pre_n, body, top_scores > -1.0)
+        nms_score = jnp.where(keep, top_scores, -1.0)
+        sel_scores, sel = lax.top_k(nms_score, min(post_n, pre_n))
+        rois = top_boxes[sel]
+        if post_n > pre_n:
+            pad = post_n - pre_n
+            rois = jnp.concatenate([rois, jnp.tile(rois[:1], (pad, 1))], 0)
+            sel_scores = jnp.concatenate([sel_scores,
+                                          jnp.tile(sel_scores[:1], (pad,))], 0)
+        return rois, sel_scores
+
+    rois, scores = jax.vmap(one_batch)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=jnp.float32), post_n)[:, None]
+    out = jnp.concatenate([batch_idx, rois.reshape(-1, 4)], axis=1)
+    if output_score:
+        return out, scores.reshape(-1, 1)
+    return out
+
+
+def _multi_proposal(*args, **kwargs):
+    return _proposal(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling / PSROIPooling
+# ---------------------------------------------------------------------------
+
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale: float = 1.0):
+    """src/operator/roi_pooling.cc: max pooling over ROI bins.
+
+    data (N,C,H,W); rois (R,5) [batch_idx, x1,y1,x2,y2] in image coords.
+    Masked-max formulation (static shapes; bins never materialize a gather)."""
+    N, C, H, W = data.shape
+    ph, pw = pooled_size
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        img = data[b]                                       # (C, H, W)
+
+        def bin_val(iy, ix):
+            hs = jnp.floor(y1 + iy * bin_h)
+            he = jnp.ceil(y1 + (iy + 1) * bin_h)
+            ws_ = jnp.floor(x1 + ix * bin_w)
+            we = jnp.ceil(x1 + (ix + 1) * bin_w)
+            mask = ((ys >= hs) & (ys < he))[:, None] & \
+                   ((xs >= ws_) & (xs < we))[None, :]
+            empty = ~jnp.any(mask)
+            v = jnp.where(mask[None], img, -jnp.inf).max(axis=(1, 2))
+            return jnp.where(empty, 0.0, v)
+
+        iy = jnp.arange(ph)
+        ix = jnp.arange(pw)
+        vals = jax.vmap(lambda y: jax.vmap(lambda x: bin_val(y, x))(ix))(iy)
+        return vals.transpose(2, 0, 1)                      # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("PSROIPooling", namespace=NS, aliases=("psroi_pooling",))
+def _psroi_pooling(data, rois, spatial_scale: float = 1.0, output_dim: int = 0,
+                   pooled_size: int = 7, group_size: int = 0):
+    """contrib/psroi_pooling.cc: position-sensitive ROI average pooling.
+
+    data (N, output_dim*k*k, H, W); each (iy,ix) bin averages its own channel
+    group (position sensitivity, the R-FCN trick)."""
+    k = pooled_size
+    group = group_size if group_size > 0 else k
+    N, Ck, H, W = data.shape
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / k, rw / k
+        img = data[b].reshape(output_dim, k * k, H, W)
+
+        def bin_val(iy, ix):
+            hs = jnp.floor(y1 + iy * bin_h)
+            he = jnp.ceil(y1 + (iy + 1) * bin_h)
+            ws_ = jnp.floor(x1 + ix * bin_w)
+            we = jnp.ceil(x1 + (ix + 1) * bin_w)
+            mask = ((ys >= hs) & (ys < he))[:, None] & \
+                   ((xs >= ws_) & (xs < we))[None, :]
+            gidx = (iy * group // k) * group + (ix * group // k)
+            chan = img[:, gidx]                             # (output_dim, H, W)
+            cnt = jnp.maximum(jnp.sum(mask), 1)
+            return jnp.where(mask[None], chan, 0.0).sum((1, 2)) / cnt
+
+        iy = jnp.arange(k)
+        ix = jnp.arange(k)
+        vals = jax.vmap(lambda y: jax.vmap(lambda x: bin_val(y, x))(ix))(iy)
+        return vals.transpose(2, 0, 1)                      # (output_dim, k, k)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_gather(img, y, x):
+    """Sample img (C,H,W) at float coords y,x (...,): bilinear, zero outside."""
+    C, H, W = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1, wx1 = y - y0, x - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            inside = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            v = img[:, jnp.clip(yy, 0, H - 1), jnp.clip(xx, 0, W - 1)]
+            out = out + v * (wy * wx * inside)[None]
+    return out
+
+
+@register("DeformableConvolution", namespace=NS,
+          aliases=("deformable_convolution",))
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                            num_filter: int = 0, num_group: int = 1,
+                            num_deformable_group: int = 1,
+                            no_bias: bool = False):
+    """contrib/deformable_convolution.cc (DCNv1): each kernel tap samples at
+    its regular grid position plus a learned offset, bilinearly.
+
+    data (N,C,H,W); offset (N, 2*dg*kh*kw, OH, OW) ordered [dy,dx] per tap.
+    Implementation: gather the deformed im2col patches with a vectorized
+    bilinear sampler, then contract with the weight — the contraction is a
+    plain dot_general on the MXU."""
+    N, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph_, pw_ = pad
+    OH = (H + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+    OW = (W + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+    dg = num_deformable_group
+
+    oy = jnp.arange(OH, dtype=jnp.float32) * sh - ph_
+    ox = jnp.arange(OW, dtype=jnp.float32) * sw - pw_
+
+    def one_image(img, off):
+        off = off.reshape(dg, kh * kw, 2, OH, OW)
+
+        def tap(t):
+            ky, kx = t // kw, t % kw
+            base_y = oy[:, None] + ky * dh                  # (OH, 1)
+            base_x = ox[None, :] + kx * dw                  # (1, OW)
+
+            def group_sample(g):
+                dy = off[g, t, 0]
+                dx = off[g, t, 1]
+                y = base_y + dy
+                x = base_x + dx
+                cpg = C // dg
+                return _bilinear_gather(
+                    img[g * cpg:(g + 1) * cpg], y, x)       # (cpg, OH, OW)
+
+            return jnp.concatenate([group_sample(g) for g in range(dg)], 0)
+
+        cols = jnp.stack([tap(t) for t in range(kh * kw)], 1)  # (C, khkw, OH, OW)
+        return cols
+
+    cols = jax.vmap(one_image)(data, offset)                # (N, C, khkw, OH, OW)
+    w = weight.reshape(num_group, num_filter // num_group,
+                       C // num_group, kh * kw)
+    cols = cols.reshape(N, num_group, C // num_group, kh * kw, OH, OW)
+    out = jnp.einsum("ngckhw,gock->ngohw", cols, w.transpose(0, 1, 2, 3))
+    out = out.reshape(N, num_filter, OH, OW)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _register_aliases():
+    from .registry import alias
+    alias("contrib.Proposal", "MultiProposal", "multi_proposal",
+          namespace="contrib")
+
+
+_register_aliases()

@@ -1,0 +1,118 @@
+"""INT8 quantization ops — capability parity with ``src/operator/quantization/``
+(quantize.cc, dequantize.cc, requantize.cc, quantized_conv.cc,
+quantized_fully_connected.cc; driven from python/mxnet/contrib/quantization.py).
+
+TPU-native design: int8 matmuls/convs issue ``lax.dot_general`` /
+``lax.conv_general_dilated`` with int8 operands and
+``preferred_element_type=int32`` — XLA lowers these onto the MXU's int8 path
+(2x the bf16 peak on v5e: 394 vs 197 TOPS). The reference's separate
+quantize→quantized_op→requantize node chains collapse: the compiled path keeps
+activations float at layer boundaries (fake-quant on the way in), which is the
+same numerics with fewer HBM round-trips, letting XLA fuse the rescale into the
+int32 accumulator readout.
+
+Range convention matches the reference (quantization_utils.h): a float range
+[min, max] maps onto the signed int range symmetrically via
+``scale = q_max / max(|min|, |max|)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+NS = "contrib"
+
+_QMAX = {"int8": 127.0, "uint8": 255.0}
+
+
+def _scale_of(min_range, max_range, out_type="int8"):
+    absmax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return _QMAX[out_type] / jnp.maximum(absmax, 1e-30)
+
+
+@register("quantize", namespace=NS, num_outputs=3, differentiable=False)
+def _quantize(data, min_range, max_range, out_type: str = "int8"):
+    """quantize.cc parity: float -> int8/uint8 given a calibrated range.
+
+    Returns (quantized, out_min, out_max) like the reference (3 outputs so the
+    range travels with the tensor through a quantized graph)."""
+    scale = _scale_of(min_range, max_range, out_type)
+    q = jnp.clip(jnp.round(data * scale), -_QMAX[out_type], _QMAX[out_type])
+    dt = jnp.int8 if out_type == "int8" else jnp.uint8
+    absmax = _QMAX[out_type] / scale
+    return q.astype(dt), -absmax, absmax
+
+
+@register("dequantize", namespace=NS, differentiable=False)
+def _dequantize(data, min_range, max_range, out_type: str = "float32"):
+    """dequantize.cc parity: int8/uint8 -> float given the tensor's range."""
+    qmax = _QMAX["uint8" if data.dtype == jnp.uint8 else "int8"]
+    absmax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(out_type) * (absmax / qmax)
+
+
+@register("requantize", namespace=NS, num_outputs=3, differentiable=False)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None):
+    """requantize.cc parity: int32 accumulator -> int8 with a (calibrated or
+    on-the-fly) output range."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / 2147483647.0)
+    if min_calib_range is None:
+        max_calib_range = jnp.max(jnp.abs(real))
+        min_calib_range = -max_calib_range
+    scale = _scale_of(min_calib_range, max_calib_range, "int8")
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
+    return q, min_calib_range, max_calib_range
+
+
+def int8_dense(x, w_q, w_scale, x_scale, bias=None):
+    """int8 x int8 -> int32 matmul on the MXU, rescaled to float.
+
+    ``x`` is float; it is quantized with the calibrated ``x_scale`` on the way
+    in (fake-quant boundary). ``w_q`` is pre-quantized int8 [out, in];
+    ``w_scale`` is per-output-channel [out]. Parity target:
+    quantized_fully_connected.cc."""
+    x_q = jnp.clip(jnp.round(x * x_scale), -127, 127).astype(jnp.int8)
+    acc = lax.dot_general(x_q, w_q, (((x_q.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) / (x_scale * w_scale)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def int8_conv(x, w_q, w_scale, x_scale, bias=None, stride=(1, 1), pad=(0, 0),
+              dilate=(1, 1), groups: int = 1):
+    """int8 x int8 -> int32 NCHW convolution on the MXU, rescaled to float.
+
+    ``w_q`` int8 [O, I/g, KH, KW]; ``w_scale`` per-output-channel [O]. Parity
+    target: quantized_conv.cc."""
+    x_q = jnp.clip(jnp.round(x * x_scale), -127, 127).astype(jnp.int8)
+    dn = lax.conv_dimension_numbers(x.shape, w_q.shape, ("NCHW", "OIHW", "NCHW"))
+    acc = lax.conv_general_dilated(
+        x_q, w_q, window_strides=tuple(stride), padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate), dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) / (x_scale * w_scale.reshape(1, -1, 1, 1))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def quantize_weight(w, per_channel_axis=0):
+    """Symmetric per-output-channel int8 weight quantization.
+
+    Returns (w_q int8, scale) with ``w ~= w_q / scale`` (scale shaped for the
+    channel axis). The reference quantizes weights per-tensor
+    (quantize_graph_pass); per-channel is strictly more accurate and free on
+    TPU since the rescale fuses into the accumulator readout."""
+    red = tuple(i for i in range(w.ndim) if i != per_channel_axis)
+    absmax = jnp.max(jnp.abs(w), axis=red, keepdims=True)
+    scale = 127.0 / jnp.maximum(absmax, 1e-30)
+    w_q = jnp.clip(jnp.round(w * scale), -127, 127).astype(jnp.int8)
+    return w_q, scale.reshape(-1)

@@ -185,7 +185,11 @@ class DataParallelTrainer:
                           repl, repl, None),
             out_shardings=(self._param_sh, repl, self._state_sh, repl))
 
-    def step(self, x, y) -> float:
+    def step_async(self, x, y) -> NDArray:
+        """One SPMD train step; returns the loss WITHOUT a host sync, so callers
+        can keep the device queue full (JAX async dispatch ≈ the reference
+        engine's lazy push; WaitToRead happens when the caller materializes the
+        loss)."""
         x = x if isinstance(x, NDArray) else nd_mod.array(x)
         y = y if isinstance(y, NDArray) else nd_mod.array(y)
         if self._step_fn is None:
@@ -199,8 +203,13 @@ class DataParallelTrainer:
         key = jax.random.key(self._t)
         params = [p.data().data for p in self._param_handles]
         auxs = [p.data().data for p in self._aux_handles]
-        new_params, new_auxs, new_states, loss = self._step_fn(
-            params, auxs, self._states, xs, ys, lr, key, self._t)
+        args = (params, auxs, self._states, xs, ys, lr, key, self._t)
+        # keep only avals (shape/dtype) for cost_analysis — holding the real
+        # arrays would pin the previous step's buffers in HBM
+        self._last_avals = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") else a, args)
+        new_params, new_auxs, new_states, loss = self._step_fn(*args)
         for p, v in zip(self._param_handles, new_params):
             p._data._data = v
             p._data._version += 1
@@ -209,4 +218,21 @@ class DataParallelTrainer:
             p._data._version += 1
         self._states = new_states
         self.optimizer.num_update = self._t
-        return float(loss)
+        return NDArray(loss)
+
+    def step(self, x, y) -> float:
+        return float(self.step_async(x, y).data)
+
+    def cost_analysis(self) -> dict:
+        """XLA's own cost model for the compiled step (flops, bytes accessed).
+        Valid after the first step; used by bench.py for honest MFU accounting.
+        The lowering/compile for the analysis is cached (first call only)."""
+        if self._step_fn is None or not hasattr(self, "_last_avals"):
+            raise RuntimeError("run at least one step first")
+        if not hasattr(self, "_cost_cache"):
+            compiled = self._step_fn.lower(*self._last_avals).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            self._cost_cache = dict(ca) if ca else {}
+        return self._cost_cache

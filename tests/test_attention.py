@@ -39,21 +39,64 @@ def test_flash_pallas_interpret_matches_reference():
     q, k, v = _qkv(B=1, H=2, T=128, D=128)
     qa, ka, va = map(jnp.asarray, (q, k, v))
     ref = attention_reference(qa, ka, va)
-    out = _flash_attention_pallas(qa, ka, va, causal=False,
-                                  scale=1.0 / np.sqrt(128), interpret=True)
+    out, lse = _flash_attention_pallas(qa, ka, va, causal=False,
+                                       scale=1.0 / np.sqrt(128), interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
                                atol=1e-4)
+    # lse parity vs explicit logsumexp
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(128)
+    ref_lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) \
+        + logits.max(-1)
+    np.testing.assert_allclose(np.asarray(lse).reshape(1, 2, 128), ref_lse,
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_flash_pallas_interpret_causal():
     q, k, v = _qkv(B=1, H=1, T=256, D=128, seed=2)
     qa, ka, va = map(jnp.asarray, (q, k, v))
     ref = attention_reference(qa, ka, va, causal=True)
-    out = _flash_attention_pallas(qa, ka, va, causal=True,
-                                  scale=1.0 / np.sqrt(128), block_q=128,
-                                  block_k=128, interpret=True)
+    out, _ = _flash_attention_pallas(qa, ka, va, causal=True,
+                                     scale=1.0 / np.sqrt(128), block_q=128,
+                                     block_k=128, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
                                atol=1e-4)
+
+
+@pytest.mark.parametrize("D,T,causal", [(64, 128, False), (96, 128, True),
+                                        (128, 120, False)])
+def test_flash_pallas_production_shapes(D, T, causal):
+    """Head dims 64/96 (lane padding) and non-128 T (block fallback) must run
+    through the kernel and match the reference."""
+    q, k, v = _qkv(B=1, H=2, T=T, D=D, seed=3)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    ref = attention_reference(qa, ka, va, causal=causal)
+    out, _ = _flash_attention_pallas(qa, ka, va, causal=causal,
+                                     scale=1.0 / np.sqrt(D), interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("D,causal", [(64, False), (128, True)])
+def test_flash_pallas_backward_matches_reference(D, causal):
+    """The Pallas backward kernels (dq + dk/dv) against jax.grad of the XLA
+    reference."""
+    from mxtpu.ops.attention import _flash_backward_pallas
+    B, H, T = 1, 2, 128
+    q, k, v = _qkv(B=B, H=H, T=T, D=D, seed=4)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    scale = 1.0 / np.sqrt(D)
+    g = jnp.asarray(np.random.RandomState(5).randn(B, H, T, D).astype(np.float32))
+
+    out, lse = _flash_attention_pallas(qa, ka, va, causal=causal, scale=scale,
+                                       interpret=True)
+    dq, dk, dv = _flash_backward_pallas(qa, ka, va, out, lse, g, causal, scale,
+                                        interpret=True)
+    _, vjp = jax.vjp(lambda q_, k_, v_: attention_reference(
+        q_, k_, v_, causal=causal, scale=scale), qa, ka, va)
+    rq, rk, rv = vjp(g)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=1e-3, atol=1e-4)
 
 
 def test_nd_attention_op_and_grad():

@@ -1,0 +1,178 @@
+"""CustomOp escape hatch: mx.operator.CustomOp/CustomOpProp registration
+executing via jax.pure_callback + custom_vjp — eager, recorded (autograd),
+inside hybridized blocks, and under Module.fit.
+Reference surface: python/mxnet/operator.py:426-692, custom-inl.h:50-170.
+"""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu import operator as mxop
+from mxtpu.gluon import nn
+
+
+@mxop.register("scaled_sigmoid")
+class ScaledSigmoidProp(mxop.CustomOpProp):
+    """The reference docs' canonical example (a sigmoid with a config kwarg)."""
+
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        scale = self.scale
+
+        class ScaledSigmoid(mxop.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0], scale / (1.0 + np.exp(-x)))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                y = out_data[0].asnumpy() / scale
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0], g * scale * y * (1.0 - y))
+
+        return ScaledSigmoid()
+
+
+@mxop.register("host_split")
+class HostSplitProp(mxop.CustomOpProp):
+    """Two-output op: exercises multi-output callback plumbing."""
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["pos", "neg"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class HostSplit(mxop.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0], np.maximum(x, 0))
+                self.assign(out_data[1], req[1], np.minimum(x, 0))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                x = in_data[0].asnumpy()
+                g = (out_grad[0].asnumpy() * (x > 0)
+                     + out_grad[1].asnumpy() * (x <= 0))
+                self.assign(in_grad[0], req[0], g)
+
+        return HostSplit()
+
+
+def test_custom_eager_forward():
+    x = nd.array(np.linspace(-2, 2, 12).reshape(3, 4).astype(np.float32))
+    y = nd.Custom(x, op_type="scaled_sigmoid", scale=2.0)
+    ref = 2.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-6)
+
+
+def test_custom_eager_backward():
+    xv = np.linspace(-2, 2, 12).reshape(3, 4).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="scaled_sigmoid", scale=3.0)
+        loss = (y * y).sum()
+    loss.backward()
+    s = 3.0 / (1.0 + np.exp(-xv))
+    ref_grad = 2 * s * s * (1.0 - s / 3.0)
+    np.testing.assert_allclose(x.grad.asnumpy(), ref_grad, rtol=1e-5)
+
+
+def test_custom_multi_output():
+    xv = np.array([[-1.0, 2.0], [3.0, -4.0]], np.float32)
+    x = nd.array(xv)
+    pos, neg = nd.Custom(x, op_type="host_split")
+    np.testing.assert_allclose(pos.asnumpy(), np.maximum(xv, 0))
+    np.testing.assert_allclose(neg.asnumpy(), np.minimum(xv, 0))
+    x.attach_grad()
+    with autograd.record():
+        p, n = nd.Custom(x, op_type="host_split")
+        loss = (2 * p + 3 * n).sum()
+    loss.backward()
+    ref = np.where(xv > 0, 2.0, 3.0)
+    np.testing.assert_allclose(x.grad.asnumpy(), ref)
+
+
+class SigmoidBlock(nn.HybridSequential):
+    pass
+
+
+def test_custom_inside_hybridized_block():
+    """The in-jit requirement: a hybridized block whose forward contains the
+    Custom op must compile (pure_callback) and train (custom_vjp)."""
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.dense = nn.Dense(8)
+                self.out = nn.Dense(2)
+
+        def forward(self, x):
+            h = self.dense(x)
+            h = nd.Custom(h, op_type="scaled_sigmoid", scale=1.0)
+            return self.out(h)
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(4, 6).astype(np.float32))
+    out1 = net(x)
+    out2 = net(x)  # second call: compiled-cache path
+    assert out1.shape == (4, 2)
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), rtol=1e-6)
+
+    # gradient through the hybridized graph
+    x.attach_grad()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    assert float((x.grad ** 2).sum().asnumpy()) > 0
+
+
+def test_custom_under_module_fit():
+    import mxtpu.io as mio
+    from mxtpu.module import Module
+    from mxtpu import symbol as sym_mod
+
+    rs = np.random.RandomState(1)
+    x = rs.randn(64, 10).astype(np.float32)
+    w = rs.randn(10, 2).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.h = nn.Dense(16)
+                self.o = nn.Dense(2)
+
+        def forward(self, d):
+            z = nd.Custom(self.h(d), op_type="scaled_sigmoid", scale=1.0)
+            return self.o(z)
+
+    net = Net()
+    mod = Module(net, data_names=("data",), label_names=("softmax_label",))
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod.fit(it, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05})
+    score = mod.score(mio.NDArrayIter(x, y, batch_size=16), "acc")
+    acc = dict(score)["accuracy"] if isinstance(score, list) else score
+    assert acc > 0.8, acc

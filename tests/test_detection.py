@@ -1,0 +1,347 @@
+"""Detection + spatial op family: MultiBoxPrior/Target/Detection, Proposal,
+ROIPooling, PSROIPooling, DeformableConvolution, SpatialTransformer/
+BilinearSampler/GridGenerator, Correlation, FFT — plus an SSD-shaped
+integration flow (prior gen → target match → detection+NMS).
+Reference surface: src/operator/contrib/multibox_*.cc, proposal.cc,
+roi_pooling.cc, psroi_pooling.cc, deformable_convolution.cc,
+spatial_transformer.cc, bilinear_sampler.cc, correlation-inl.h, fft-inl.h.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxtpu import nd
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+
+
+def test_multibox_prior_shapes_and_values():
+    data = nd.zeros((1, 3, 4, 6))
+    out = nd.contrib.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    # anchors per location = num_sizes + num_ratios - 1 = 3
+    assert out.shape == (1, 4 * 6 * 3, 4)
+    a = out.asnumpy()[0]
+    # first anchor at (0,0): center ((0+.5)/6, (0+.5)/4), size .5 with h/w aspect
+    cx, cy = 0.5 / 6, 0.5 / 4
+    w = 0.5 * 4 / 6 / 2
+    h = 0.5 / 2
+    np.testing.assert_allclose(a[0], [cx - w, cy - h, cx + w, cy + h], atol=1e-6)
+    # ratio-2 anchor uses sizes[0] and sqrt-ratio scaling
+    sq = np.sqrt(2.0)
+    w2 = 0.5 * 4 / 6 * sq / 2
+    h2 = 0.5 / sq / 2
+    np.testing.assert_allclose(a[2], [cx - w2, cy - h2, cx + w2, cy + h2],
+                               atol=1e-6)
+
+
+def test_multibox_prior_clip():
+    out = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=(1.5,),
+                                   clip=True)
+    a = out.asnumpy()
+    assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+
+
+def _ssd_fixture():
+    # 4 anchors: one perfectly on each gt, two far away
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.6, 0.6, 0.9, 0.9],
+                         [0.0, 0.0, 0.05, 0.05],
+                         [0.5, 0.0, 0.55, 0.05]]], np.float32)
+    # labels: (N, G, 5) [cls, x1,y1,x2,y2], -1 padded
+    labels = np.array([[[0, 0.1, 0.1, 0.3, 0.3],
+                        [1, 0.62, 0.62, 0.88, 0.88],
+                        [-1, -1, -1, -1, -1]]], np.float32)
+    cls_preds = np.zeros((1, 3, 4), np.float32)  # 3 classes (bg + 2)
+    return anchors, labels, cls_preds
+
+
+def test_multibox_target_matching():
+    anchors, labels, cls_preds = _ssd_fixture()
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds))
+    assert loc_t.shape == (1, 16) and loc_m.shape == (1, 16)
+    assert cls_t.shape == (1, 4)
+    ct = cls_t.asnumpy()[0]
+    # anchor 0 → gt 0 (cls 0 → target 1); anchor 1 → gt 1 (cls 1 → target 2)
+    assert ct[0] == 1.0 and ct[1] == 2.0
+    # far-away anchors are background (no mining by default → negatives)
+    assert ct[2] == 0.0 and ct[3] == 0.0
+    lm = loc_m.asnumpy()[0].reshape(4, 4)
+    np.testing.assert_allclose(lm[0], 1.0)
+    np.testing.assert_allclose(lm[2], 0.0)
+    # anchor0 loc target: perfect match → near-zero offsets
+    lt = loc_t.asnumpy()[0].reshape(4, 4)
+    np.testing.assert_allclose(lt[0], 0.0, atol=1e-5)
+    # anchor1: shifted gt → nonzero encoded target
+    assert np.abs(lt[1]).sum() > 0.01
+
+
+def test_multibox_target_negative_mining():
+    anchors, labels, cls_preds = _ssd_fixture()
+    # make anchor 3 a confident-foreground (hard) negative
+    cls_preds[0, 1, 3] = 5.0
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds),
+        negative_mining_ratio=0.5, negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    # 2 positives × 0.5 = 1 negative: the hard one (anchor 3); anchor 2 ignored
+    assert ct[3] == 0.0
+    assert ct[2] == -1.0
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection + SSD integration
+# ---------------------------------------------------------------------------
+
+
+def test_multibox_detection_decodes_and_nms():
+    anchors, labels, _ = _ssd_fixture()
+    A = anchors.shape[1]
+    # classifier certain: anchor0 → cls1, anchor1 → cls2, rest background
+    cls_prob = np.zeros((1, 3, A), np.float32)
+    cls_prob[0, 1, 0] = 0.9
+    cls_prob[0, 0, 0] = 0.1
+    cls_prob[0, 2, 1] = 0.8
+    cls_prob[0, 0, 1] = 0.2
+    cls_prob[0, 0, 2:] = 1.0
+    loc_pred = np.zeros((1, 4 * A), np.float32)  # zero offsets → anchors
+    out = nd.contrib.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                       nd.array(anchors))
+    det = out.asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    assert len(kept) == 2
+    # rows sorted by score: anchor0 (0.9, cls 0) first
+    np.testing.assert_allclose(kept[0, :2], [0.0, 0.9], atol=1e-6)
+    np.testing.assert_allclose(kept[0, 2:], anchors[0, 0], atol=1e-5)
+    np.testing.assert_allclose(kept[1, :2], [1.0, 0.8], atol=1e-6)
+
+
+def test_ssd_integration_roundtrip():
+    """prior gen → encode targets → decode predictions recovers the gt box."""
+    data = nd.zeros((1, 8, 8, 8))
+    anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.3, 0.15),
+                                       ratios=(1.0, 2.0, 0.5))
+    A = anchors.shape[1]
+    gt = np.array([[[1, 0.22, 0.28, 0.55, 0.61],
+                    [-1, -1, -1, -1, -1]]], np.float32)
+    cls_preds = np.zeros((1, 3, A), np.float32)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, nd.array(gt), nd.array(cls_preds))
+    # feed the encoded targets back as "predictions" with a perfect classifier
+    ct = cls_t.asnumpy()[0]
+    pos = np.where(ct == 2.0)[0]
+    assert len(pos) > 0
+    cls_prob = np.zeros((1, 3, A), np.float32)
+    cls_prob[0, 0, :] = 1.0
+    cls_prob[0, 2, pos] = 0.99
+    cls_prob[0, 0, pos] = 0.01
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_t, anchors,
+                                       nms_threshold=0.45)
+    det = out.asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    assert len(kept) >= 1
+    # best detection box ≈ the ground-truth box
+    np.testing.assert_allclose(kept[0, 2:], gt[0, 0, 1:], atol=2e-2)
+    assert kept[0, 0] == 1.0  # class id (0-based after bg removal)
+
+
+# ---------------------------------------------------------------------------
+# Proposal
+# ---------------------------------------------------------------------------
+
+
+def test_proposal_shapes_and_clipping():
+    N, A, h, w = 1, 12, 4, 4  # A = len(scales) * len(ratios)
+    rs = np.random.RandomState(0)
+    cls_prob = rs.rand(N, 2 * A, h, w).astype(np.float32)
+    bbox_pred = (rs.randn(N, 4 * A, h, w) * 0.1).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = nd.contrib.Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                               nd.array(im_info), rpn_pre_nms_top_n=50,
+                               rpn_post_nms_top_n=10, feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1:] >= 0).all() and (r[:, 1:] <= 63).all()
+    # MultiProposal alias
+    rois2 = nd.contrib.MultiProposal(nd.array(cls_prob), nd.array(bbox_pred),
+                                     nd.array(im_info), rpn_pre_nms_top_n=50,
+                                     rpn_post_nms_top_n=10)
+    assert rois2.shape == (10, 5)
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling / PSROIPooling
+# ---------------------------------------------------------------------------
+
+
+def test_roi_pooling_max_semantics():
+    data = np.arange(1 * 1 * 8 * 8, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)  # whole image
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    o = out.asnumpy()[0, 0]
+    # max of each quadrant
+    np.testing.assert_allclose(o, [[27, 31], [59, 63]])
+
+
+def test_psroi_pooling_position_sensitivity():
+    k = 2
+    out_dim = 3
+    data = np.zeros((1, out_dim * k * k, 6, 6), np.float32)
+    # channel group g gets constant value g+1
+    for g in range(k * k):
+        data[0, [g + c * k * k for c in range(out_dim)]] = g + 1
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=out_dim,
+                                  pooled_size=k)
+    o = out.asnumpy()[0]
+    assert o.shape == (out_dim, k, k)
+    # bin (iy,ix) reads its own group → value iy*k+ix+1
+    for iy in range(k):
+        for ix in range(k):
+            np.testing.assert_allclose(o[:, iy, ix], iy * k + ix + 1)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 4, 9, 9).astype(np.float32)
+    w = rs.randn(6, 4, 3, 3).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(offset), nd.array(w), kernel=(3, 3),
+        num_filter=6, no_bias=True)
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=6,
+                         no_bias=True)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    """Offset (0,1) everywhere == convolving the x-shifted image (interior)."""
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 2, 8, 8).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    offset[:, 1::2] = 1.0  # dx = +1 for every tap
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(offset), nd.array(w), kernel=(3, 3),
+        num_filter=3, no_bias=True).asnumpy()
+    x_shift = np.roll(x, -1, axis=3)
+    ref = nd.Convolution(nd.array(x_shift), nd.array(w), kernel=(3, 3),
+                         num_filter=3, no_bias=True).asnumpy()
+    np.testing.assert_allclose(out[..., :-1], ref[..., :-1], rtol=1e-4,
+                               atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer / BilinearSampler / GridGenerator
+# ---------------------------------------------------------------------------
+
+
+def test_grid_generator_identity_affine():
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = nd.GridGenerator(theta, transform_type="affine", target_shape=(4, 5))
+    assert grid.shape == (1, 2, 4, 5)
+    g = grid.asnumpy()[0]
+    np.testing.assert_allclose(g[0, 0], np.linspace(-1, 1, 5), atol=1e-6)
+    np.testing.assert_allclose(g[1, :, 0], np.linspace(-1, 1, 4), atol=1e-6)
+
+
+def test_bilinear_sampler_identity_and_torch():
+    import torch
+    import torch.nn.functional as tF
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([[1, 0, 0, 0, 1, 0]], np.float32), (2, 1))
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(5, 7))
+    out = nd.BilinearSampler(nd.array(x), grid).asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+    # rotated affine vs torch grid_sample
+    th = np.tile(np.array([[0.8, 0.2, 0.1, -0.2, 0.9, -0.1]], np.float32),
+                 (2, 1))
+    out2 = nd.SpatialTransformer(nd.array(x), nd.array(th),
+                                 target_shape=(5, 7)).asnumpy()
+    tgrid = tF.affine_grid(torch.from_numpy(th.reshape(2, 2, 3)),
+                           size=(2, 3, 5, 7), align_corners=True)
+    ref = tF.grid_sample(torch.from_numpy(x), tgrid, mode="bilinear",
+                         padding_mode="zeros", align_corners=True).numpy()
+    np.testing.assert_allclose(out2, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_bilinear_sampler_grad_flows():
+    from mxtpu import autograd
+    x = nd.array(np.random.RandomState(4).randn(1, 2, 4, 4).astype(np.float32))
+    theta = nd.array(np.array([[0.9, 0, 0.05, 0, 0.9, -0.05]], np.float32))
+    x.attach_grad()
+    theta.attach_grad()
+    with autograd.record():
+        out = nd.SpatialTransformer(x, theta, target_shape=(4, 4))
+        loss = nd.sum(out * out)
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    assert np.abs(theta.grad.asnumpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# Correlation / FFT
+# ---------------------------------------------------------------------------
+
+
+def test_correlation_vs_numpy_oracle():
+    rs = np.random.RandomState(5)
+    x1 = rs.randn(1, 4, 9, 9).astype(np.float32)
+    x2 = rs.randn(1, 4, 9, 9).astype(np.float32)
+    r, pad = 2, 2
+    out = nd.Correlation(nd.array(x1), nd.array(x2), kernel_size=1,
+                         max_displacement=2, stride1=1, stride2=1,
+                         pad_size=pad).asnumpy()
+    assert out.shape[1] == 25  # (2r+1)^2 displacement channels
+    p1 = np.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    th, tw = out.shape[2], out.shape[3]
+    border = 2  # max_displacement + kernel_radius
+    for iy in range(-r, r + 1):
+        for ix in range(-r, r + 1):
+            ch = (iy + r) * 5 + (ix + r)
+            for oy in range(th):
+                for ox in range(tw):
+                    cy, cx = border + oy, border + ox
+                    ref = (p1[0, :, cy, cx] *
+                           p2[0, :, cy + iy, cx + ix]).sum() / 4.0
+                    np.testing.assert_allclose(out[0, ch, oy, ox], ref,
+                                               rtol=1e-4, atol=1e-4)
+
+
+def test_fft_ifft_roundtrip():
+    rs = np.random.RandomState(6)
+    x = rs.randn(3, 16).astype(np.float32)
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (3, 32)
+    # interleaved real/imag parity vs numpy
+    ref = np.fft.fft(x, axis=-1)
+    fr = f.asnumpy().reshape(3, 16, 2)
+    np.testing.assert_allclose(fr[..., 0], ref.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fr[..., 1], ref.imag, rtol=1e-4, atol=1e-4)
+    # unnormalized inverse (cuFFT convention): ifft(fft(x)) = x * d
+    back = nd.contrib.ifft(f).asnumpy()
+    np.testing.assert_allclose(back, x * 16, rtol=1e-4, atol=1e-3)

@@ -1,0 +1,144 @@
+"""INT8 quantization: ops (quantize/dequantize/requantize), int8 MXU kernels,
+calibration (naive + entropy), and end-to-end quantize_net accuracy parity.
+Reference surface: src/operator/quantization/, python/mxnet/contrib/quantization.py.
+"""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.contrib import quantization as qz
+from mxtpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    data = nd.array(np.random.RandomState(0).uniform(-3, 3, (4, 16)).astype(np.float32))
+    q, qmin, qmax = nd.contrib.quantize(data, nd.array([-3.0]), nd.array([3.0]),
+                                        out_type="int8")
+    assert q.dtype == np.int8
+    back = nd.contrib.dequantize(q, qmin, qmax)
+    np.testing.assert_allclose(back.asnumpy(), data.asnumpy(), atol=3.0 / 127 + 1e-6)
+
+
+def test_requantize_int32_to_int8():
+    rs = np.random.RandomState(1)
+    acc = nd.array(rs.randint(-2**20, 2**20, (8, 8)).astype(np.int32))
+    q, lo, hi = nd.contrib.requantize(acc, nd.array([-2.0**31 + 1]),
+                                      nd.array([2.0**31 - 1]))
+    assert q.dtype == np.int8
+    # real value of acc entries: acc * (2^31-1)/(2^31-1) = acc; output range
+    # should cover the observed max
+    real_max = float(np.abs(acc.asnumpy()).max())
+    assert float(hi.asnumpy()) == pytest.approx(real_max, rel=1e-5)
+
+
+def test_int8_dense_close_to_fp32():
+    rs = np.random.RandomState(2)
+    x = rs.randn(8, 64).astype(np.float32)
+    w = rs.randn(32, 64).astype(np.float32)
+    b = rs.randn(32).astype(np.float32)
+    from mxtpu.ops.quantization import int8_dense, quantize_weight
+    import jax.numpy as jnp
+    w_q, w_scale = quantize_weight(jnp.asarray(w))
+    x_scale = 127.0 / np.abs(x).max()
+    out = np.asarray(int8_dense(jnp.asarray(x), w_q, w_scale, x_scale,
+                                jnp.asarray(b)))
+    ref = x @ w.T + b
+    # int8 quantization error ~ 1% relative on random gaussians
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.03
+
+
+def test_int8_conv_close_to_fp32():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 8, 10, 10).astype(np.float32)
+    w = rs.randn(16, 8, 3, 3).astype(np.float32)
+    from mxtpu.ops.quantization import int8_conv, quantize_weight
+    import jax
+    import jax.numpy as jnp
+    w_q, w_scale = quantize_weight(jnp.asarray(w))
+    out = np.asarray(int8_conv(jnp.asarray(x), w_q, w_scale,
+                               127.0 / np.abs(x).max(), None, (1, 1), (1, 1)))
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    ref = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=dn))
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_entropy_threshold_clips_outliers():
+    rs = np.random.RandomState(4)
+    arr = rs.randn(100000).astype(np.float32)
+    arr[:10] *= 100.0  # inject outliers
+    t = qz._get_optimal_threshold(arr)
+    assert 0 < t < np.abs(arr).max() * 0.5  # KL clips far below the outlier max
+    # near-uniform data: threshold stays near the max
+    uni = rs.uniform(-1, 1, 100000).astype(np.float32)
+    t2 = qz._get_optimal_threshold(uni)
+    assert t2 > 0.8
+
+
+def _train_tiny_mlp(seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(256, 32).astype(np.float32)
+    w_true = rs.randn(32, 4).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xa, ya = nd.array(x), nd.array(y.astype(np.float32))
+    for _ in range(60):
+        with autograd.record():
+            L = lossfn(net(xa), ya).mean()
+        L.backward()
+        trainer.step(1)
+    return net, x, y
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_net_accuracy(calib_mode):
+    net, x, y = _train_tiny_mlp()
+    xa = nd.array(x)
+    with autograd.predict_mode():
+        fp32_pred = np.argmax(net(xa).asnumpy(), axis=1)
+    fp32_acc = (fp32_pred == y).mean()
+    calib = [nd.array(x[i * 64:(i + 1) * 64]) for i in range(4)]
+    qnet = qz.quantize_net(net, calib_mode=calib_mode,
+                           calib_data=calib if calib_mode != "none" else None,
+                           num_calib_batches=4)
+    with autograd.predict_mode():
+        q_pred = np.argmax(qnet(xa).asnumpy(), axis=1)
+    q_acc = (q_pred == y).mean()
+    agree = (q_pred == fp32_pred).mean()
+    assert agree > 0.95, (calib_mode, agree)
+    assert q_acc > fp32_acc - 0.05, (calib_mode, fp32_acc, q_acc)
+
+
+def test_quantize_net_conv_and_exclude():
+    """Quantized LeNet: conv layers quantized, excluded layer stays fp32."""
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.lenet(classes=10)
+    net.initialize()
+    x = nd.array(np.random.RandomState(5).rand(4, 1, 28, 28).astype(np.float32))
+    with autograd.predict_mode():
+        ref = net(x).asnumpy()
+    qnet = qz.quantize_net(net, calib_mode="naive", calib_data=[x],
+                           exclude=["output"])
+    # the excluded head is untouched
+    assert isinstance(qnet.output, nn.Dense)
+    # conv stack is quantized
+    found = []
+    def scan(b):
+        for c in b._children.values():
+            if isinstance(c, (qz.QuantizedConv2D, qz.QuantizedDense)):
+                found.append(c)
+            scan(c)
+    scan(qnet)
+    assert len(found) >= 3
+    with autograd.predict_mode():
+        out = qnet(x).asnumpy()
+    # random-init logits are small; agreement within int8 error
+    assert np.abs(out - ref).max() < 0.1 * max(1.0, np.abs(ref).max())
