@@ -9,3 +9,7 @@ from .image import (CreateAugmenter, HorizontalFlipAug, CastAug, CenterCropAug,
                     ColorJitterAug, ForceResizeAug, ImageIter, RandomCropAug,
                     ResizeAug, color_normalize, fixed_crop, imdecode, imread,
                     imresize, random_crop, center_crop, resize_short)
+from .detection import (CreateDetAugmenter, CreateMultiRandCropAugmenter,
+                        DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomPadAug, DetRandomSelectAug,
+                        ImageDetIter)

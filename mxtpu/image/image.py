@@ -247,13 +247,28 @@ class ImageIter:
             self._rec_lock = threading.Lock()  # file reads serialize; decode doesn't
             self._items = list(range(len(self._rec)))
             self._mode = "rec"
+        elif path_imglist:
+            # .lst format (tools/im2rec.py): index \t label... \t rel_path
+            entries = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    labels = np.asarray([float(x) for x in parts[1:-1]],
+                                        np.float32)
+                    entries.append([labels, parts[-1]])
+            self._list = entries
+            self._root = path_root
+            self._items = list(range(len(entries)))
+            self._mode = "list"
         elif imglist is not None:
             self._list = imglist
             self._root = path_root
             self._items = list(range(len(imglist)))
             self._mode = "list"
         else:
-            raise ValueError("need path_imgrec or imglist")
+            raise ValueError("need path_imgrec, path_imglist, or imglist")
         self._shuffle = shuffle
         self.reset()
 
@@ -262,7 +277,8 @@ class ImageIter:
         if self._shuffle:
             pyrandom.shuffle(self._items)
 
-    def _read(self, idx):
+    def _read_raw(self, idx):
+        """Decode one sample WITHOUT augmentation: (img HWC, raw label)."""
         from .. import recordio
         if self._mode == "rec":
             with self._rec_lock:  # seek+read on the shared handle serializes
@@ -273,6 +289,20 @@ class ImageIter:
         else:
             label, path = self._list[idx][0], self._list[idx][-1]
             img = imread(os.path.join(self._root, path))
+        return img, label
+
+    def _read_label(self, idx):
+        """Raw label only (no image decode) — used for label-shape scans."""
+        from .. import recordio
+        if self._mode == "rec":
+            with self._rec_lock:
+                raw = self._rec[idx]
+            header, _ = recordio.unpack(raw)
+            return header.label
+        return self._list[idx][0]
+
+    def _read(self, idx):
+        img, label = self._read_raw(idx)
         for aug in self.auglist:
             img = aug(img)
         return img, np.asarray(label, np.float32)

@@ -115,11 +115,16 @@ class KVStore:
             red = vlist[0].data
             for v in vlist[1:]:
                 red = red + v.data
-            if self._distributed and jax.process_count() > 1:
-                from .parallel import collectives
-                red = collectives.allreduce_processes(red)
             if self._compression_params is not None:
-                red = self._compress(k, red)
+                # worker-side compression BEFORE transport (the reference
+                # compresses before the dist push for wire-bandwidth,
+                # gradient_compression.h:37-134 + kvstore_dist.h): the int8
+                # sign codes are what crosses the wire; the residual stays
+                # per-rank; decode happens after the sum
+                codes = self._transport(self._compress_encode(k, red))
+                red = self._decode(codes).astype(red.dtype)
+            else:
+                red = self._transport(red)
             if self._updater is not None:
                 grad = NDArray(red)
                 self._updater(k, grad, self._store[k])
@@ -181,15 +186,33 @@ class KVStore:
         self._compression_params = dict(compression_params)
         self._residuals: Dict[Any, jnp.ndarray] = {}
 
-    def _compress(self, key, grad):
+    def _transport(self, payload):
+        """The cross-worker hop: everything that 'crosses the wire' funnels
+        through here (tests hook it to inspect the payload)."""
+        if self._distributed and jax.process_count() > 1:
+            from .parallel import collectives
+            return collectives.allreduce_processes(payload)
+        return payload
+
+    def _compress_encode(self, key, grad):
+        """2-bit quantization with error-feedback residual
+        (gradient_compression.h:37-134): returns int8 codes in {-1, 0, +1};
+        the decoded value is ``codes * threshold``. int8 (not 2-bit packed) is
+        the practical XLA-collective payload — still a 4x wire saving vs f32."""
         thr = float(self._compression_params.get("threshold", 0.5))
         res = self._residuals.get(key)
         if res is None:
             res = jnp.zeros_like(grad)
         g = grad + res
-        q = jnp.where(g >= thr, thr, jnp.where(g <= -thr, -thr, 0.0)).astype(g.dtype)
-        self._residuals[key] = g - q
-        return q
+        codes = (jnp.where(g >= thr, 1, 0) +
+                 jnp.where(g <= -thr, -1, 0)).astype(jnp.int8)
+        self._residuals[key] = g - self._decode(codes).astype(g.dtype)
+        return codes
+
+    def _decode(self, codes):
+        """Inverse of _compress_encode (threshold lives in one place)."""
+        thr = float(self._compression_params.get("threshold", 0.5))
+        return codes.astype(jnp.float32) * thr
 
     def save_optimizer_states(self, fname: str, dump_optimizer: bool = False):
         if self._updater is None:

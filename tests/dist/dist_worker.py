@@ -58,6 +58,23 @@ np.testing.assert_allclose(gred.asnumpy(), expect)
 # --- 3. barrier ------------------------------------------------------------
 kv.barrier()
 
+# --- 3.5 gradient compression: worker-side, wire payload is int8 codes -----
+kv3 = mx.kvstore.create("dist_sync")
+kv3.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+kv3.init("c", nd.zeros((4,)))
+wire = []
+_orig_transport = kv3._transport
+kv3._transport = lambda p: (wire.append(np.asarray(p)), _orig_transport(p))[1]
+# rank0 pushes [0.6, 0.1, -0.7, 0], rank1 pushes [0.6, 0.1, 0.7, 0]
+g = np.array([0.6, 0.1, -0.7 if rank == 0 else 0.7, 0.0], np.float32)
+kv3.push("c", nd.array(g))
+assert wire[0].dtype == np.int8, wire[0].dtype          # quantized BEFORE wire
+assert set(np.unique(wire[0])) <= {-1, 0, 1}
+outc = nd.zeros((4,))
+kv3.pull("c", outc)
+# sum of per-rank quantized grads: [1+1, 0, -1+1, 0] * 0.5
+np.testing.assert_allclose(outc.asnumpy(), [1.0, 0.0, 0.0, 0.0])
+
 # --- 4. DataParallelTrainer over process-spanning mesh ---------------------
 mesh = parallel.make_mesh((8,), ("dp",))
 mx.rng.seed(0)

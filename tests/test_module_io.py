@@ -237,6 +237,27 @@ def test_kvstore_compression():
     assert out.asnumpy()[0] == 0.5
 
 
+def test_kvstore_compression_wire_payload_is_quantized():
+    """The payload crossing _transport (the wire) must be the int8 code form,
+    not the float gradient (reference compresses before transport,
+    gradient_compression.h:37 + kvstore_dist.h wiring)."""
+    from mxtpu import kvstore
+    kv = kvstore.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("g", nd.zeros((4,)))
+    seen = []
+    orig = kv._transport
+    kv._transport = lambda p: (seen.append(p), orig(p))[1]
+    kv.push("g", nd.array([0.3, 0.7, -0.9, 0.0]))
+    assert len(seen) == 1
+    payload = np.asarray(seen[0])
+    assert payload.dtype == np.int8
+    assert set(np.unique(payload)) <= {-1, 0, 1}
+    out = nd.zeros((4,))
+    kv.pull("g", out)
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5, -0.5, 0.0])
+
+
 def test_row_sparse_pull():
     from mxtpu import kvstore
     kv = kvstore.create("local")
