@@ -17,6 +17,16 @@ Backward, custom Function), redesigned for JAX:
 
 The scopes also carry the thread-local ``is_training`` flag consumed by Dropout/BatchNorm
 (`MXAutogradSetIsTraining` parity).
+
+Performance stance (deliberate): eager ``backward()`` calls ``jax.vjp`` per tape
+node, which re-executes that node's forward to build the vjp — eager backward
+costs ~2x an eager forward and is unjitted. This is the DEBUGGING path, exactly
+as imperative mode is the slow path in the reference (its imperative ops skip
+graph optimization too). The production path is ``hybridize()``/``CachedOp``/
+``DataParallelTrainer``, where forward+backward+update trace into ONE compiled
+XLA program and the tape holds a single node. Per-node vjp caching would only
+accelerate the path nobody should be on — rejected in favor of keeping the tape
+replay-correct and simple.
 """
 
 from __future__ import annotations
@@ -39,6 +49,9 @@ def _st():
         _state.recording = False
         _state.training = False
         _state.tape = []
+        _state.retained = []
+    if not hasattr(_state, "retained"):
+        _state.retained = []
     return _state
 
 
@@ -131,6 +144,18 @@ def _mark_variable(handle, grad_req: str = "write"):
     entry = _VariableEntry(handle, grad_req)
     handle._grad_entry = entry
     handle._grad = NDArray(jnp.zeros_like(handle._data))
+
+
+def retain_grad(handle):
+    """Request the gradient of a NON-leaf (tape-produced) array: its cotangent
+    is flushed into ``handle.grad`` at the next backward, WITHOUT detaching it
+    from the recorded graph (attach_grad would sever the producing edge —
+    torch's retain_grad semantics, needed by Module.inputs_need_grad when the
+    input is another module's output on the same tape)."""
+    if handle._grad_entry is None:
+        _mark_variable(handle)
+        return
+    _st().retained.append(handle)
 
 
 def mark_variables(variables, gradients=None, grad_reqs="write"):
@@ -292,6 +317,14 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
 
     # flush into variable .grad buffers / collect for grad()
     from .ndarray.ndarray import NDArray
+    for h in st.retained:
+        entry = h._grad_entry
+        if entry is None:
+            continue
+        k = _entry_key(entry)
+        if k in grads:
+            h._grad = NDArray(jnp.asarray(_dense_cot(grads[k]),
+                                          dtype=h._data.dtype))
     results = None
     if collect_vars is not None:
         results = []
@@ -322,6 +355,7 @@ def _run_backward(heads, head_grads, retain_graph, train_mode_flag,
 
     if not retain_graph:
         st.tape = []
+        st.retained = []
     return results
 
 

@@ -316,8 +316,9 @@ class HybridBlock(Block):
         import jax.numpy as jnp
         from ..base import dtype_np
         sig = next(iter(self._cached_op._cache))
-        arg_shapes = sig[0]  # ((shape, dtype), ...) per input
-        examples = [NDArray(jnp.zeros(s, dtype_np(dt))) for s, dt in arg_shapes]
+        arg_shapes = sig[0]  # ((shape, dtype, sharding), ...) per input
+        examples = [NDArray(jnp.zeros(s, dtype_np(dt)))
+                    for s, dt, *_rest in arg_shapes]
         from .. import autograd as _ag
         with _ag.predict_mode():
             text = export_stablehlo(lambda *xs: self.forward(*xs), examples)

@@ -49,10 +49,19 @@ class CachedOp:
         self._cache: Dict[tuple, dict] = {}
 
     # -- signature ---------------------------------------------------------
+    @staticmethod
+    def _shard_key(raw):
+        # device placement/sharding is part of the compiled executable's
+        # contract: params re-placed with new shardings (e.g. after a
+        # DataParallelTrainer._collect) must invalidate the traced entry.
+        # Shardings are hashable — no stringification on the hot path.
+        return getattr(raw, "sharding", None)
+
     def _sig(self, args) -> tuple:
         return (
-            tuple((a.shape, str(a.dtype)) for a in args),
-            tuple((p.shape, str(p.dtype)) for p in self.params),
+            tuple((a.shape, str(a.dtype), self._shard_key(a.data)) for a in args),
+            tuple((p.shape, str(p.dtype), self._shard_key(p._data))
+                  for p in self.params),
             autograd.is_training(),
         )
 

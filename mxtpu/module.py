@@ -64,6 +64,10 @@ class BaseModule:
     def update_metric(self, eval_metric, labels):
         raise NotImplementedError
 
+    def _monitor_blocks(self):
+        """Blocks a Monitor should hook (valid after bind/init_params)."""
+        return []
+
     # shared loop ----------------------------------------------------------
     def forward_backward(self, data_batch: DataBatch):
         self.forward(data_batch, is_train=True)
@@ -122,6 +126,10 @@ class BaseModule:
                             optimizer_params=optimizer_params)
         eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
+
+        if monitor is not None:
+            for b in self._monitor_blocks():
+                monitor.install(b)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -191,6 +199,9 @@ class Module(BaseModule):
     def symbol(self):
         return self._symbol_obj if self._symbolic else self._block
 
+    def _monitor_blocks(self):
+        return [self._block]
+
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -199,6 +210,7 @@ class Module(BaseModule):
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
         self._for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
         self.binded = True
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
@@ -275,10 +287,27 @@ class Module(BaseModule):
             extra = [label] * n_label if label is not None else \
                 [nd.zeros((self._batch_size,))] * n_label
             data = data + extra
+        if is_train and getattr(self, "_inputs_need_grad", False):
+            n_data = len(data_batch.data)  # exclude appended symbolic labels
+            for d in data[:n_data]:
+                if d._grad_entry is None:
+                    d.attach_grad()        # true leaf (host batch)
+                else:
+                    autograd.retain_grad(d)  # another module's live output
+            self._input_arrays = list(data[:n_data])
         if is_train:
+            from .gluon.loss import SoftmaxCrossEntropyLoss
             with autograd.record():
                 out = self._block(*data)
                 self._outputs = [out] if isinstance(out, NDArray) else list(out)
+                # expose the SAME tensors get_outputs() returns while still on
+                # the tape, so backward(out_grads) seeds the right node
+                if not self._symbolic and isinstance(self._loss,
+                                                     SoftmaxCrossEntropyLoss):
+                    self._exposed = [self._outputs[0].softmax()] \
+                        + self._outputs[1:]
+                else:
+                    self._exposed = None
                 if label is not None and not self._symbolic:
                     self._loss_val = self._loss(self._outputs[0], label)
                 elif self._symbolic:
@@ -290,11 +319,18 @@ class Module(BaseModule):
                 out = self._block(*data)
             self._outputs = [out] if isinstance(out, NDArray) else list(out)
             self._loss_val = None
+            self._exposed = None  # never serve a stale train-time exposure
 
     def backward(self, out_grads=None):
         if self._symbolic:
             autograd.backward(list(self._outputs),
                               list(out_grads) if out_grads is not None else None)
+        elif out_grads is not None:
+            # explicit head gradients seed the EXPOSED outputs (what
+            # get_outputs() returned — softmaxed for classification modules)
+            heads = self._exposed if getattr(self, "_exposed", None) \
+                else self._outputs
+            autograd.backward(list(heads), list(out_grads))
         elif self._loss_val is not None:
             autograd.backward([self._loss_val])
 
@@ -308,12 +344,18 @@ class Module(BaseModule):
         from .gluon.loss import SoftmaxCrossEntropyLoss
         if self._symbolic:
             return list(self._outputs)  # loss-fused heads already emit probabilities
+        if getattr(self, "_exposed", None):
+            return list(self._exposed)
         if self._outputs and isinstance(self._loss, SoftmaxCrossEntropyLoss):
             return [self._outputs[0].softmax()] + self._outputs[1:]
         return list(self._outputs)
 
     def get_input_grads(self):
-        raise NotImplementedError("inputs_need_grad path not implemented")
+        """Gradients w.r.t. the data inputs (module.py:40 inputs_need_grad
+        contract); requires bind(inputs_need_grad=True) + forward/backward."""
+        if not getattr(self, "_inputs_need_grad", False):
+            raise RuntimeError("bind with inputs_need_grad=True first")
+        return [d.grad for d in self._input_arrays]
 
     def update_metric(self, eval_metric, labels):
         eval_metric.update(labels, self.get_outputs())
@@ -418,14 +460,118 @@ class BucketingModule(BaseModule):
     def get_params(self):
         return self._curr.get_params() if self._curr else ({}, {})
 
+    def _monitor_blocks(self):
+        return self._curr._monitor_blocks() if self._curr else []
+
 
 class SequentialModule(BaseModule):
-    """Chain of modules (sequential_module.py parity, minimal)."""
+    """Chain of modules executed back-to-back (sequential_module.py parity).
+
+    ``add(module, take_labels=True)`` marks the module that consumes labels
+    (META_TAKE_LABELS; defaults to the last). Data shapes auto-wire: each
+    module binds on the previous module's output shape (discovered with a
+    zeros forward, since blocks infer shapes by running). Backward chains
+    through ``get_input_grads`` — every non-first module binds with
+    ``inputs_need_grad=True``."""
 
     def __init__(self, logger=logging):
         super().__init__(logger)
         self._modules: List[BaseModule] = []
+        self._metas: List[dict] = []
 
     def add(self, module, **kwargs):
         self._modules.append(module)
+        self._metas.append({"take_labels": kwargs.get("take_labels", False)})
         return self
+
+    def _label_module_index(self) -> int:
+        for i, meta in enumerate(self._metas):
+            if meta["take_labels"]:
+                return i
+        return len(self._modules) - 1
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        assert self._modules, "add modules before bind"
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+    def _monitor_blocks(self):
+        return [b for m in self._modules for b in m._monitor_blocks()]
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        from .io import DataDesc
+        shapes = list(self._data_shapes)
+        label_idx = self._label_module_index()
+        for i, m in enumerate(self._modules):
+            ing = self._inputs_need_grad if i == 0 else True
+            m.bind(shapes, self._label_shapes if i == label_idx else None,
+                   for_training=self._for_training, inputs_need_grad=ing,
+                   force_rebind=True)
+            m.init_params(initializer=initializer, arg_params=arg_params,
+                          aux_params=aux_params, allow_missing=True,
+                          force_init=force_init)
+            # discover output shapes with a zeros forward (auto-wiring)
+            dummy = DataBatch(data=[nd.zeros(tuple(d.shape)) for d in shapes],
+                              label=None)
+            m.forward(dummy, is_train=False)
+            shapes = [DataDesc(f"data{j}", o.shape)
+                      for j, o in enumerate(m.get_outputs())]
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        for m in self._modules:
+            m.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                             optimizer_params=optimizer_params,
+                             force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch: DataBatch, is_train=None):
+        label_idx = self._label_module_index()
+        batch = data_batch
+        for i, m in enumerate(self._modules):
+            label = data_batch.label if i == label_idx else None
+            m.forward(DataBatch(data=list(batch.data), label=label,
+                                pad=getattr(data_batch, "pad", 0)),
+                      is_train=is_train)
+            # chain the RAW outputs (still attached to the live tape);
+            # get_outputs() would apply the classification-head softmax
+            # outside the record context and detach the graph
+            batch = DataBatch(data=list(m._outputs), label=None)
+
+    def backward(self, out_grads=None):
+        # all chained forwards record onto ONE connected tape (each module's
+        # output NDArrays are the next module's inputs), so a single backward
+        # from the loss-owning module reaches every submodule's params — the
+        # reference's per-executor out_grads relay (sequential_module.py:344)
+        # collapses. Intermediate input grads remain readable via
+        # modules[i].get_input_grads() (their bind sets inputs_need_grad).
+        idx = (len(self._modules) - 1 if out_grads is not None
+               else self._label_module_index())
+        self._modules[idx].backward(out_grads=out_grads)
+
+    def update(self):
+        for m in self._modules:
+            m.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_params(self):
+        arg, aux = {}, {}
+        for m in self._modules:
+            a, x = m.get_params()
+            arg.update(a)
+            aux.update(x)
+        return arg, aux
+
+    def update_metric(self, eval_metric, labels):
+        self._modules[self._label_module_index()].update_metric(eval_metric,
+                                                                labels)

@@ -1,0 +1,120 @@
+"""Monitor — per-block output/weight/gradient spying, capability parity with
+``python/mxnet/monitor.py:33-85`` (+ ``ExecuteMonCallback``,
+graph_executor.cc:1563).
+
+The reference installs a C callback on every executor op; here ``install``
+walks a Gluon block tree and registers forward hooks that capture each
+sub-block's output under its qualified name. Weights and gradients are read
+from ``collect_params`` at ``toc`` time. Capture is eager-mode: inside a
+``hybridize()``d/compiled graph intermediate arrays are tracers and are
+skipped (the compiled graph has no per-op boundaries to spy on — same reason
+the reference's monitor only sees executor-level ops)."""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+def _is_concrete(arr) -> bool:
+    import jax.core
+    raw = arr.data if isinstance(arr, NDArray) else arr
+    return not isinstance(raw, jax.core.Tracer)
+
+
+class Monitor:
+    """Monitor outputs, weights, and gradients for debugging (monitor.py:33).
+
+    ``interval``: batches between collections. ``stat_func``: NDArray -> stat
+    (default |x|_2 / sqrt(size)). ``pattern``: regex over tensor names
+    ('.*output' → outputs only, '.*weight' → weights, '.*grad' → gradients).
+    """
+
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        if stat_func is None:
+            def asum_stat(x):
+                raw = x.data if isinstance(x, NDArray) else x
+                import jax.numpy as jnp
+                return float(jnp.linalg.norm(raw.astype(jnp.float32).ravel())
+                             / math.sqrt(raw.size))
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List[Tuple[int, str, object]] = []
+        self.step = 0
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self._blocks: List = []
+
+    # -- installation ------------------------------------------------------
+    def install(self, block):
+        """Register capture hooks over the block tree (executor
+        set_monitor_callback parity)."""
+        if any(b is block for b in self._blocks):
+            return
+        self._blocks.append(block)
+
+        def walk(b, prefix):
+            for name, child in b._children.items():
+                qual = f"{prefix}{name}"
+                child.register_forward_hook(self._mk_hook(qual))
+                walk(child, qual + ".")
+
+        block.register_forward_hook(self._mk_hook(getattr(block, "prefix", "")
+                                                  .rstrip("_") or "net"))
+        walk(block, "")
+
+    def _mk_hook(self, qual: str):
+        def hook(blk, args, out):
+            if not self.activated:
+                return
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for i, o in enumerate(outs):
+                if not isinstance(o, NDArray) or not _is_concrete(o):
+                    continue
+                name = f"{qual}_output" if len(outs) == 1 else \
+                    f"{qual}_output{i}"
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(o)))
+        return hook
+
+    # -- per-batch protocol (tic/toc, monitor.py:85-140) --------------------
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, object]]:
+        if not self.activated:
+            return []
+        self.activated = False
+        for block in self._blocks:
+            for name, p in block.collect_params().items():
+                if p._data is None:
+                    continue
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(p.data())))
+                gname = name + "_grad"
+                if p._data._grad is not None and self.re_prog.match(gname):
+                    self.queue.append((self.step, gname,
+                                       self.stat_func(p.grad())))
+        res = self.queue
+        self.queue = []
+        if self.sort:
+            res = sorted(res, key=lambda t: t[1])
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {stat}")

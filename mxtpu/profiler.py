@@ -35,17 +35,35 @@ def set_state(state: str = "stop", profile_process: str = "worker"):
         _state["dir"] = out_dir
         jax.profiler.start_trace(out_dir)
         _state["running"] = True
-    elif state == "stop" and _state["running"]:
-        jax.profiler.stop_trace()
-        _state["running"] = False
+    elif state == "stop":
+        if _state["running"]:
+            jax.profiler.stop_trace()
+            _state["running"] = False
+        _state.pop("resume_running", None)  # explicit stop cancels pause-resume
 
 
 def pause(profile_process: str = "worker"):
+    """Suspend collection (c_api MXProfilePause parity): custom events stop
+    recording and the device trace is closed until resume()."""
+    if _state["paused"]:
+        return
     _state["paused"] = True
+    if _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+        _state["resume_running"] = True
 
 
 def resume(profile_process: str = "worker"):
+    if not _state["paused"]:
+        return
     _state["paused"] = False
+    if _state.pop("resume_running", False):
+        _state["segment"] = _state.get("segment", 0) + 1
+        out_dir = f"{_state['dir']}_resume{_state['segment']}"
+        _state["dir"] = out_dir  # dump() must point at the live trace dir
+        jax.profiler.start_trace(out_dir)
+        _state["running"] = True
 
 
 def dump(finished: bool = True, profile_process: str = "worker"):
@@ -60,8 +78,41 @@ def dump(finished: bool = True, profile_process: str = "worker"):
     return fname
 
 
+def get_summary(sort_by: str = "total") -> str:
+    """Aggregate-stats table (MXAggregateProfileStatsPrint / aggregate_stats.cc
+    parity): per-name count, total/avg/min/max duration over recorded events."""
+    stats = {}
+    for e in _state["events"]:
+        if e.get("ph") != "X":
+            continue
+        s = stats.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
+        dur = e.get("dur", 0.0) / 1000.0  # ms
+        s[0] += 1
+        s[1] += dur
+        s[2] = min(s[2], dur)
+        s[3] = max(s[3], dur)
+    key = {"total": lambda kv: -kv[1][1], "count": lambda kv: -kv[1][0],
+           "avg": lambda kv: -(kv[1][1] / max(kv[1][0], 1)),
+           "name": lambda kv: kv[0]}[sort_by]
+    lines = [f"{'Name':<40s}{'Count':>8s}{'Total(ms)':>12s}{'Avg(ms)':>10s}"
+             f"{'Min(ms)':>10s}{'Max(ms)':>10s}"]
+    lines.append("-" * len(lines[0]))
+    for name, (cnt, tot, mn, mx) in sorted(stats.items(), key=key):
+        lines.append(f"{name:<40s}{cnt:>8d}{tot:>12.3f}{tot/cnt:>10.3f}"
+                     f"{mn:>10.3f}{mx:>10.3f}")
+    return "\n".join(lines)
+
+
 def dumps(reset: bool = False) -> str:
-    return json.dumps({"traceEvents": _state["events"]})
+    """Aggregate table when set_config(aggregate_stats=True) (reference
+    profiler.dumps), raw chrome-trace JSON otherwise."""
+    if _state["config"].get("aggregate_stats"):
+        out = get_summary()
+    else:
+        out = json.dumps({"traceEvents": _state["events"]})
+    if reset:
+        _state["events"] = []
+    return out
 
 
 class Domain:
@@ -93,11 +144,12 @@ class _Scoped:
     def stop(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
-            _state["events"].append({
-                "name": self.name, "ph": "X", "ts": self._t0 / 1000,
-                "dur": (time.perf_counter_ns() - self._t0) / 1000,
-                "pid": 0, "tid": 0,
-                "cat": self.domain.name if self.domain else "default"})
+            if not _state["paused"]:
+                _state["events"].append({
+                    "name": self.name, "ph": "X", "ts": self._t0 / 1000,
+                    "dur": (time.perf_counter_ns() - self._t0) / 1000,
+                    "pid": 0, "tid": 0,
+                    "cat": self.domain.name if self.domain else "default"})
             self._ann = None
 
     def __enter__(self):
@@ -127,9 +179,10 @@ class Counter:
 
     def set_value(self, value):
         self.value = value
-        _state["events"].append({"name": self.name, "ph": "C",
-                                 "ts": time.perf_counter_ns() / 1000, "pid": 0,
-                                 "args": {self.name: value}})
+        if not _state["paused"]:
+            _state["events"].append({"name": self.name, "ph": "C",
+                                     "ts": time.perf_counter_ns() / 1000,
+                                     "pid": 0, "args": {self.name: value}})
 
     def increment(self, delta=1):
         self.set_value(self.value + delta)
@@ -143,6 +196,7 @@ class Marker:
         self.domain, self.name = domain, name
 
     def mark(self, scope: str = "process"):
-        _state["events"].append({"name": self.name, "ph": "i",
-                                 "ts": time.perf_counter_ns() / 1000, "pid": 0,
-                                 "s": scope[0]})
+        if not _state["paused"]:
+            _state["events"].append({"name": self.name, "ph": "i",
+                                     "ts": time.perf_counter_ns() / 1000,
+                                     "pid": 0, "s": scope[0]})

@@ -11,17 +11,23 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_dist_sync_two_processes():
+@pytest.mark.parametrize("world,devs", [(2, 4), (4, 2)])
+def test_dist_sync_multi_process(world, devs):
+    """2-proc and 4-proc dist_sync: kvstore consistency, sparse push across
+    ranks holding different rows (densify-allreduce path, flagged in
+    kvstore.push), compressed wire payload, DataParallelTrainer over the
+    process-spanning mesh."""
     worker = os.path.join(ROOT, "tests", "dist", "dist_worker.py")
     launcher = os.path.join(ROOT, "tools", "launch.py")
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS",)}  # workers get their own device count
     env["JAX_PLATFORMS"] = "cpu"
+    env["EXPECT_WORLD"] = str(world)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
-        [sys.executable, launcher, "-n", "2", "--devices-per-worker", "4",
-         sys.executable, worker],
+        [sys.executable, launcher, "-n", str(world),
+         "--devices-per-worker", str(devs), sys.executable, worker],
         capture_output=True, text=True, timeout=280, env=env, cwd=ROOT)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
-    assert out.count("DIST_WORKER_OK") == 2, out[-4000:]
+    assert out.count("DIST_WORKER_OK") == world, out[-4000:]

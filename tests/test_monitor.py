@@ -1,0 +1,104 @@
+"""Monitor (per-block output/weight/grad spying) + profiler pause/aggregate.
+Reference surface: python/mxnet/monitor.py:33-140, aggregate_stats.cc,
+MXProfilePause (c_api.h:265).
+"""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.gluon import nn
+from mxtpu.monitor import Monitor
+
+
+def _net():
+    net = nn.HybridSequential(prefix="mon_")
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    return net
+
+
+def test_monitor_captures_outputs():
+    net = _net()
+    mon = Monitor(interval=1, pattern=".*output")
+    mon.install(net)
+    x = nd.array(np.random.RandomState(0).randn(4, 6).astype(np.float32))
+    mon.tic()
+    net(x)
+    res = mon.toc()
+    names = [n for _, n, _ in res]
+    assert any("output" in n for n in names)
+    assert all(isinstance(s, float) for _, _, s in res)
+    # interval respected: second batch (step 1) not collected with interval=2
+    mon2 = Monitor(interval=2, pattern=".*output")
+    mon2.install(net)
+    mon2.tic(); net(x); assert len(mon2.toc()) > 0
+    mon2.tic(); net(x); assert mon2.toc() == []
+
+
+def test_monitor_captures_weights_and_grads():
+    net = _net()
+    mon = Monitor(interval=1, pattern=".*(weight|grad)")
+    mon.install(net)
+    x = nd.array(np.random.RandomState(1).randn(4, 6).astype(np.float32))
+    mon.tic()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    res = mon.toc()
+    names = [n for _, n, _ in res]
+    assert any(n.endswith("weight") for n in names)
+    assert any(n.endswith("_grad") for n in names)
+
+
+def test_monitor_under_module_fit(capsys):
+    from mxtpu.module import Module
+    import mxtpu.io as mio
+    rs = np.random.RandomState(2)
+    x = rs.randn(32, 6).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.float32)
+    net = _net()
+    mod = Module(net)
+    mon = Monitor(interval=2, pattern=".*output")
+    mod.fit(mio.NDArrayIter(x, y, batch_size=8), num_epoch=1,
+            optimizer_params={"learning_rate": 0.1}, monitor=mon)
+    out = capsys.readouterr().out
+    assert "output" in out and "Batch:" in out
+
+
+def test_profiler_pause_resume_gates_events():
+    from mxtpu import profiler
+    profiler._state["events"] = []
+    with profiler.Domain("test").new_task("recorded"):
+        pass
+    profiler.pause()
+    with profiler.Domain("test").new_task("dropped"):
+        pass
+    profiler.resume()
+    with profiler.Domain("test").new_task("recorded2"):
+        pass
+    names = [e["name"] for e in profiler._state["events"]]
+    assert "recorded" in names and "recorded2" in names
+    assert "dropped" not in names
+
+
+def test_profiler_aggregate_stats_table():
+    from mxtpu import profiler
+    profiler._state["events"] = []
+    profiler.set_config(aggregate_stats=True)
+    d = profiler.Domain("agg")
+    for _ in range(3):
+        with d.new_task("op_a"):
+            pass
+    with d.new_task("op_b"):
+        pass
+    table = profiler.dumps()
+    lines = table.splitlines()
+    assert "Name" in lines[0] and "Total(ms)" in lines[0]
+    row_a = next(l for l in lines if l.startswith("op_a"))
+    assert " 3" in row_a  # count column
+    assert any(l.startswith("op_b") for l in lines)
+    profiler.set_config(aggregate_stats=False)
