@@ -192,6 +192,105 @@ class CSVIter(DataIter):
         return self._inner.provide_label
 
 
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator producing CSR data batches
+    (src/io/iter_libsvm.cc parity): lines are ``label idx:val idx:val ...``
+    (indices 0-based like the reference default). ``data_shape`` is the
+    feature-vector length; optional ``label_libsvm`` reads multi-output labels
+    from a second libsvm file."""
+
+    def __init__(self, data_libsvm: str, data_shape, batch_size: int = 1,
+                 label_libsvm: Optional[str] = None, label_shape=(1,),
+                 round_batch: bool = True):
+        super().__init__(batch_size)
+        self._num_features = int(data_shape[0] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        self._labels, self._rows = self._parse(data_libsvm)
+        if label_libsvm:
+            self._labels = self._parse_labels(label_libsvm, label_shape)
+        self._round = round_batch
+        self.reset()
+
+    @staticmethod
+    def _parse(path):
+        labels, rows = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = []
+                for t in parts[1:]:
+                    i, v = t.split(":")
+                    row.append((int(i), float(v)))
+                rows.append(row)
+        return np.asarray(labels, np.float32), rows
+
+    @staticmethod
+    def _parse_labels(path, label_shape):
+        """External label file: either plain values per line (dense labels)
+        or sparse idx:val rows (iter_libsvm.cc label_libsvm semantics)."""
+        width = int(label_shape[0] if isinstance(label_shape, (tuple, list))
+                    else label_shape)
+        out = []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                row = np.zeros((width,), np.float32)
+                if any(":" in t for t in parts):
+                    for t in parts:
+                        if ":" in t:
+                            i, v = t.split(":")
+                            row[int(i)] = float(v)
+                else:
+                    vals = [float(t) for t in parts]
+                    row[:len(vals)] = vals
+                out.append(row)
+        dense = np.asarray(out, np.float32)
+        return dense[:, 0] if width == 1 else dense
+
+    def reset(self):
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._num_features))]
+
+    @property
+    def provide_label(self):
+        lab = np.asarray(self._labels)
+        shape = (self.batch_size,) if lab.ndim == 1 else \
+            (self.batch_size,) + lab.shape[1:]
+        return [DataDesc("softmax_label", shape)]
+
+    def next(self) -> DataBatch:
+        from .ndarray import sparse as _sparse
+        n = len(self._rows)
+        if self._cursor >= n:
+            raise StopIteration
+        idxs = list(range(self._cursor, min(self._cursor + self.batch_size, n)))
+        pad = self.batch_size - len(idxs)
+        if pad and not self._round:
+            raise StopIteration
+        idxs += idxs[-1:] * pad  # pad by repeating (round_batch)
+        values, col_idx, indptr = [], [], [0]
+        for i in idxs:
+            for j, v in self._rows[i]:
+                col_idx.append(j)
+                values.append(v)
+            indptr.append(len(values))
+        data = _sparse.CSRNDArray(
+            np.asarray(values, np.float32), np.asarray(col_idx, np.int64),
+            np.asarray(indptr, np.int64),
+            (self.batch_size, self._num_features))
+        label = NDArray(np.asarray(self._labels[idxs]))
+        self._cursor += self.batch_size
+        return DataBatch(data=[data], label=[label], pad=pad)
+
+
 class MNISTIter(DataIter):
     """MNIST iterator (src/io/iter_mnist.cc parity): flat=True → (N,784)."""
 

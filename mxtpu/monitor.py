@@ -41,10 +41,13 @@ class Monitor:
                  pattern: str = ".*", sort: bool = False):
         if stat_func is None:
             def asum_stat(x):
-                raw = x.data if isinstance(x, NDArray) else x
                 import jax.numpy as jnp
+                if getattr(x, "stype", "default") != "default":
+                    raw = x.data.data      # sparse: stats over stored values
+                else:
+                    raw = x.data if isinstance(x, NDArray) else x
                 return float(jnp.linalg.norm(raw.astype(jnp.float32).ravel())
-                             / math.sqrt(raw.size))
+                             / math.sqrt(max(raw.size, 1)))
             stat_func = asum_stat
         self.stat_func = stat_func
         self.interval = interval

@@ -559,21 +559,43 @@ def waitall():
 _SAVE_FORMAT_KEY = "__mxtpu_format__"  # reserved npz entry: b"list" | b"dict"
 
 
+def _encode_entry(payload, key, v):
+    """One array into the npz payload; sparse storage serializes by component
+    (NDArray::Save handles row_sparse/csr the same way, ndarray.cc:1537)."""
+    stype = getattr(v, "stype", "default")
+    if stype == "row_sparse":
+        payload[f"{key}::rsp::indices"] = np.asarray(v.indices.asnumpy())
+        payload[f"{key}::rsp::values"] = np.asarray(v.data.asnumpy())
+        payload[f"{key}::rsp::shape"] = np.asarray(v.shape, np.int64)
+    elif stype == "csr":
+        payload[f"{key}::csr::data"] = np.asarray(v.data.asnumpy())
+        payload[f"{key}::csr::indices"] = np.asarray(v.indices.asnumpy())
+        payload[f"{key}::csr::indptr"] = np.asarray(v.indptr.asnumpy())
+        payload[f"{key}::csr::shape"] = np.asarray(v.shape, np.int64)
+    else:
+        payload[key] = v.asnumpy()
+
+
 def save(fname: str, data):
-    """Save an NDArray, list of NDArrays, or dict of name→NDArray (mx.nd.save parity).
+    """Save an NDArray (dense or sparse), list, or dict of name→NDArray
+    (mx.nd.save parity incl. row_sparse/csr, ndarray.cc:1537).
 
     An explicit format marker is stored so a dict whose keys happen to look like
     ``arr_<i>`` round-trips correctly (list-vs-dict is never inferred from key names).
     """
-    if isinstance(data, NDArray):
-        payload, fmt = {"arr_0": data.asnumpy()}, "list"
-    elif isinstance(data, dict):
+    payload = {}
+    if isinstance(data, dict):
         if _SAVE_FORMAT_KEY in data:
             raise ValueError(f"key {_SAVE_FORMAT_KEY!r} is reserved")
-        payload = {k: v.asnumpy() for k, v in data.items()}
+        for k, v in data.items():
+            _encode_entry(payload, k, v)
         fmt = "dict"
     elif isinstance(data, (list, tuple)):
-        payload = {f"arr_{i}": v.asnumpy() for i, v in enumerate(data)}
+        for i, v in enumerate(data):
+            _encode_entry(payload, f"arr_{i}", v)
+        fmt = "list"
+    elif hasattr(data, "asnumpy"):
+        _encode_entry(payload, "arr_0", data)
         fmt = "list"
     else:
         raise TypeError(f"cannot save {type(data)}")
@@ -582,8 +604,32 @@ def save(fname: str, data):
         np.savez(f, **payload)
 
 
+def _decode_entries(z, keys):
+    """Reassemble logical entries (dense or sparse-by-component) from npz."""
+    from . import sparse as _sparse
+    out = {}
+    logical = {}
+    for k in keys:
+        parts = k.rsplit("::", 2)  # user keys may themselves contain '::'
+        if len(parts) == 3 and parts[1] in ("rsp", "csr"):
+            name, stype, comp = parts
+            logical.setdefault((name, stype), {})[comp] = z[k]
+        else:
+            out[k] = NDArray(z[k])
+    for (name, stype), comps in logical.items():
+        if stype == "rsp":
+            out[name] = _sparse.RowSparseNDArray(
+                comps["indices"], comps["values"], tuple(comps["shape"]))
+        else:
+            out[name] = _sparse.CSRNDArray(
+                comps["data"], comps["indices"], comps["indptr"],
+                tuple(comps["shape"]))
+    return out
+
+
 def load(fname: str):
-    """Load from ``save``; returns dict if named, else list (mx.nd.load parity)."""
+    """Load from ``save``; returns dict if named, else list (mx.nd.load parity).
+    Sparse entries come back as RowSparseNDArray/CSRNDArray."""
     with open(fname, "rb") as f:
         with np.load(f, allow_pickle=False) as z:
             keys = [k for k in z.keys() if k != _SAVE_FORMAT_KEY]
@@ -591,6 +637,7 @@ def load(fname: str):
                 fmt = bytes(z[_SAVE_FORMAT_KEY]).decode()
             else:  # pre-marker files: fall back to the key-name heuristic
                 fmt = "list" if all(k.startswith("arr_") for k in keys) else "dict"
+            entries = _decode_entries(z, keys)
             if fmt == "list":
-                return [NDArray(z[f"arr_{i}"]) for i in range(len(keys))]
-            return {k: NDArray(z[k]) for k in keys}
+                return [entries[f"arr_{i}"] for i in range(len(entries))]
+            return entries

@@ -439,3 +439,106 @@ def add(lhs, rhs):
 
 
 elemwise_add = add
+
+
+# ---------------------------------------------------------------------------
+# sparse elementwise family (src/operator/tensor/elemwise_binary_op_basic.cc
+# FComputeEx sparse kernels; unsupported storage combinations fall back to
+# dense exactly like the reference's StorageFallbackOpExecutor,
+# attach_op_execs_pass.cc:46-223)
+# ---------------------------------------------------------------------------
+
+
+def negate(arr):
+    if isinstance(arr, RowSparseNDArray):
+        return RowSparseNDArray(arr._indices, -arr._values, arr._shape)
+    if isinstance(arr, CSRNDArray):
+        return CSRNDArray(-arr._values, arr._indices, arr._indptr, arr._shape)
+    return NDArray(-(arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)))
+
+
+def subtract(lhs, rhs):
+    """elemwise sub: rsp-rsp -> rsp; csr-csr -> csr; dense operand -> dense."""
+    return add(lhs, negate(rhs)) if isinstance(rhs, BaseSparseNDArray) else \
+        add(lhs, NDArray(-(rhs.data if isinstance(rhs, NDArray)
+                           else jnp.asarray(rhs))))
+
+
+def multiply(lhs, rhs):
+    """elemwise mul. rsp*rsp keeps the row intersection; rsp*scalar and
+    csr*scalar stay sparse (zero is absorbing, unlike add); rsp*dense keeps
+    the stored rows; anything else densifies."""
+    if isinstance(lhs, (int, float)):
+        lhs, rhs = rhs, lhs
+    if isinstance(rhs, (int, float)):
+        if isinstance(lhs, RowSparseNDArray):
+            return RowSparseNDArray(lhs._indices, lhs._values * rhs, lhs._shape)
+        if isinstance(lhs, CSRNDArray):
+            return CSRNDArray(lhs._values * rhs, lhs._indices, lhs._indptr,
+                              lhs._shape)
+        return NDArray(lhs.data * rhs)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        if lhs._shape != rhs._shape:
+            raise ValueError(f"shape mismatch {lhs._shape} vs {rhs._shape}")
+        li = np.asarray(jax.device_get(lhs._indices))
+        ri = np.asarray(jax.device_get(rhs._indices))
+        common, lpos, rpos = np.intersect1d(li, ri, return_indices=True)
+        return RowSparseNDArray(
+            jnp.asarray(common, _INT),
+            lhs._values[jnp.asarray(lpos)] * rhs._values[jnp.asarray(rpos)],
+            lhs._shape)
+    if isinstance(lhs, RowSparseNDArray):
+        dense = rhs.data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        if tuple(dense.shape) != lhs._shape:
+            raise ValueError(
+                f"shape mismatch {lhs._shape} vs {tuple(dense.shape)}")
+        return RowSparseNDArray(lhs._indices,
+                                lhs._values * dense[lhs._indices], lhs._shape)
+    if isinstance(rhs, RowSparseNDArray):
+        return multiply(rhs, lhs)
+    l = lhs._dense() if isinstance(lhs, BaseSparseNDArray) else lhs.data
+    r = rhs._dense() if isinstance(rhs, BaseSparseNDArray) else (
+        rhs.data if isinstance(rhs, NDArray) else jnp.asarray(rhs))
+    return NDArray(l * r)
+
+
+def _csr_binop(lhs, rhs, op):
+    """csr (+|-) csr through scipy on host (keeps sparsity; reference uses its
+    own CPU CSR kernels for the same combos)."""
+    import scipy.sparse as sps
+    out = op(lhs.asscipy(), rhs.asscipy()).tocsr()
+    out.sort_indices()
+    return CSRNDArray(jnp.asarray(out.data), jnp.asarray(out.indices, _INT),
+                      jnp.asarray(out.indptr, _INT), lhs._shape)
+
+
+_rsp_add_orig = add
+
+
+def add(lhs, rhs):  # noqa: F811 — extend the existing dispatcher with csr+csr
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        if lhs._shape != rhs._shape:
+            raise ValueError(f"shape mismatch {lhs._shape} vs {rhs._shape}")
+        return _csr_binop(lhs, rhs, lambda a, b: a + b)
+    return _rsp_add_orig(lhs, rhs)
+
+
+elemwise_add = add
+elemwise_sub = subtract
+elemwise_mul = multiply
+
+
+def _install_operators():
+    RowSparseNDArray.__sub__ = lambda s, o: subtract(s, o)
+    RowSparseNDArray.__mul__ = lambda s, o: multiply(s, o)
+    RowSparseNDArray.__rmul__ = lambda s, o: multiply(s, o)
+    RowSparseNDArray.__neg__ = lambda s: negate(s)
+    CSRNDArray.__add__ = lambda s, o: add(s, o)
+    CSRNDArray.__radd__ = lambda s, o: add(s, o)
+    CSRNDArray.__sub__ = lambda s, o: subtract(s, o)
+    CSRNDArray.__mul__ = lambda s, o: multiply(s, o)
+    CSRNDArray.__rmul__ = lambda s, o: multiply(s, o)
+    CSRNDArray.__neg__ = lambda s: negate(s)
+
+
+_install_operators()

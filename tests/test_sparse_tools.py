@@ -1,0 +1,182 @@
+"""Sparse elementwise family, sparse nd.save/load, LibSVMIter, im2rec,
+parse_log. Reference surface: elemwise_binary_op_basic.cc FComputeEx,
+ndarray.cc:1537 (sparse Save), src/io/iter_libsvm.cc, tools/im2rec.py,
+tools/parse_log.py.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.ndarray import sparse
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rsp(rows, shape=(6, 3), val=1.0):
+    return sparse.row_sparse_array(
+        (np.full((len(rows), shape[1]), val, np.float32), rows), shape=shape)
+
+
+def _csr(dense):
+    return sparse.cast_storage(nd.array(dense), "csr")
+
+
+def test_sparse_elemwise_rsp():
+    a = _rsp([0, 2], val=2.0)
+    b = _rsp([2, 4], val=3.0)
+    s = a - b
+    assert s.stype == "row_sparse"
+    expect = np.zeros((6, 3), np.float32)
+    expect[0], expect[2], expect[4] = 2, -1, -3
+    np.testing.assert_allclose(s._dense(), expect)
+    m = a * b                      # intersection of rows
+    assert m.stype == "row_sparse"
+    em = np.zeros((6, 3), np.float32)
+    em[2] = 6
+    np.testing.assert_allclose(m._dense(), em)
+    np.testing.assert_allclose((a * 2.0)._dense(), a._dense() * 2)
+    np.testing.assert_allclose((-a)._dense(), -a._dense())
+    # rsp * dense keeps stored rows
+    d = nd.array(np.arange(18, dtype=np.float32).reshape(6, 3))
+    md = a * d
+    assert md.stype == "row_sparse"
+    np.testing.assert_allclose(md._dense(), a._dense() * d.asnumpy())
+
+
+def test_sparse_elemwise_csr():
+    rs = np.random.RandomState(0)
+    da = (rs.rand(5, 7) > 0.6) * rs.rand(5, 7).astype(np.float32)
+    db = (rs.rand(5, 7) > 0.6) * rs.rand(5, 7).astype(np.float32)
+    a, b = _csr(da.astype(np.float32)), _csr(db.astype(np.float32))
+    s = a + b
+    assert s.stype == "csr"
+    np.testing.assert_allclose(s._dense(), da + db, rtol=1e-6)
+    np.testing.assert_allclose((a - b)._dense(), da - db, rtol=1e-6)
+    np.testing.assert_allclose((a * 0.5)._dense(), da * 0.5, rtol=1e-6)
+
+
+def test_sparse_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "mixed.nd")
+    rsp = _rsp([1, 3], val=2.5)
+    csr = _csr(np.array([[0, 1.0], [2.0, 0]], np.float32))
+    dense = nd.array([1.0, 2.0])
+    nd.save(f, {"w_rsp": rsp, "w_csr": csr, "w_dense": dense})
+    out = nd.load(f)
+    assert out["w_rsp"].stype == "row_sparse"
+    np.testing.assert_allclose(out["w_rsp"]._dense(), rsp._dense())
+    assert out["w_csr"].stype == "csr"
+    np.testing.assert_allclose(out["w_csr"]._dense(), csr._dense())
+    np.testing.assert_allclose(out["w_dense"].asnumpy(), [1.0, 2.0])
+    # list format with sparse entries
+    f2 = str(tmp_path / "lst.nd")
+    nd.save(f2, [rsp, dense])
+    lst = nd.load(f2)
+    assert lst[0].stype == "row_sparse" and lst[1].shape == (2,)
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:0.5\n")
+        f.write("2 0:1.0 2:3.0 4:4.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2)
+    b1 = next(it)
+    assert b1.data[0].stype == "csr"
+    d = b1.data[0]._dense()
+    np.testing.assert_allclose(np.asarray(d), [[1.5, 0, 0, 2.0, 0],
+                                               [0, 0.5, 0, 0, 0]])
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1.0, 0.0])
+    b2 = next(it)  # padded with repeat of last row
+    assert b2.pad == 1
+    np.testing.assert_allclose(np.asarray(b2.data[0]._dense())[0],
+                               [1.0, 0, 3.0, 0, 4.0])
+    with pytest.raises(StopIteration):
+        next(it)
+    it.reset()
+    assert next(it).pad == 0
+
+
+def test_libsvm_feeds_sparse_dot():
+    """CSR batch from LibSVMIter drives sparse dot (the FM/linear pipeline)."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "x.libsvm")
+        with open(path, "w") as f:
+            f.write("1 0:1.0 2:2.0\n0 1:3.0\n")
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(3,), batch_size=2)
+        batch = next(it)
+        w = nd.array(np.eye(3, dtype=np.float32))
+        out = sparse.dot(batch.data[0], w)
+        np.testing.assert_allclose(np.asarray(out.data if hasattr(out, "data")
+                                              else out),
+                                   [[1, 0, 2], [0, 3, 0]])
+
+
+def _write_images(root, n_per_class=3):
+    from PIL import Image
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        os.makedirs(os.path.join(root, cls), exist_ok=True)
+        for i in range(n_per_class):
+            arr = rs.randint(0, 255, (20, 24, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(os.path.join(root, cls, f"{i}.png"))
+
+
+def test_im2rec_end_to_end(tmp_path):
+    """im2rec --list then pack; the .rec feeds ImageIter."""
+    root = str(tmp_path / "imgs")
+    _write_images(root)
+    prefix = str(tmp_path / "ds")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r1 = subprocess.run([sys.executable, os.path.join(ROOT, "tools", "im2rec.py"),
+                         "--list", prefix, root], capture_output=True, text=True,
+                        env=env)
+    assert r1.returncode == 0, r1.stderr
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    labels = {float(l.split("\t")[1]) for l in lines}
+    assert labels == {0.0, 1.0}
+    r2 = subprocess.run([sys.executable, os.path.join(ROOT, "tools", "im2rec.py"),
+                         prefix, root, "--encoding", ".png"],
+                        capture_output=True, text=True, env=env)
+    assert r2.returncode == 0, r2.stderr
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+    from mxtpu import image as mximage
+    it = mximage.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                           path_imgrec=prefix + ".rec", rand_crop=True)
+    batch = next(it)
+    assert batch.data[0].shape == (2, 3, 16, 16)
+    assert set(np.asarray(batch.label[0].asnumpy())) <= {0.0, 1.0}
+
+
+def test_parse_log(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import parse_log
+    log = [
+        "INFO Epoch[0] Batch [20] Speed: 100.0 samples/sec accuracy=0.5",
+        "INFO Epoch[0] Batch [40] Speed: 200.0 samples/sec accuracy=0.6",
+        "INFO Epoch[0] Train-accuracy=0.61",
+        "INFO Epoch[0] Time cost=12.5",
+        "INFO Epoch[0] Validation-accuracy=0.55",
+        "INFO Epoch[1] Train-accuracy=0.82",
+        "INFO Epoch[1] Time cost=11.0",
+        "INFO Epoch[1] Validation-accuracy=0.75",
+    ]
+    rows = parse_log.parse(log)
+    assert rows[0]["train-accuracy"] == 0.61
+    assert rows[0]["valid-accuracy"] == 0.55
+    assert rows[0]["speed"] == 150.0
+    assert rows[1]["time"] == 11.0
+    md = parse_log.render(rows, "markdown")
+    assert "| epoch |" in md.splitlines()[0] or "epoch" in md.splitlines()[0]
+    csv = parse_log.render(rows, "csv")
+    assert csv.splitlines()[0].startswith("epoch,")
+    assert len(csv.splitlines()) == 3

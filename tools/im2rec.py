@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""im2rec — build .lst / .rec image datasets (capability parity with the
+reference ``tools/im2rec.py`` list+record modes and ``tools/im2rec.cc``).
+
+Two modes:
+
+* ``--list``: scan an image folder (one subdirectory per class, or flat) and
+  write ``prefix.lst`` lines ``index \\t label... \\t relpath`` with optional
+  train/test split and shuffling.
+* default: read ``prefix.lst`` + image root and pack ``prefix.rec`` +
+  ``prefix.idx`` (IndexedRecordIO) with optional resize/quality, using a
+  thread pool for decode/encode (the reference's --num-thread).
+
+Usage:
+  python tools/im2rec.py --list data/train data/images
+  python tools/im2rec.py data/train data/images --resize 256 --quality 90
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(args):
+    root = os.path.abspath(args.root)
+    classes = sorted([d for d in os.listdir(root)
+                      if os.path.isdir(os.path.join(root, d))])
+    entries = []
+    if classes:
+        for label, cls in enumerate(classes):
+            for dirpath, _dirs, files in os.walk(os.path.join(root, cls)):
+                for fn in sorted(files):
+                    if os.path.splitext(fn)[1].lower() in _EXTS:
+                        rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                        entries.append((float(label), rel))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if os.path.splitext(fn)[1].lower() in _EXTS:
+                entries.append((0.0, fn))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(entries)
+    n_test = int(len(entries) * args.test_ratio)
+    splits = [("", entries[n_test:]), ("_test", entries[:n_test])] \
+        if n_test else [("", entries)]
+    for suffix, ent in splits:
+        path = f"{args.prefix}{suffix}.lst"
+        with open(path, "w") as f:
+            for i, (label, rel) in enumerate(ent):
+                f.write(f"{i}\t{label}\t{rel}\n")
+        print(f"wrote {len(ent)} entries -> {path}")
+
+
+def _pack_one(args, root, line):
+    from mxtpu import image as mximage, recordio
+    parts = line.strip().split("\t")
+    idx = int(parts[0])
+    labels = [float(x) for x in parts[1:-1]]
+    rel = parts[-1]
+    img = mximage.imread(os.path.join(root, rel))
+    if args.resize:
+        img = mximage.resize_short(img, args.resize)
+    if args.center_crop:
+        s = min(img.shape[0], img.shape[1])
+        img = mximage.center_crop(img, (s, s))[0]
+    label = labels[0] if len(labels) == 1 else __import__("numpy").asarray(
+        labels, dtype="float32")
+    header = recordio.IRHeader(0, label, idx, 0)
+    packed = recordio.pack_img(header, img.asnumpy(), quality=args.quality,
+                               img_fmt=args.encoding)
+    return idx, packed
+
+
+def make_record(args):
+    from mxtpu import recordio
+    lst = args.prefix + ".lst"
+    with open(lst) as f:
+        lines = [l for l in f if l.strip()]
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    root = os.path.abspath(args.root)
+    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        for idx, packed in pool.map(
+                lambda line: _pack_one(args, root, line), lines):
+            rec.write_idx(idx, packed)
+    rec.close()
+    print(f"packed {len(lines)} images -> {args.prefix}.rec")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="output prefix (prefix.lst / prefix.rec)")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true", help="generate .lst only")
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter side to this")
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    p.add_argument("--num-thread", type=int, default=4)
+    args = p.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        make_record(args)
+
+
+if __name__ == "__main__":
+    main()

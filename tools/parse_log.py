@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""parse_log — turn training logs into a per-epoch table (capability parity
+with the reference ``tools/parse_log.py``).
+
+Parses the framework's standard log lines::
+
+  Epoch[3] Batch [40]  Speed: 123.45 samples/sec  accuracy=0.9876
+  Epoch[3] Train-accuracy=0.987
+  Epoch[3] Validation-accuracy=0.95
+  Epoch[3] Time cost=12.3
+
+Output: markdown (default) or csv with one row per epoch:
+``epoch, train-metric, valid-metric, time, speed(avg)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+_RE_TRAIN = re.compile(r"Epoch\[(\d+)\]\s+Train-([\w-]+)=([\d.eE+-]+)")
+_RE_VALID = re.compile(r"Epoch\[(\d+)\]\s+Validation-([\w-]+)=([\d.eE+-]+)")
+_RE_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.eE+-]+)")
+_RE_SPEED = re.compile(r"Epoch\[(\d+)\].*?Speed:\s*([\d.eE+-]+)")
+
+
+def parse(lines):
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    for line in lines:
+        m = _RE_TRAIN.search(line)
+        if m:
+            rows[int(m.group(1))][f"train-{m.group(2)}"] = float(m.group(3))
+        m = _RE_VALID.search(line)
+        if m:
+            rows[int(m.group(1))][f"valid-{m.group(2)}"] = float(m.group(3))
+        m = _RE_TIME.search(line)
+        if m:
+            rows[int(m.group(1))]["time"] = float(m.group(2))
+        m = _RE_SPEED.search(line)
+        if m:
+            speeds[int(m.group(1))].append(float(m.group(2)))
+    for e, sp in speeds.items():
+        rows[e]["speed"] = sum(sp) / len(sp)
+    return dict(rows)
+
+
+def render(rows, fmt="markdown"):
+    if not rows:
+        return "(no epochs found)"
+    cols = ["epoch"] + sorted({k for r in rows.values() for k in r})
+    lines = []
+    if fmt == "markdown":
+        lines.append("| " + " | ".join(cols) + " |")
+        lines.append("|" + "---|" * len(cols))
+        for e in sorted(rows):
+            vals = [str(e)] + [f"{rows[e].get(c, ''):.6g}" if c in rows[e]
+                               else "" for c in cols[1:]]
+            lines.append("| " + " | ".join(vals) + " |")
+    else:
+        lines.append(",".join(cols))
+        for e in sorted(rows):
+            lines.append(",".join(
+                [str(e)] + [f"{rows[e].get(c, ''):.6g}" if c in rows[e]
+                            else "" for c in cols[1:]]))
+    return "\n".join(lines)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile", nargs="?", help="log file (default: stdin)")
+    p.add_argument("--format", default="markdown", choices=["markdown", "csv"])
+    args = p.parse_args()
+    lines = open(args.logfile) if args.logfile else sys.stdin
+    print(render(parse(lines), args.format))
+
+
+if __name__ == "__main__":
+    main()
