@@ -19,7 +19,16 @@ def _pil():
 
 
 def imdecode(buf: bytes, flag: int = 1, to_rgb: bool = True) -> NDArray:
-    """Decode compressed image bytes → HWC uint8 NDArray (image.py imdecode)."""
+    """Decode compressed image bytes → HWC uint8 NDArray (image.py imdecode).
+
+    JPEGs take the native libjpeg path (mxtpu_io.cc — the reference's decode
+    hot loop, iter_image_recordio_2.cc:138-149; the C call releases the GIL so
+    iterator thread pools scale across cores); PIL handles everything else."""
+    if flag == 1 and buf[:2] == b"\xff\xd8":
+        from .. import native
+        arr = native.jpeg_decode(bytes(buf))
+        if arr is not None:
+            return nd.array(arr, dtype="uint8")
     img = _pil().open(io.BytesIO(buf))
     if flag == 0:
         img = img.convert("L")

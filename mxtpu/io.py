@@ -209,6 +209,7 @@ class LibSVMIter(DataIter):
         if label_libsvm:
             self._labels = self._parse_labels(label_libsvm, label_shape)
         self._round = round_batch
+        self._validate()
         self.reset()
 
     @staticmethod
@@ -226,6 +227,12 @@ class LibSVMIter(DataIter):
                     row.append((int(i), float(v)))
                 rows.append(row)
         return np.asarray(labels, np.float32), rows
+
+    def _validate(self):
+        bad = max((j for row in self._rows for j, _ in row), default=-1)
+        if bad >= self._num_features:
+            raise ValueError(
+                f"libsvm feature index {bad} >= data_shape {self._num_features}")
 
     @staticmethod
     def _parse_labels(path, label_shape):

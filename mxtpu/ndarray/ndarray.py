@@ -587,6 +587,12 @@ def save(fname: str, data):
     if isinstance(data, dict):
         if _SAVE_FORMAT_KEY in data:
             raise ValueError(f"key {_SAVE_FORMAT_KEY!r} is reserved")
+        for k in data:
+            parts = k.rsplit("::", 2)
+            if len(parts) == 3 and parts[1] in ("rsp", "csr"):
+                raise ValueError(
+                    f"key {k!r} matches the reserved '<name>::rsp/csr::<comp>' "
+                    "sparse-component pattern")
         for k, v in data.items():
             _encode_entry(payload, k, v)
         fmt = "dict"

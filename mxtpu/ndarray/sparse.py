@@ -496,7 +496,8 @@ def multiply(lhs, rhs):
                                 lhs._values * dense[lhs._indices], lhs._shape)
     if isinstance(rhs, RowSparseNDArray):
         return multiply(rhs, lhs)
-    l = lhs._dense() if isinstance(lhs, BaseSparseNDArray) else lhs.data
+    l = lhs._dense() if isinstance(lhs, BaseSparseNDArray) else (
+        lhs.data if isinstance(lhs, NDArray) else jnp.asarray(lhs))
     r = rhs._dense() if isinstance(rhs, BaseSparseNDArray) else (
         rhs.data if isinstance(rhs, NDArray) else jnp.asarray(rhs))
     return NDArray(l * r)

@@ -10,13 +10,23 @@
 //                         threaded over the batch (the host-side hot loop that
 //                         feeds device_put)
 //   f32_batch_stack — parallel memcpy gather of sample pointers into a batch
+//   jpeg_dims / jpeg_decode — libjpeg RGB decode (the reference's OMP decode
+//                             hot loop, iter_image_recordio_2.cc:138-149);
+//                             callers parallelize across a thread pool (the
+//                             ctypes call releases the GIL)
 
 #include <atomic>
+#include <csetjmp>
+#include <cstddef>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <thread>
 #include <vector>
+
+#ifdef MXTPU_HAVE_JPEG
+#include <jpeglib.h>
+#endif
 
 namespace {
 
@@ -165,6 +175,86 @@ void f32_batch_stack(const float** samples, float* out, int64_t n, int64_t bytes
       num_threads);
 }
 
-int mxtpu_io_abi_version() { return 1; }
+#ifdef MXTPU_HAVE_JPEG
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jb, 1);
+}
+
+}  // namespace
+
+// Parse the JPEG header only: fills h/w/c. Returns 0 on success.
+int jpeg_dims(const uint8_t* buf, int64_t size, int64_t* h, int64_t* w,
+              int64_t* c) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *h = cinfo.image_height;
+  *w = cinfo.image_width;
+  *c = 3;  // decode always emits RGB (grayscale upconverts)
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Full RGB decode into a caller-allocated h*w*3 buffer. Returns 0 on success.
+int jpeg_decode(const uint8_t* buf, int64_t size, uint8_t* out,
+                int64_t capacity) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(size));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int64_t stride = static_cast<int64_t>(cinfo.output_width) * 3;
+  if (stride * cinfo.output_height > capacity) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + static_cast<int64_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+#else  // !MXTPU_HAVE_JPEG — keep the ABI, report failure (callers fall back)
+
+int jpeg_dims(const uint8_t*, int64_t, int64_t*, int64_t*, int64_t*) {
+  return -1;
+}
+int jpeg_decode(const uint8_t*, int64_t, uint8_t*, int64_t) { return -1; }
+
+#endif  // MXTPU_HAVE_JPEG
+
+int mxtpu_io_abi_version() { return 2; }
 
 }  // extern "C"
