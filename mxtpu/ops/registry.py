@@ -92,6 +92,46 @@ def get_op(name: str) -> OpDef:
     return _OPS[name]
 
 
+def describe(name: str) -> dict:
+    """Typed op-config reflection — the dmlc::Parameter equivalent
+    (reference: every op's Param struct self-describes fields/defaults for
+    doc generation, parameter.h DMLC_DECLARE_FIELD). Returns
+    ``{name, doc, inputs, attrs: [{name, default, annotation}]}``
+    introspected from the registered function's signature."""
+    import inspect
+    op = get_op(name)
+    sig = inspect.signature(op.fn)
+    inputs, attrs = [], []
+    for pname, p in sig.parameters.items():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            inputs.append({"name": f"*{pname}", "variadic": True})
+        elif p.default is inspect.Parameter.empty and \
+                p.kind != inspect.Parameter.VAR_KEYWORD:
+            inputs.append({"name": pname, "variadic": False})
+        elif p.kind != inspect.Parameter.VAR_KEYWORD:
+            ann = None if p.annotation is inspect.Parameter.empty else (
+                getattr(p.annotation, "__name__", None) or str(p.annotation))
+            attrs.append({"name": pname, "default": p.default,
+                          "annotation": ann})
+    return {"name": op.name, "doc": op.doc, "num_outputs": op.num_outputs,
+            "inputs": inputs, "attrs": attrs, "aliases": list(op.aliases)}
+
+
+def op_doc(name: str) -> str:
+    """Auto-generated docstring (MXSymbolGetAtomicSymbolInfo-style doc
+    rendering): summary + a Parameters section from the signature."""
+    info = describe(name)
+    lines = [info["doc"].strip() if info["doc"] else f"{info['name']} op.", ""]
+    if info["inputs"]:
+        lines += ["Inputs: " + ", ".join(i["name"] for i in info["inputs"]), ""]
+    if info["attrs"]:
+        lines += ["Parameters", "----------"]
+        for a in info["attrs"]:
+            t = a["annotation"] or type(a["default"]).__name__
+            lines.append(f"{a['name']} : {t}, default {a['default']!r}")
+    return "\n".join(lines)
+
+
 def list_ops(namespace: Optional[str] = None) -> List[str]:
     if namespace is None:
         return sorted(_OPS)

@@ -1,12 +1,22 @@
 """Visualization — parity with ``python/mxnet/visualization.py`` (print_summary,
-plot_network). ``plot_network`` renders block trees (graphviz if available, text
-otherwise); detailed op graphs live in StableHLO dumps (jit.export_stablehlo)."""
+plot_network). ``plot_network`` emits DOT source directly (a ``graphviz.Source``
+when that package is installed, the raw string otherwise); detailed op graphs
+live in StableHLO dumps (jit.export_stablehlo)."""
 
 from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 from .gluon.block import Block
+
+
+def _block_param_count(b: Block) -> int:
+    """Materialized parameter count of one block (shared by print_summary and
+    the DOT renderer)."""
+    return sum(int(np.prod(p.shape)) for p in b.params.values()
+               if p.shape and all(s > 0 for s in p.shape))
 
 
 def print_summary(block: Block, shape=None, line_length: int = 72):
@@ -16,13 +26,7 @@ def print_summary(block: Block, shape=None, line_length: int = 72):
 
     def visit(b: Block, depth: int):
         nonlocal total
-        own = 0
-        for name, p in b.params.items():
-            if p.shape and all(s > 0 for s in p.shape):
-                n = 1
-                for s in p.shape:
-                    n *= s
-                own += n
+        own = _block_param_count(b)
         total += own
         rows.append(("  " * depth + type(b).__name__, b.name, own))
         for child in b._children.values():
@@ -39,30 +43,46 @@ def print_summary(block: Block, shape=None, line_length: int = 72):
     return total
 
 
+def network_dot_source(block: Block, title: str = "plot") -> str:
+    """Graphviz DOT source for the block tree — generated directly (no
+    graphviz dependency), same visual vocabulary as the reference's
+    plot_network (visualization.py:plot_network node styling)."""
+    _palette = {"Conv": "#fb8072", "Dense": "#fb8072", "Pool": "#80b1d3",
+                "BatchNorm": "#bebada", "Activation": "#ffffb3"}
+    lines = [f'digraph "{title}" {{',
+             '  node [shape=box, style=filled, fillcolor="#8dd3c7"];']
+    counter = [0]
+
+    def node_id(b):
+        counter[0] += 1
+        return f"n{counter[0]}"
+
+    def visit(b, parent_id):
+        nid = node_id(b)
+        tname = type(b).__name__
+        color = next((c for k, c in _palette.items() if k in tname), "#8dd3c7")
+        n_params = _block_param_count(b)
+        label = f"{tname}\\n{b.name}" + (f"\\n{n_params} params" if n_params
+                                         else "")
+        lines.append(f'  {nid} [label="{label}", fillcolor="{color}"];')
+        if parent_id:
+            lines.append(f"  {parent_id} -> {nid};")
+        for c in b._children.values():
+            visit(c, nid)
+
+    visit(block, None)
+    lines.append("}")
+    return "\n".join(lines)
+
+
 def plot_network(block: Block, title: str = "plot", save_format: str = "pdf",
                  shape=None, **kwargs):
+    """Return a renderable graph of the block tree: a ``graphviz.Digraph``
+    when the python package is installed, otherwise the DOT source string
+    (pipe it to ``dot -Tpdf`` yourself)."""
+    src = network_dot_source(block, title)
     try:
         import graphviz
     except ImportError:
-        # text fallback
-        lines = []
-
-        def visit(b, depth):
-            lines.append("  " * depth + f"{type(b).__name__}({b.name})")
-            for c in b._children.values():
-                visit(c, depth + 1)
-
-        visit(block, 0)
-        return "\n".join(lines)
-    dot = graphviz.Digraph(name=title)
-
-    def visit2(b, parent=None):
-        nid = b.name or str(id(b))
-        dot.node(nid, f"{type(b).__name__}\n{b.name}")
-        if parent:
-            dot.edge(parent, nid)
-        for c in b._children.values():
-            visit2(c, nid)
-
-    visit2(block)
-    return dot
+        return src
+    return graphviz.Source(src, filename=title, format=save_format)

@@ -1,0 +1,101 @@
+"""Op-config reflection (dmlc::Parameter-equivalent auto-doc), DOT network
+plots, and contrib.text (Vocabulary/embeddings)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.ops import registry
+
+
+def test_registry_describe_and_doc():
+    info = registry.describe("Convolution")
+    attr_names = {a["name"] for a in info["attrs"]}
+    assert {"kernel", "stride", "num_filter", "num_group"} <= attr_names
+    assert any(i["name"] == "data" for i in info["inputs"])
+    defaults = {a["name"]: a["default"] for a in info["attrs"]}
+    assert defaults["num_group"] == 1
+    doc = registry.op_doc("Convolution")
+    assert "Parameters" in doc and "num_group : int, default 1" in doc
+    # auto-doc reaches the generated nd wrappers
+    assert "Parameters" in nd.Convolution.__doc__
+
+
+def test_plot_network_dot_source():
+    from mxtpu import visualization
+    from mxtpu.gluon import nn
+    net = nn.HybridSequential(prefix="viz_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Activation("relu"))
+    net.initialize()
+    out = visualization.plot_network(net, title="t")
+    src = out if isinstance(out, str) else out.source
+    assert src.startswith('digraph "t"')
+    assert "Dense" in src and "->" in src
+    assert "16 params" in src  # 4x3 weight + 4 bias
+
+
+def test_text_vocabulary():
+    from mxtpu.contrib import text
+    counter = text.count_tokens_from_str("a b b c c c\nd d d d", to_lower=True)
+    assert counter["c"] == 3 and counter["d"] == 4
+    v = text.Vocabulary(counter, most_freq_count=3, min_freq=2,
+                        reserved_tokens=["<pad>"])
+    # <unk>, <pad>, then d(4), c(3), b(2)
+    assert v.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+    assert v.to_indices(["d", "zzz"]) == [2, 0]
+    assert v.to_tokens([3, 0]) == ["c", "<unk>"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def test_text_custom_embedding(tmp_path):
+    from mxtpu.contrib import text
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["nope"]).asnumpy(), [[0, 0, 0]])
+    emb.update_token_vectors("hello", nd.array([[9.0, 9.0, 9.0]]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+    # restrict to a vocabulary
+    vocab = text.Vocabulary(collections.Counter(["world", "world", "other"]))
+    emb2 = text.CustomEmbedding(str(p), vocabulary=vocab)
+    assert len(emb2.idx_to_token) == len(vocab)
+    np.testing.assert_allclose(
+        emb2.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+
+
+def test_text_composite_embedding(tmp_path):
+    from mxtpu.contrib import text
+    p1 = tmp_path / "e1.txt"
+    p1.write_text("a 1.0 2.0\nb 3.0 4.0\n")
+    p2 = tmp_path / "e2.txt"
+    p2.write_text("a 5.0\nc 6.0\n")
+    vocab = text.Vocabulary(collections.Counter(["a", "b", "c"]))
+    comp = text.CompositeEmbedding(vocab, [text.CustomEmbedding(str(p1)),
+                                           text.CustomEmbedding(str(p2))])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(comp.get_vecs_by_tokens("a").asnumpy(),
+                               [1, 2, 5])
+    np.testing.assert_allclose(comp.get_vecs_by_tokens("c").asnumpy(),
+                               [0, 0, 6])
+
+
+def test_text_fasttext_header_skip(tmp_path):
+    from mxtpu.contrib import text
+    p = tmp_path / "wiki.vec"
+    p.write_text("2 3\nfoo 1.0 1.0 1.0\nbar 2.0 2.0 2.0\n")
+    emb = text.FastText(pretrained_file_path=str(p))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("bar").asnumpy(),
+                               [2, 2, 2])
+    with pytest.raises(NotImplementedError):
+        text.GloVe()
