@@ -147,7 +147,13 @@ class _TokenEmbedding(Vocabulary):
         table[0] = init_unknown_vec(self._vec_len)
         for token, v in vecs.items():
             table[self._token_to_idx[token]] = v
-        self._idx_to_vec = nd.array(table)
+        self._set_table(table)
+
+    def _set_table(self, table: np.ndarray):
+        """Single mutation point: keeps a host-side copy so lookups never
+        read the device table back (multi-GB for real embedding mirrors)."""
+        self._table_np = np.asarray(table, np.float32)
+        self._idx_to_vec = nd.array(self._table_np)
 
     @property
     def vec_len(self) -> int:
@@ -166,8 +172,7 @@ class _TokenEmbedding(Vocabulary):
             if i == 0 and lower_case_backup:
                 i = self._token_to_idx.get(t.lower(), 0)
             idxs.append(i)
-        table = self._idx_to_vec.asnumpy()
-        out = table[np.asarray(idxs)]
+        out = self._table_np[np.asarray(idxs)]
         return nd.array(out[0] if single else out)
 
     def update_token_vectors(self, tokens, new_vectors):
@@ -175,13 +180,13 @@ class _TokenEmbedding(Vocabulary):
         vecs = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
             else np.asarray(new_vectors)
         vecs = vecs.reshape(len(toks), self._vec_len)
-        table = np.array(self._idx_to_vec.asnumpy())  # asnumpy views are read-only
+        table = np.array(self._table_np)
         for t, v in zip(toks, vecs):
             if t not in self._token_to_idx:
                 raise ValueError(f"token {t!r} is unknown; only existing "
                                  "tokens can be updated")
             table[self._token_to_idx[t]] = v
-        self._idx_to_vec = nd.array(table)
+        self._set_table(table)
 
     def _build_for_vocabulary(self, vocabulary: Vocabulary, source):
         """Restrict to a vocabulary's tokens — carries the vocabulary's
@@ -197,7 +202,7 @@ class _TokenEmbedding(Vocabulary):
         self._unknown_token = vocabulary.unknown_token
         self._reserved_tokens = vocabulary.reserved_tokens
         self._vec_len = source._vec_len
-        self._idx_to_vec = nd.array(table)
+        self._set_table(table)
 
 
 class CustomEmbedding(_TokenEmbedding):
@@ -243,6 +248,7 @@ class _FromTable:
         self._vec_len = table.shape[1]
         self._token_to_idx = dict(vocabulary.token_to_idx)
         self._idx_to_vec = nd.array(table)
+        self._table_np = np.asarray(table, np.float32)
 
 
 class CompositeEmbedding(_TokenEmbedding):

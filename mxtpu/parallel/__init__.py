@@ -18,3 +18,5 @@ from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, get_default_mesh,
                    make_mesh, set_default_mesh)
 from . import ring_attention
 from .ring_attention import ring_attention_inner, ring_self_attention
+from . import pipeline
+from .pipeline import gpipe

@@ -47,23 +47,24 @@ def network_dot_source(block: Block, title: str = "plot") -> str:
     """Graphviz DOT source for the block tree — generated directly (no
     graphviz dependency), same visual vocabulary as the reference's
     plot_network (visualization.py:plot_network node styling)."""
+    import itertools
+
+    def esc(s):
+        return str(s).replace("\\", "\\\\").replace('"', '\\"')
+
     _palette = {"Conv": "#fb8072", "Dense": "#fb8072", "Pool": "#80b1d3",
                 "BatchNorm": "#bebada", "Activation": "#ffffb3"}
-    lines = [f'digraph "{title}" {{',
+    lines = [f'digraph "{esc(title)}" {{',
              '  node [shape=box, style=filled, fillcolor="#8dd3c7"];']
-    counter = [0]
-
-    def node_id(b):
-        counter[0] += 1
-        return f"n{counter[0]}"
+    counter = itertools.count(1)
 
     def visit(b, parent_id):
-        nid = node_id(b)
+        nid = f"n{next(counter)}"
         tname = type(b).__name__
         color = next((c for k, c in _palette.items() if k in tname), "#8dd3c7")
         n_params = _block_param_count(b)
-        label = f"{tname}\\n{b.name}" + (f"\\n{n_params} params" if n_params
-                                         else "")
+        label = f"{esc(tname)}\\n{esc(b.name)}" + (
+            f"\\n{n_params} params" if n_params else "")
         lines.append(f'  {nid} [label="{label}", fillcolor="{color}"];')
         if parent_id:
             lines.append(f"  {parent_id} -> {nid};")

@@ -1,0 +1,44 @@
+"""tools/bandwidth, tools/kill_mxtpu, benchmark/python scripts run end-to-end
+(tiny sizes). Reference surface: tools/bandwidth/measure.py, kill-mxnet.py,
+benchmark/python/{sparse,control_flow}."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""))
+
+
+def _run(args):
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, env=ENV, cwd=ROOT, timeout=240)
+
+
+def test_bandwidth_tool():
+    r = _run(["tools/bandwidth.py", "--sizes-mb", "0.5,1", "--iters", "3"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert "algbw" in lines[1]
+    assert len(lines) >= 4  # header + 2 size rows
+
+
+def test_kill_tool_dry_run():
+    r = _run(["tools/kill_mxtpu.py", "--pattern", "zzz_no_such", "--dry-run"])
+    assert r.returncode == 0
+    assert "no matching processes" in r.stdout
+
+
+def test_sparse_ops_benchmark():
+    r = _run(["benchmark/python/sparse_ops.py", "--rows", "2048", "--cols",
+              "64", "--densities", "0.05", "--iters", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "csr_dot_dense" in r.stdout
+
+
+def test_control_flow_rnn_benchmark():
+    r = _run(["benchmark/python/control_flow_rnn.py", "--batch", "4",
+              "--hidden", "32", "--seq-len", "8", "--iters", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "foreach" in r.stdout and "unrolled" in r.stdout

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Allreduce bandwidth measurement — capability parity with the reference's
+``tools/bandwidth/measure.py`` (the kvstore allreduce GB/s harness; BASELINE's
+ICI-GB/s north-star metric).
+
+Sweeps tensor sizes through the framework's gradient-reduction path and
+reports algorithmic bandwidth (bytes reduced / time). Modes:
+
+* single process: kvstore push+pull over the in-process reduce (dominated by
+  device bandwidth — the `local`/`device` tier).
+* multi process (under ``tools/launch.py -n W``): ``allreduce_processes`` over
+  the pod collective — the ``dist_sync``/ICI tier; busbw = 2(W-1)/W x algbw.
+
+Timing follows the repo's sync discipline: a host readback is the only real
+barrier (see bench.py docstring).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(sizes_mb, iters: int = 10, kv_type: str = "device"):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import mxtpu as mx
+    from mxtpu import nd
+
+    multi = jax.process_count() > 1
+    rows = []
+    for mb in sizes_mb:
+        n = int(mb * 1e6 / 4)
+        x = jnp.ones((n,), jnp.float32)
+        float(jnp.sum(x))  # materialize
+        if multi:
+            from mxtpu.parallel import collectives
+
+            def run():
+                out = x
+                for _ in range(iters):
+                    out = collectives.allreduce_processes(out)
+                return float(jnp.sum(out))
+        else:
+            kv = mx.kvstore.create(kv_type)
+            kv.init("w", nd.NDArray(jnp.zeros_like(x)))
+            arr = nd.NDArray(x)
+            out_arr = nd.NDArray(jnp.zeros_like(x))
+
+            def run():
+                for _ in range(iters):
+                    kv.push("w", [arr, arr])   # 2-way reduce + store
+                kv.pull("w", out_arr)
+                return float(jnp.sum(out_arr.data[:1]))
+
+        run()  # warm/compile
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        bytes_moved = n * 4 * iters
+        algbw = bytes_moved / dt / 1e9
+        w = jax.process_count()
+        busbw = algbw * (2 * (w - 1) / w) if multi else algbw
+        rows.append((mb, dt / iters * 1e3, algbw, busbw))
+    return rows, multi
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sizes-mb", default="1,4,16,64",
+                   help="comma-separated tensor sizes in MB")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--kv-type", default="device")
+    args = p.parse_args()
+    sizes = [float(s) for s in args.sizes_mb.split(",")]
+    rows, multi = measure(sizes, args.iters, args.kv_type)
+    tier = "dist allreduce" if multi else f"kvstore {args.kv_type}"
+    print(f"# {tier}  ({'busbw = 2(W-1)/W algbw' if multi else 'algbw only'})")
+    print(f"{'MB':>8} {'ms/iter':>10} {'algbw GB/s':>12} {'busbw GB/s':>12}")
+    for mb, ms, alg, bus in rows:
+        print(f"{mb:>8.1f} {ms:>10.2f} {alg:>12.2f} {bus:>12.2f}")
+
+
+if __name__ == "__main__":
+    main()

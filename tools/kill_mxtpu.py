@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Cluster cleanup — parity with the reference's ``tools/kill-mxnet.py``:
+terminate stray worker processes left behind by ``tools/launch.py`` (crashed
+launchers, hung collectives). Local-host version: matches processes whose
+command line carries the DMLC worker env/launch signature."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def find_workers(pattern):
+    """Workers are identified by the DMLC_ROLE env var tools/launch.py sets
+    (read from /proc/<pid>/environ — command lines carry no launch marker);
+    ``pattern`` optionally narrows by command-line substring."""
+    out = subprocess.run(["ps", "-eo", "pid,command"], capture_output=True,
+                         text=True).stdout
+    me = os.getpid()
+    pids = []
+    for line in out.splitlines()[1:]:
+        parts = line.strip().split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid, cmd = int(parts[0]), parts[1]
+        if pid == me or "kill_mxtpu" in cmd:
+            continue
+        try:
+            environ = open(f"/proc/{pid}/environ", "rb").read()
+        except OSError:
+            continue
+        if b"DMLC_ROLE=" not in environ:
+            continue
+        if pattern and pattern not in cmd:
+            continue
+        pids.append((pid, cmd))
+    return pids
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pattern", default="",
+                   help="optional command-line substring filter (workers are "
+                        "found by their DMLC_ROLE environment)")
+    p.add_argument("--signal", type=int, default=signal.SIGTERM)
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args()
+    victims = find_workers(args.pattern)
+    for pid, cmd in victims:
+        print(f"{'would kill' if args.dry_run else 'killing'} {pid}: {cmd[:80]}")
+        if not args.dry_run:
+            try:
+                os.kill(pid, args.signal)
+            except ProcessLookupError:
+                pass
+    if not victims:
+        print("no matching processes")
+
+
+if __name__ == "__main__":
+    main()
