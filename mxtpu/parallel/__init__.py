@@ -20,3 +20,5 @@ from . import ring_attention
 from .ring_attention import ring_attention_inner, ring_self_attention
 from . import pipeline
 from .pipeline import gpipe
+from . import moe
+from .moe import expert_parallel_ffn

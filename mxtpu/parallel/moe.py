@@ -1,0 +1,86 @@
+"""Expert parallelism over an ``ep`` mesh axis — the EP hook (task mandate:
+real tp/pp/dp/sp/ep shardings; the reference predates MoE entirely).
+
+Top-1-routed mixture-of-experts FFN in the canonical TPU formulation: tokens
+are ep-sharded, each device owns exactly one expert's weights, and dispatch/
+return ride ``lax.all_to_all`` over ICI — the same program structure as
+GShard/Switch. Capacity-bounded: each expert accepts at most ``capacity``
+tokens per source device; overflow tokens pass through with a zero expert
+contribution (standard capacity-drop semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import Mesh, get_default_mesh
+
+__all__ = ["expert_parallel_ffn"]
+
+
+def expert_parallel_ffn(router_w, w1, w2, x, mesh: Optional[Mesh] = None,
+                        axis_name: str = "ep",
+                        capacity_factor: float = 1.0):
+    """MoE FFN: ``y[t] = gate[t] * FFN_{e(t)}(x[t])`` with expert-sharded
+    weights (one expert per ep rank).
+
+    ``router_w``: (d, E) routing matrix (replicated). ``w1``: (E, d, h),
+    ``w2``: (E, h, d) expert weights, stacked over the leading expert axis and
+    sharded over ``ep`` (one expert per ep rank: E == ep size). ``x``: (N, d)
+    tokens, N divisible by E. Returns (N, d).
+    """
+    mesh = mesh or get_default_mesh()
+    E = mesh.shape[axis_name]
+    N, d = x.shape
+    if router_w.shape[1] != E or w1.shape[0] != E or w2.shape[0] != E:
+        raise ValueError(
+            f"expert count mismatch: ep axis has {E} ranks but router_w/w1/w2 "
+            f"carry {router_w.shape[1]}/{w1.shape[0]}/{w2.shape[0]} experts "
+            "(one expert per ep rank)")
+    if N % E != 0:
+        raise ValueError(f"token count {N} not divisible by ep size {E}")
+    n_loc = N // E
+    capacity = max(1, int(capacity_factor * n_loc))
+
+    def spmd(router_w, w1_loc, w2_loc, x_loc):
+        # x_loc: (n_loc, d); w1_loc/w2_loc: (1, d, h)/(1, h, d) — my expert
+        logits = x_loc @ router_w                        # (n_loc, E)
+        expert = jnp.argmax(logits, axis=-1)             # (n_loc,)
+        gate = jax.nn.softmax(logits, axis=-1)[
+            jnp.arange(n_loc), expert]                   # (n_loc,)
+
+        # position of each token within its expert's send buffer
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)   # (n_loc, E)
+        pos = jnp.cumsum(onehot, axis=0) * onehot        # 1-based where routed
+        pos = jnp.sum(pos, axis=-1) - 1                  # (n_loc,)
+        keep = pos < capacity
+
+        send = jnp.zeros((E, capacity, d), x_loc.dtype)
+        send = send.at[expert, jnp.where(keep, pos, 0)].add(
+            jnp.where(keep[:, None], x_loc, 0.0))
+
+        # exchange: device e receives every device's buffer for expert e
+        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)               # (E_src, capacity, d)
+
+        h = recv.reshape(-1, d) @ w1_loc[0]              # my expert's FFN
+        h = jax.nn.relu(h)
+        out = (h @ w2_loc[0]).reshape(E, capacity, d)
+
+        # return trip + gather each token's result back by its position
+        back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)               # (E_expert, capacity, d)
+        y = back[expert, jnp.where(keep, pos, 0)]
+        y = jnp.where(keep[:, None], y * gate[:, None], 0.0)
+        return y
+
+    fn = jax.shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=P(axis_name))
+    return fn(router_w, w1, w2, x)

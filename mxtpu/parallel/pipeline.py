@@ -73,12 +73,8 @@ def gpipe(stage_fn: Callable, stacked_params, x, mesh: Optional[Mesh] = None,
         _, ys = lax.scan(step, carry0, stream_loc)
         return ys                                        # (n_steps, B, ...)
 
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
     params_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    fn = shard_map(spmd, mesh=mesh,
+    fn = jax.shard_map(spmd, mesh=mesh,
                    in_specs=(params_spec, P()),          # stream replicated
                    out_specs=P())
     ys = fn(stacked_params, stream)
