@@ -30,7 +30,7 @@ from jax import lax
 
 from .registry import register
 
-__all__ = ["attention_reference", "flash_attention"]
+__all__ = ["attention_reference", "flash_attention", "flash_chunk"]
 
 _NEG_INF = -1e30
 
@@ -247,8 +247,12 @@ def _flash_attention_pallas(q, k, v, causal: bool, scale: float,
 
 def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
                            block_q: int = 128, block_k: int = 128,
-                           interpret: bool = False):
-    """Flash backward: dq via q-block grid, dk/dv via k-block grid."""
+                           interpret: bool = False, lse_cot=None):
+    """Flash backward: dq via q-block grid, dk/dv via k-block grid.
+
+    ``lse_cot`` (B,H,T): optional cotangent of the log-sum-exp output (ring
+    merges differentiate through lse); it folds into the delta term exactly —
+    dS = P∘(dP - (Δ - dlse)) since ∂lse/∂S = P."""
     from jax.experimental import pallas as pl
 
     B, H, T, D = q.shape
@@ -256,6 +260,8 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
     block_q = _pick_block(T, block_q)
     block_k = _pick_block(Tk, block_k)
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if lse_cot is not None:
+        delta = delta - lse_cot.astype(jnp.float32)
     # lse/delta ride (BH, 8, T): sublane-broadcast to satisfy Mosaic tiling
     delta = jnp.broadcast_to(delta.reshape(B * H, 1, T), (B * H, 8, T))
     lse = jnp.broadcast_to(lse.reshape(B * H, 1, T), (B * H, 8, T))
@@ -321,33 +327,55 @@ def _use_pallas(q, k) -> bool:
             and _pick_block(Tk) >= 8 and T >= 8)
 
 
+def _chunk_reference_lse(q, k, v, causal, scale):
+    """(normalized out, lse) via plain XLA — the flash_chunk fallback. Rows
+    with every key masked produce a very negative lse, which zeroes their
+    weight in any downstream lse-merge."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        rows = lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        logits = jnp.where(rows >= cols, logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p / l, v)
+    lse = (m + jnp.log(l))[..., 0]
+    return out, lse
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_core(q, k, v, causal, scale):
-    if _use_pallas(q, k):
-        out, _lse = _flash_attention_pallas(q, k, v, causal, scale)
-        return out
-    return attention_reference(q, k, v, causal=causal, scale=scale)
-
-
-def _flash_fwd(q, k, v, causal, scale):
+def flash_chunk(q, k, v, causal, scale):
+    """One self-attention chunk returning (normalized out, lse (B,H,T)) —
+    the composable unit ring attention merges across devices. Pallas on TPU
+    at eligible shapes, XLA fallback elsewhere; the custom vjp handles BOTH
+    cotangents (out and lse), so lse-merges differentiate exactly."""
     if _use_pallas(q, k):
         out, lse = _flash_attention_pallas(q, k, v, causal, scale)
-        return out, (q, k, v, out, lse)
-    out = attention_reference(q, k, v, causal=causal, scale=scale)
-    return out, (q, k, v, out, None)
+        return out, lse.reshape(q.shape[0], q.shape[1], q.shape[2])
+    return _chunk_reference_lse(q, k, v, causal, scale)
 
 
-def _flash_bwd(causal, scale, res, g):
-    q, k, v, o, lse = res
-    if lse is not None and _use_pallas(q, k):
-        return _flash_backward_pallas(q, k, v, o, lse, g, causal, scale)
-    # fallback: recompute through the XLA reference formulation
-    _, vjp = jax.vjp(lambda q_, k_, v_: attention_reference(
-        q_, k_, v_, causal=causal, scale=scale), q, k, v)
-    return vjp(g)
+def _flash_chunk_fwd(q, k, v, causal, scale):
+    out, lse = flash_chunk(q, k, v, causal, scale)
+    return (out, lse), (q, k, v, out, lse)
 
 
-_flash_core.defvjp(_flash_fwd, _flash_bwd)
+def _flash_chunk_bwd(causal, scale, res, cots):
+    q, k, v, out, lse = res
+    g_o, g_lse = cots
+    if _use_pallas(q, k):
+        B, H, T, _ = q.shape
+        lse2d = lse.reshape(B * H, T)
+        return _flash_backward_pallas(q, k, v, out, lse2d, g_o, causal, scale,
+                                      lse_cot=g_lse)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _chunk_reference_lse(
+        q_, k_, v_, causal, scale), q, k, v)
+    return vjp((g_o, g_lse))
+
+
+flash_chunk.defvjp(_flash_chunk_fwd, _flash_chunk_bwd)
 
 
 @register("flash_attention", namespace="contrib", aliases=("attention",))
@@ -356,7 +384,8 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
 
     Pallas fwd+bwd on TPU at production shapes (any head dim ≤512 via lane
     padding; T % 128 == 0 or T ≤ 128 with T % 8 == 0), XLA reference
-    otherwise — numerically equivalent paths.
+    otherwise — numerically equivalent paths. Thin wrapper over
+    ``flash_chunk`` (the lse output's zero cotangent folds away in bwd).
     """
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    return _flash_core(q, k, v, causal, s)
+    return flash_chunk(q, k, v, causal, s)[0]

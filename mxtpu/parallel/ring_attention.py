@@ -31,66 +31,83 @@ __all__ = ["ring_attention_inner", "ring_self_attention"]
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, m, l, o, scale, q_offset, k_offset, causal):
-    """Accumulate one K/V block into the flash (m, l, o) stats.
-
-    q: (B,H,Tq,D); k,v: (B,H,Tk,D); m,l: (B,H,Tq,1); o: (B,H,Tq,D).
-    """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if causal:
-        rows = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        cols = k_offset + lax.broadcasted_iota(jnp.int32, s.shape, 3)
-        s = jnp.where(rows >= cols, s, _NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    corr = jnp.exp(m - m_new)
-    l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
-    o_new = corr * o + jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    return m_new, l_new, o_new
+def _merge_chunks(o_a, lse_a, o_b, lse_b):
+    """Combine two normalized partial-attention results via their lse
+    (exact blockwise-softmax composition). The _NEG_INF sentinel keeps
+    fully-masked chunks at weight ~0 without producing NaNs."""
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    denom = jnp.maximum(wa + wb, 1e-30)
+    o = (wa[..., None] * o_a + wb[..., None] * o_b) / denom[..., None]
+    return o, m + jnp.log(denom)
 
 
 def ring_attention_inner(q, k, v, axis_name: str, causal: bool = False,
                          scale: Optional[float] = None):
     """Call INSIDE shard_map: q,k,v are the per-device sequence chunks (B,H,t,D).
 
-    Rotates K/V with ``lax.ppermute`` (ICI neighbor exchange) n-1 times; the next
-    chunk's transfer overlaps the current chunk's attention automatically (XLA
-    schedules the ppermute DMA concurrently with the einsums).
+    Rotates K/V with ``lax.ppermute`` (ICI neighbor exchange) n-1 times; each
+    resident chunk is attended by ``ops.attention.flash_chunk`` — the Pallas
+    kernel on TPU at eligible shapes — and partial results compose by their
+    log-sum-exp (``_merge_chunks``), the exact blockwise-softmax identity.
+    Causal masking: the diagonal chunk runs the kernel's causal mode, chunks
+    entirely below the diagonal run dense, chunks above contribute weight 0
+    (their lse is forced to the -inf sentinel).
     """
+    from ..ops.attention import flash_chunk
+
     n = lax.psum(1, axis_name)
     r = lax.axis_index(axis_name)
     t = q.shape[2]
     d = q.shape[3]
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     q_offset = r * t
-
-    m = jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32)
-    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
-    o = jnp.zeros(q.shape, jnp.float32)
     qf = q.astype(jnp.float32)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def attend(s, k_cur, v_cur, m, l, o):
-        # K/V currently resident came from device (r - s) mod n
+    def attend(s, k_cur, v_cur):
+        kf = k_cur.astype(jnp.float32)
+        vf = v_cur.astype(jnp.float32)
+        if not causal:
+            return flash_chunk(qf, kf, vf, False, sc)
         src = (r - s) % n
         k_offset = src * t
-        return _block_attend(qf, k_cur.astype(jnp.float32),
-                             v_cur.astype(jnp.float32), m, l, o, sc,
-                             q_offset, k_offset, causal)
+
+        def diag(_):
+            return flash_chunk(qf, kf, vf, True, sc)
+
+        def below(_):
+            return flash_chunk(qf, kf, vf, False, sc)
+
+        def above(_):
+            # fully masked: contribute weight 0 WITHOUT paying the kernel
+            return (jnp.zeros(qf.shape, jnp.float32),
+                    jnp.full(qf.shape[:3], _NEG_INF, jnp.float32))
+
+        def offdiag(_):
+            return lax.cond(k_offset > q_offset, above, below, None)
+
+        return lax.cond(k_offset == q_offset, diag, offdiag, None)
 
     def step(s, carry):
-        k_cur, v_cur, m, l, o = carry
-        m, l, o = attend(s, k_cur, v_cur, m, l, o)
+        k_cur, v_cur, o_acc, lse_acc = carry
+        o_i, lse_i = attend(s, k_cur, v_cur)
+        o_acc, lse_acc = _merge_chunks(o_acc, lse_acc, o_i, lse_i)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, m, l, o
+        return k_nxt, v_nxt, o_acc, lse_acc
 
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
     # n-1 attend+rotate steps, then a final attend — the last rotation would only
     # return chunks to their owners, so skipping it saves one full K/V RDMA per call
-    k_cur, v_cur, m, l, o = lax.fori_loop(0, n - 1, step, (k, v, m, l, o))
-    m, l, o = attend(n - 1, k_cur, v_cur, m, l, o)
-    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    k_cur, v_cur, o_acc, lse_acc = lax.fori_loop(
+        0, n - 1, step, (k, v, o0, lse0))
+    o_i, lse_i = attend(n - 1, k_cur, v_cur)
+    o_acc, _ = _merge_chunks(o_acc, lse_acc, o_i, lse_i)
+    return o_acc.astype(q.dtype)
 
 
 def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
