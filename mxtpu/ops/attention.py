@@ -37,12 +37,13 @@ _NEG_INF = -1e30
 
 def attention_reference(q, k, v, causal: bool = False, scale: Optional[float] = None,
                         bias=None):
-    """Pure-XLA softmax attention. q,k,v: (B, H, T, D)."""
+    """Pure-XLA softmax attention. q,k,v: (B, H, T, D). The bias-free path is
+    the single shared implementation (``_chunk_reference_lse``)."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
-    if bias is not None:
-        logits = logits + bias
+    if bias is None:
+        return _chunk_reference_lse(q, k, v, causal, s)[0]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s + bias
     if causal:
         # top-left alignment (row i attends keys 0..i), matching torch is_causal
         # and the Pallas kernel's rows>=cols convention

@@ -67,16 +67,13 @@ def ring_attention_inner(q, k, v, axis_name: str, causal: bool = False,
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def attend(s, k_cur, v_cur):
+    def offdiag_attend(s, k_cur, v_cur):
+        """Attend a rotated (never-diagonal) chunk: s in [1, n-1]."""
         kf = k_cur.astype(jnp.float32)
         vf = v_cur.astype(jnp.float32)
         if not causal:
             return flash_chunk(qf, kf, vf, False, sc)
-        src = (r - s) % n
-        k_offset = src * t
-
-        def diag(_):
-            return flash_chunk(qf, kf, vf, True, sc)
+        k_offset = ((r - s) % n) * t
 
         def below(_):
             return flash_chunk(qf, kf, vf, False, sc)
@@ -86,27 +83,23 @@ def ring_attention_inner(q, k, v, axis_name: str, causal: bool = False,
             return (jnp.zeros(qf.shape, jnp.float32),
                     jnp.full(qf.shape[:3], _NEG_INF, jnp.float32))
 
-        def offdiag(_):
-            return lax.cond(k_offset > q_offset, above, below, None)
-
-        return lax.cond(k_offset == q_offset, diag, offdiag, None)
+        return lax.cond(k_offset > q_offset, above, below, None)
 
     def step(s, carry):
         k_cur, v_cur, o_acc, lse_acc = carry
-        o_i, lse_i = attend(s, k_cur, v_cur)
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        o_i, lse_i = offdiag_attend(s, k_cur, v_cur)
         o_acc, lse_acc = _merge_chunks(o_acc, lse_acc, o_i, lse_i)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, o_acc, lse_acc
+        return k_cur, v_cur, o_acc, lse_acc
 
-    o0 = jnp.zeros(q.shape, jnp.float32)
-    lse0 = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
-    # n-1 attend+rotate steps, then a final attend — the last rotation would only
-    # return chunks to their owners, so skipping it saves one full K/V RDMA per call
-    k_cur, v_cur, o_acc, lse_acc = lax.fori_loop(
-        0, n - 1, step, (k, v, o0, lse0))
-    o_i, lse_i = attend(n - 1, k_cur, v_cur)
-    o_acc, _ = _merge_chunks(o_acc, lse_acc, o_i, lse_i)
+    # step 0 is ALWAYS the diagonal chunk (src == r) — statically known, so
+    # the causal kernel call lives outside the loop; the loop body rotates
+    # then attends strictly off-diagonal chunks (n-1 rotations total)
+    o_acc, lse_acc = flash_chunk(qf, k.astype(jnp.float32),
+                                 v.astype(jnp.float32), causal, sc)
+    _, _, o_acc, lse_acc = lax.fori_loop(
+        1, n, step, (k, v, o_acc, lse_acc))
     return o_acc.astype(q.dtype)
 
 
