@@ -28,7 +28,7 @@ def main():
 
     flat = args.network == "mlp"
     train = io.MNISTIter(batch_size=args.batch_size, flat=flat)
-    val = io.MNISTIter(batch_size=args.batch_size, flat=flat)
+    val = io.MNISTIter(batch_size=args.batch_size, flat=flat, seed=7)  # held out
 
     if args.network == "mlp":
         net = nn.HybridSequential()

@@ -87,19 +87,29 @@ def ring_attention_inner(q, k, v, axis_name: str, causal: bool = False,
 
     def step(s, carry):
         k_cur, v_cur, o_acc, lse_acc = carry
-        k_cur = lax.ppermute(k_cur, axis_name, perm)
-        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        # attend the resident chunk while PREFETCHING the next over ICI —
+        # the two are data-independent, so XLA overlaps the ppermute RDMA
+        # with the flash kernel (the ring's latency-hiding property)
         o_i, lse_i = offdiag_attend(s, k_cur, v_cur)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
         o_acc, lse_acc = _merge_chunks(o_acc, lse_acc, o_i, lse_i)
-        return k_cur, v_cur, o_acc, lse_acc
+        return k_nxt, v_nxt, o_acc, lse_acc
 
     # step 0 is ALWAYS the diagonal chunk (src == r) — statically known, so
-    # the causal kernel call lives outside the loop; the loop body rotates
-    # then attends strictly off-diagonal chunks (n-1 rotations total)
+    # the causal kernel call lives outside the loop; the first rotation is
+    # issued alongside it (independent ops), the loop attends+prefetches
+    # chunks 1..n-2, and the last chunk attends with no trailing rotation
+    # (n-1 rotations total, same as the ring requires)
     o_acc, lse_acc = flash_chunk(qf, k.astype(jnp.float32),
                                  v.astype(jnp.float32), causal, sc)
-    _, _, o_acc, lse_acc = lax.fori_loop(
-        1, n, step, (k, v, o_acc, lse_acc))
+    if n > 1:
+        k_cur = lax.ppermute(k, axis_name, perm)
+        v_cur = lax.ppermute(v, axis_name, perm)
+        k_cur, v_cur, o_acc, lse_acc = lax.fori_loop(
+            1, n - 1, step, (k_cur, v_cur, o_acc, lse_acc))
+        o_i, lse_i = offdiag_attend(n - 1, k_cur, v_cur)
+        o_acc, lse_acc = _merge_chunks(o_acc, lse_acc, o_i, lse_i)
     return o_acc.astype(q.dtype)
 
 

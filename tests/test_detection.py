@@ -345,3 +345,24 @@ def test_fft_ifft_roundtrip():
     # unnormalized inverse (cuFFT convention): ifft(fft(x)) = x * d
     back = nd.contrib.ifft(f).asnumpy()
     np.testing.assert_allclose(back, x * 16, rtol=1e-4, atol=1e-3)
+
+
+def test_toy_ssd_example_trains(monkeypatch):
+    """examples/train_ssd_toy.py end-to-end: the detector genuinely learns
+    through the MultiBoxPrior -> MultiBoxTarget -> losses -> MultiBoxDetection
+    chain (localization + class quality, not just loss motion)."""
+    import importlib.util
+    import os
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "train_ssd_toy", os.path.join(root, "examples", "train_ssd_toy.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    monkeypatch.setattr(sys, "argv", ["train_ssd_toy.py", "--steps", "35",
+                                      "--batch-size", "8"])
+    _first, _last, mean_iou, hits = m.main()
+    # localization quality well above the untrained baseline (~0.02) and
+    # several exact class+IoU hits
+    assert mean_iou > 0.2, mean_iou
+    assert hits >= 3, hits
