@@ -69,7 +69,10 @@ def main():
 
     import numpy as np
 
+    import mxtpu as mx
     from mxtpu import autograd, gluon, nd
+
+    mx.rng.seed(0)  # deterministic init regardless of ambient rng state
 
     num_classes = 3
     sizes, ratios = (0.35, 0.6), (1.0, 2.0)
