@@ -1,0 +1,173 @@
+"""On-demand build + ctypes binding of the C ABI library (native/mxtpu_capi.cc).
+
+Mirrors :mod:`mxtpu.native`'s build-at-first-use pattern. The library is the
+stable C boundary other languages bind against (c_predict_api.h role, SURVEY
+§2.6); this module additionally exposes it back to Python so the test suite can
+exercise the exact ABI a C/R/JVM client would use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import sysconfig
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .native import compile_shared
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "mxtpu_capi.cc")
+_LIB_PATH = os.path.join(os.path.dirname(_SRC), "libmxtpu_capi.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def python_link_flags():
+    """(include_dir, libdir, libname) for embedding this interpreter."""
+    include = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    return include, libdir, f"python{ver}"
+
+
+def build() -> bool:
+    """g++ against this interpreter's libpython; mtime-cached via compile_shared."""
+    include, libdir, libname = python_link_flags()
+    return compile_shared(_SRC, _LIB_PATH, ([
+        f"-I{include}", f"-L{libdir}", f"-l{libname}", f"-Wl,-rpath,{libdir}"],))
+
+
+def lib_path() -> str:
+    return _LIB_PATH
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC) or not build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        u32 = ctypes.c_uint32
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        lib.MXCAPIGetVersion.argtypes = [ctypes.POINTER(ctypes.c_int)]
+        lib.MXPredCreate.restype = ctypes.c_int
+        lib.MXPredCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, u32, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(u32), ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXPredGetNumOutputs.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(u32)]
+        lib.MXPredGetOutputShape.argtypes = [
+            ctypes.c_void_p, u32, ctypes.POINTER(ctypes.POINTER(u32)),
+            ctypes.POINTER(u32)]
+        lib.MXPredSetInput.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"), u32]
+        lib.MXPredForward.argtypes = [ctypes.c_void_p]
+        lib.MXPredGetOutput.argtypes = [
+            ctypes.c_void_p, u32,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"), u32]
+        lib.MXPredFree.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class CPredictor:
+    """Python client of the C ABI — the same calls a C binding would make.
+
+    This deliberately goes through the flat-buffer boundary (not capi_impl
+    directly) so tests cover marshalling, the error convention, and the
+    embedded-interpreter attach path.
+    """
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 input_shapes: dict, dev_type: int = 1, dev_id: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("C ABI library unavailable (no g++/libpython?)")
+        self._lib = lib
+        names = list(input_shapes.keys())
+        keys = (ctypes.c_char_p * len(names))(
+            *[n.encode() for n in names])
+        indptr = [0]
+        flat: list = []
+        for n in names:
+            flat.extend(int(d) for d in input_shapes[n])
+            indptr.append(len(flat))
+        c_indptr = (ctypes.c_uint32 * len(indptr))(*indptr)
+        c_shape = (ctypes.c_uint32 * max(1, len(flat)))(*(flat or [0]))
+        handle = ctypes.c_void_p()
+        rc = lib.MXPredCreate(symbol_json.encode(), param_bytes,
+                              len(param_bytes), dev_type, dev_id, len(names),
+                              keys, c_indptr, c_shape, ctypes.byref(handle))
+        if rc != 0:
+            raise RuntimeError(f"MXPredCreate: {self.last_error()}")
+        self._handle = handle
+
+    def last_error(self) -> str:
+        return (self._lib.MXGetLastError() or b"").decode()
+
+    def set_input(self, key: str, arr: np.ndarray):
+        arr = np.ascontiguousarray(arr, np.float32)
+        rc = self._lib.MXPredSetInput(self._handle, key.encode(), arr,
+                                      arr.size)
+        if rc != 0:
+            raise RuntimeError(f"MXPredSetInput: {self.last_error()}")
+
+    def forward(self):
+        if self._lib.MXPredForward(self._handle) != 0:
+            raise RuntimeError(f"MXPredForward: {self.last_error()}")
+
+    @property
+    def num_outputs(self) -> int:
+        n = ctypes.c_uint32()
+        if self._lib.MXPredGetNumOutputs(self._handle, ctypes.byref(n)) != 0:
+            raise RuntimeError(f"MXPredGetNumOutputs: {self.last_error()}")
+        return n.value
+
+    def output_shape(self, index: int) -> tuple:
+        data = ctypes.POINTER(ctypes.c_uint32)()
+        ndim = ctypes.c_uint32()
+        rc = self._lib.MXPredGetOutputShape(self._handle, index,
+                                            ctypes.byref(data),
+                                            ctypes.byref(ndim))
+        if rc != 0:
+            raise RuntimeError(f"MXPredGetOutputShape: {self.last_error()}")
+        return tuple(data[i] for i in range(ndim.value))
+
+    def get_output(self, index: int) -> np.ndarray:
+        shape = self.output_shape(index)
+        out = np.empty(shape, np.float32)
+        rc = self._lib.MXPredGetOutput(self._handle, index,
+                                       out.reshape(-1), out.size)
+        if rc != 0:
+            raise RuntimeError(f"MXPredGetOutput: {self.last_error()}")
+        return out
+
+    def free(self):
+        if getattr(self, "_handle", None):
+            self._lib.MXPredFree(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.free()
+        except Exception:
+            pass

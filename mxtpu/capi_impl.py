@@ -1,0 +1,112 @@
+"""Python support layer for the native C ABI (native/mxtpu_capi.cc).
+
+The reference exposes a predict-only C ABI (``include/mxnet/c_predict_api.h``,
+``src/c_api/c_predict_api.cc``: MXPredCreate/SetInput/Forward/GetOutput) so any
+language with a C FFI can run inference from a symbol-JSON + params checkpoint.
+In the TPU-native design the compute path is JAX, so the stable C boundary embeds
+(or attaches to) the CPython interpreter and drives this module; the C side stays
+a thin marshalling shim (buffers in, buffers out) while graph loading, shape
+inference, and execution reuse the framework's own Symbol/Executor stack.
+
+Checkpoint convention matches ``mxtpu.model.save_checkpoint`` (and the reference's
+model.py:384): symbol JSON from ``Symbol.tojson`` + an ``arg:``/``aux:``-prefixed
+params file (nd.save format).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Predictor", "create_predictor", "load_param_bytes"]
+
+
+def load_param_bytes(param_bytes: bytes) -> Tuple[Dict, Dict]:
+    """Split a params payload (nd.save npz format) into (arg_params, aux_params),
+    stripping the reference's ``arg:``/``aux:`` prefixes (c_predict_api.cc does the
+    same split when creating a predictor)."""
+    from .ndarray.ndarray import _SAVE_FORMAT_KEY, _decode_entries
+
+    with np.load(io.BytesIO(param_bytes), allow_pickle=False) as z:
+        keys = [k for k in z.keys() if k != _SAVE_FORMAT_KEY]
+        entries = _decode_entries(z, keys)
+    arg_params, aux_params = {}, {}
+    for k, v in entries.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+class Predictor:
+    """One bound inference executor behind a C ``PredictorHandle``."""
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 input_names: Sequence[str],
+                 input_shapes: Sequence[Sequence[int]],
+                 dev_type: int = 1, dev_id: int = 0):
+        from . import context
+        from .symbol import load_json
+
+        if len(input_names) != len(input_shapes):
+            raise ValueError("input_keys and input_shapes length mismatch")
+        sym = load_json(symbol_json)
+        arg_params, aux_params = load_param_bytes(param_bytes)
+        self._input_names = list(input_names)
+        self._input_shapes = {n: tuple(int(d) for d in s)
+                              for n, s in zip(input_names, input_shapes)}
+        # dev_type follows the reference's enum (1=cpu, 2=gpu); the accelerator
+        # slot maps to the TPU context here
+        ctx = context.cpu(dev_id) if dev_type == 1 else context.tpu(dev_id)
+        self._exec = sym.simple_bind(ctx=ctx, grad_req="null",
+                                     **self._input_shapes)
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=True)
+        self._outputs: List[np.ndarray] = []
+        self.forward()  # c_predict_api.cc runs an initial forward on create
+
+    # -- C-boundary entry points (flat buffers only) -------------------------
+    def set_input(self, key: str, data: bytes) -> None:
+        """Copy a float32 buffer into the named input (MXPredSetInput)."""
+        if key not in self._input_shapes:
+            raise KeyError(f"unknown input {key!r}; declared: "
+                           f"{self._input_names}")
+        shape = self._input_shapes[key]
+        arr = np.frombuffer(data, dtype=np.float32)
+        expect = int(np.prod(shape)) if shape else 1
+        if arr.size != expect:
+            raise ValueError(f"input {key!r} expects {expect} floats "
+                             f"(shape {shape}), got {arr.size}")
+        import jax.numpy as jnp
+        self._exec.arg_dict[key]._set_data(jnp.asarray(arr.reshape(shape)))
+
+    def forward(self) -> None:
+        self._exec.forward(is_train=False)
+        self._outputs = [np.asarray(o.data, dtype=np.float32)
+                         for o in self._exec.outputs]
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._outputs)
+
+    def output_shape(self, index: int) -> Tuple[int, ...]:
+        return tuple(int(d) for d in self._outputs[index].shape)
+
+    def get_output(self, index: int) -> bytes:
+        """Return output ``index`` as a contiguous float32 buffer."""
+        return np.ascontiguousarray(self._outputs[index],
+                                    dtype=np.float32).tobytes()
+
+
+def create_predictor(symbol_json: str, param_bytes: bytes,
+                     input_names: Sequence[str],
+                     input_shapes: Sequence[Sequence[int]],
+                     dev_type: int = 1, dev_id: int = 0) -> Predictor:
+    """Factory the C side calls (keeps the C code to one attribute lookup)."""
+    return Predictor(symbol_json, param_bytes, input_names, input_shapes,
+                     dev_type, dev_id)

@@ -30,23 +30,32 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
-    """g++ -O3 -shared; rebuilt when the source is newer than the .so."""
-    if os.path.exists(_LIB_PATH) and \
-            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+def compile_shared(src: str, lib_path: str, extra_flag_sets=((),),
+                   timeout: int = 180) -> bool:
+    """g++ -O3 -shared -fPIC a native source into a .so, rebuilt only when the
+    source is newer than the artifact. ``extra_flag_sets`` are tried in order
+    until one compiles (feature-gated variants first, bare fallback last).
+    Shared by every on-demand native build (IO lib here, C ABI in capi.py)."""
+    if os.path.exists(lib_path) and \
+            os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return True
     base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-            _SRC, "-o", _LIB_PATH]
-    # jpeg support is optional: hosts without libjpeg dev files still get the
-    # RecordIO/normalize kernels (jpeg entry points report failure -> PIL path)
-    for extra in (["-DMXTPU_HAVE_JPEG", "-ljpeg"], []):
+            src, "-o", lib_path]
+    for extra in extra_flag_sets:
         try:
-            subprocess.run(base + extra, check=True, capture_output=True,
-                           timeout=120)
+            subprocess.run(base + list(extra), check=True, capture_output=True,
+                           timeout=timeout)
             return True
         except (OSError, subprocess.SubprocessError):
             continue
     return False
+
+
+def _build() -> bool:
+    # jpeg support is optional: hosts without libjpeg dev files still get the
+    # RecordIO/normalize kernels (jpeg entry points report failure -> PIL path)
+    return compile_shared(_SRC, _LIB_PATH,
+                          (["-DMXTPU_HAVE_JPEG", "-ljpeg"], []), timeout=120)
 
 
 def _load() -> Optional[ctypes.CDLL]:

@@ -1,0 +1,120 @@
+/* Pure-C client of the mxtpu C ABI (libmxtpu_capi.so).
+ *
+ * Proves the bindings story end-to-end with no Python in the host program:
+ * this process starts as plain C, the library bootstraps the embedded
+ * interpreter, and inference runs from a symbol-JSON + params checkpoint —
+ * the same usage pattern as the reference's c_predict_api examples
+ * (example/image-classification/predict-cpp).
+ *
+ * Usage: capi_demo <symbol.json> <file.params> <input_name> <d0,d1,...>
+ * Prints one JSON line: {"ok":1,"shape":[...],"checksum":...,"first":...}
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* PredictorHandle;
+extern const char* MXGetLastError(void);
+extern int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                        int param_size, int dev_type, int dev_id,
+                        uint32_t num_input, const char** input_keys,
+                        const uint32_t* input_shape_indptr,
+                        const uint32_t* input_shape_data,
+                        PredictorHandle* out);
+extern int MXPredSetInput(PredictorHandle h, const char* key,
+                          const float* data, uint32_t size);
+extern int MXPredForward(PredictorHandle h);
+extern int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                                uint32_t** shape_data, uint32_t* shape_ndim);
+extern int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                           uint32_t size);
+extern int MXPredFree(PredictorHandle h);
+
+static char* read_file(const char* path, long* out_len) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc((size_t)n + 1);
+  if (fread(buf, 1, (size_t)n, f) != (size_t)n) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[n] = 0;
+  fclose(f);
+  if (out_len) *out_len = n;
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    fprintf(stderr, "usage: %s symbol.json file.params input_name d0,d1,...\n",
+            argv[0]);
+    return 2;
+  }
+  long sym_len = 0, param_len = 0;
+  char* sym = read_file(argv[1], &sym_len);
+  char* params = read_file(argv[2], &param_len);
+  if (!sym || !params) {
+    fprintf(stderr, "cannot read inputs\n");
+    return 2;
+  }
+
+  uint32_t shape[16];
+  uint32_t ndim = 0;
+  uint32_t numel = 1;
+  for (char* tok = strtok(argv[4], ","); tok && ndim < 16;
+       tok = strtok(NULL, ",")) {
+    shape[ndim] = (uint32_t)atoi(tok);
+    numel *= shape[ndim];
+    ndim++;
+  }
+  uint32_t indptr[2] = {0, ndim};
+  const char* keys[1] = {argv[3]};
+
+  PredictorHandle h = NULL;
+  if (MXPredCreate(sym, params, (int)param_len, 1, 0, 1, keys, indptr, shape,
+                   &h) != 0) {
+    fprintf(stderr, "MXPredCreate failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  float* in = (float*)malloc(sizeof(float) * numel);
+  for (uint32_t i = 0; i < numel; ++i)
+    in[i] = 0.01f * (float)(i % 100) - 0.5f; /* deterministic ramp */
+  if (MXPredSetInput(h, argv[3], in, numel) != 0 || MXPredForward(h) != 0) {
+    fprintf(stderr, "set_input/forward failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  uint32_t* oshape = NULL;
+  uint32_t ondim = 0;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "get_output_shape failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  uint32_t osize = 1;
+  for (uint32_t i = 0; i < ondim; ++i) osize *= oshape[i];
+  float* out = (float*)malloc(sizeof(float) * osize);
+  if (MXPredGetOutput(h, 0, out, osize) != 0) {
+    fprintf(stderr, "get_output failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  double checksum = 0.0;
+  for (uint32_t i = 0; i < osize; ++i) checksum += (double)out[i];
+  printf("{\"ok\":1,\"shape\":[");
+  for (uint32_t i = 0; i < ondim; ++i)
+    printf("%s%u", i ? "," : "", oshape[i]);
+  printf("],\"checksum\":%.6f,\"first\":%.6f}\n", checksum, (double)out[0]);
+
+  MXPredFree(h);
+  free(in);
+  free(out);
+  free(sym);
+  free(params);
+  return 0;
+}

@@ -1,0 +1,329 @@
+// mxtpu C ABI — predict API + error convention.
+//
+// Reference parity: include/mxnet/c_predict_api.h (MXPredCreate / MXPredSetInput /
+// MXPredForward / MXPredGetOutputShape / MXPredGetOutput / MXPredFree, 250 LoC) and
+// the API_BEGIN/API_END -> MXGetLastError error convention of src/c_api/
+// c_api_common.h:38-47 + c_api_error.cc:28.
+//
+// TPU-native design: the compute path is JAX, so the stable C boundary embeds (or,
+// when the host process already runs Python, attaches to) the CPython interpreter
+// and drives mxtpu/capi_impl.py. The C side is pure marshalling: every entry point
+// takes flat buffers, grabs the GIL, calls one Python method, and copies results
+// out. Any language with a C FFI (the reference's Scala/R/C++/Perl binding role,
+// SURVEY §2.6) can load this library and run inference from a symbol-JSON +
+// params checkpoint without knowing Python exists.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 $(python3-config --includes) \
+//   mxtpu_capi.cc -o libmxtpu_capi.so -L$LIBDIR -lpython3.X
+// (mxtpu/capi.py does this on demand, like mxtpu/native.py does for the IO lib.)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define MXTPU_CAPI_ABI_VERSION 1
+
+extern "C" {
+typedef void* PredictorHandle;
+
+const char* MXGetLastError();
+int MXCAPIGetVersion(int* out);
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out);
+int MXPredGetNumOutputs(PredictorHandle handle, uint32_t* out);
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim);
+int MXPredSetInput(PredictorHandle handle, const char* key, const float* data,
+                   uint32_t size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle handle);
+}
+
+namespace {
+
+// ---- error convention (c_api_common.h API_BEGIN/API_END parity) -------------
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  // must hold the GIL
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// ---- interpreter bootstrap ---------------------------------------------------
+// Two modes: (a) host process already runs Python (ctypes in-process binding) —
+// attach via PyGILState; (b) pure C/C++ host (the bindings story) — initialize
+// the interpreter once, then release the GIL so every entry point can use the
+// same PyGILState discipline regardless of mode.
+std::once_flag g_init_once;
+PyObject* g_impl_module = nullptr;  // mxtpu.capi_impl, owned forever
+bool g_init_ok = false;
+std::string g_bootstrap_error;  // shared across threads (set once, read-only after)
+
+void bootstrap() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);          // no signal handlers: we are a library
+    PyEval_SaveThread();         // drop the GIL acquired by initialization
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* mod = PyImport_ImportModule("mxtpu.capi_impl");
+  if (mod == nullptr) {
+    set_error_from_python();
+    g_bootstrap_error =
+        "cannot import mxtpu.capi_impl (is the repo on PYTHONPATH?): "
+        + g_last_error;
+  } else {
+    g_impl_module = mod;  // keep the reference for the process lifetime
+    g_init_ok = true;
+  }
+  PyGILState_Release(gil);
+}
+
+bool ensure_ready() {
+  std::call_once(g_init_once, bootstrap);
+  if (!g_init_ok)
+    g_last_error = g_bootstrap_error;  // every failing caller's thread sees it
+  return g_init_ok;
+}
+
+struct Pred {
+  PyObject* obj;  // mxtpu.capi_impl.Predictor instance (owned)
+  // backing store for MXPredGetOutputShape pointers (valid until next call on
+  // the same handle / MXPredFree, same lifetime contract as the reference)
+  std::vector<std::vector<uint32_t>> shapes;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+int MXCAPIGetVersion(int* out) {
+  *out = MXTPU_CAPI_ABI_VERSION;
+  return 0;
+}
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out) {
+  if (out == nullptr || symbol_json_str == nullptr) {
+    g_last_error = "MXPredCreate: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* names = nullptr;
+  PyObject* shapes = nullptr;
+  PyObject* params = nullptr;
+  PyObject* pobj = nullptr;
+  do {
+    names = PyList_New(num_input_nodes);
+    shapes = PyList_New(num_input_nodes);
+    if (names == nullptr || shapes == nullptr) break;
+    bool fail = false;
+    for (uint32_t i = 0; i < num_input_nodes; ++i) {
+      PyObject* key = PyUnicode_FromString(input_keys[i]);
+      if (key == nullptr) { fail = true; break; }
+      PyList_SET_ITEM(names, i, key);
+      uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+      PyObject* shp = PyTuple_New(hi - lo);
+      if (shp == nullptr) { fail = true; break; }
+      for (uint32_t j = lo; j < hi; ++j)
+        PyTuple_SET_ITEM(shp, j - lo,
+                         PyLong_FromUnsignedLong(input_shape_data[j]));
+      PyList_SET_ITEM(shapes, i, shp);
+    }
+    if (fail) break;
+    params = PyBytes_FromStringAndSize(
+        static_cast<const char*>(param_bytes), param_size);
+    if (params == nullptr) break;
+    pobj = PyObject_CallMethod(g_impl_module, "create_predictor", "sOOOii",
+                               symbol_json_str, params, names, shapes,
+                               dev_type, dev_id);
+    if (pobj == nullptr) {
+      set_error_from_python();
+      break;
+    }
+    Pred* p = new Pred{pobj, {}};
+    pobj = nullptr;  // ownership moved into the handle
+    *out = p;
+    rc = 0;
+  } while (false);
+  if (rc != 0 && !PyErr_Occurred() && g_last_error.empty())
+    g_last_error = "MXPredCreate: allocation failure";
+  if (PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(names);
+  Py_XDECREF(shapes);
+  Py_XDECREF(params);
+  Py_XDECREF(pobj);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetNumOutputs(PredictorHandle handle, uint32_t* out) {
+  Pred* p = static_cast<Pred*>(handle);
+  if (p == nullptr || out == nullptr) {
+    g_last_error = "MXPredGetNumOutputs: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* n = PyObject_GetAttrString(p->obj, "num_outputs");
+  if (n == nullptr) {
+    set_error_from_python();
+  } else {
+    *out = static_cast<uint32_t>(PyLong_AsUnsignedLong(n));
+    Py_DECREF(n);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim) {
+  Pred* p = static_cast<Pred*>(handle);
+  if (p == nullptr || shape_data == nullptr || shape_ndim == nullptr) {
+    g_last_error = "MXPredGetOutputShape: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* shp = PyObject_CallMethod(p->obj, "output_shape", "I", index);
+  if (shp == nullptr) {
+    set_error_from_python();
+  } else {
+    Py_ssize_t nd = PyTuple_Size(shp);
+    std::vector<uint32_t> dims(static_cast<size_t>(nd));
+    for (Py_ssize_t i = 0; i < nd; ++i)
+      dims[i] = static_cast<uint32_t>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i)));
+    Py_DECREF(shp);
+    if (p->shapes.size() <= index) p->shapes.resize(index + 1);
+    p->shapes[index] = std::move(dims);
+    *shape_data = p->shapes[index].data();
+    *shape_ndim = static_cast<uint32_t>(p->shapes[index].size());
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key, const float* data,
+                   uint32_t size) {
+  Pred* p = static_cast<Pred*>(handle);
+  if (p == nullptr || key == nullptr || data == nullptr) {
+    g_last_error = "MXPredSetInput: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(float));
+  if (buf != nullptr) {
+    PyObject* r = PyObject_CallMethod(p->obj, "set_input", "sO", key, buf);
+    Py_DECREF(buf);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      Py_DECREF(r);
+      rc = 0;
+    }
+  } else {
+    set_error_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Pred* p = static_cast<Pred*>(handle);
+  if (p == nullptr) {
+    g_last_error = "MXPredForward: null handle";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(p->obj, "forward", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size) {
+  Pred* p = static_cast<Pred*>(handle);
+  if (p == nullptr || data == nullptr) {
+    g_last_error = "MXPredGetOutput: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(p->obj, "get_output", "I", index);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    char* raw = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(r, &raw, &len) == 0) {
+      Py_ssize_t want = static_cast<Py_ssize_t>(size) * sizeof(float);
+      if (len != want) {
+        g_last_error = "MXPredGetOutput: size mismatch (have " +
+                       std::to_string(len / sizeof(float)) + " floats, caller asked " +
+                       std::to_string(size) + ")";
+      } else {
+        std::memcpy(data, raw, static_cast<size_t>(len));
+        rc = 0;
+      }
+    } else {
+      set_error_from_python();
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Pred* p = static_cast<Pred*>(handle);
+  if (p == nullptr) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->obj);
+  PyGILState_Release(gil);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

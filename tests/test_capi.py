@@ -1,0 +1,135 @@
+"""C ABI tests (native/mxtpu_capi.cc — c_predict_api.h parity).
+
+Two clients of the same shared library:
+* in-process ctypes (`mxtpu.capi.CPredictor`) — covers marshalling, the error
+  convention, and the attach-to-running-interpreter path;
+* a pure-C program (native/capi_demo.c) compiled and run as a subprocess —
+  covers the embedded-interpreter bootstrap, i.e. the real bindings story
+  (no Python in the host program).
+"""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import capi, model, nd
+from mxtpu import symbol as sym
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(not capi.available(),
+                                reason="C ABI library unavailable")
+
+
+def _make_checkpoint(tmp_path, batch=4, in_dim=6, hidden=8, classes=3):
+    """A small symbolic MLP + its checkpoint files; returns
+    (prefix, input_shape, oracle_fn)."""
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    out = sym.softmax(fc2, name="prob")
+
+    rs = np.random.RandomState(7)
+    arg_params = {
+        "fc1_weight": nd.array(rs.randn(hidden, in_dim).astype(np.float32) * 0.4),
+        "fc1_bias": nd.array(rs.randn(hidden).astype(np.float32) * 0.1),
+        "fc2_weight": nd.array(rs.randn(classes, hidden).astype(np.float32) * 0.4),
+        "fc2_bias": nd.array(rs.randn(classes).astype(np.float32) * 0.1),
+    }
+    prefix = str(tmp_path / "capi_mlp")
+    model.save_checkpoint(prefix, 0, symbol=out, arg_params=arg_params)
+
+    def oracle(x):
+        ex = out.simple_bind(ctx=mx.cpu(), grad_req="null",
+                             data=(x.shape[0], in_dim))
+        ex.copy_params_from(arg_params)
+        ex.forward(is_train=False, data=nd.array(x))
+        return ex.outputs[0].asnumpy()
+
+    return prefix, (batch, in_dim), oracle
+
+
+def test_cpredictor_matches_executor(tmp_path):
+    prefix, in_shape, oracle = _make_checkpoint(tmp_path)
+    with open(f"{prefix}-symbol.json") as f:
+        sym_json = f.read()
+    with open(f"{prefix}-0000.params", "rb") as f:
+        param_bytes = f.read()
+
+    pred = capi.CPredictor(sym_json, param_bytes, {"data": in_shape})
+    assert pred.num_outputs == 1
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(*in_shape).astype(np.float32)
+    pred.set_input("data", x)
+    pred.forward()
+    assert pred.output_shape(0) == (in_shape[0], 3)
+    got = pred.get_output(0)
+    np.testing.assert_allclose(got, oracle(x), rtol=1e-5, atol=1e-6)
+    # rows are softmax distributions
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+    pred.free()
+
+
+def test_capi_error_convention(tmp_path):
+    prefix, in_shape, _ = _make_checkpoint(tmp_path)
+    with open(f"{prefix}-symbol.json") as f:
+        sym_json = f.read()
+    with open(f"{prefix}-0000.params", "rb") as f:
+        param_bytes = f.read()
+    pred = capi.CPredictor(sym_json, param_bytes, {"data": in_shape})
+    # unknown input name -> rc!=0 and MXGetLastError carries the message
+    with pytest.raises(RuntimeError, match="unknown input"):
+        pred.set_input("not_an_input", np.zeros(in_shape, np.float32))
+    # wrong element count
+    with pytest.raises(RuntimeError, match="expects"):
+        pred.set_input("data", np.zeros(3, np.float32))
+    # bad symbol JSON fails create with a real message
+    with pytest.raises(RuntimeError, match="MXPredCreate"):
+        capi.CPredictor("{not json", param_bytes, {"data": in_shape})
+    pred.free()
+
+
+def test_pure_c_client(tmp_path):
+    """Compile native/capi_demo.c with gcc and run it against the checkpoint —
+    no Python in the host program."""
+    prefix, in_shape, oracle = _make_checkpoint(tmp_path)
+
+    demo_src = os.path.join(REPO, "native", "capi_demo.c")
+    demo_bin = str(tmp_path / "capi_demo")
+    libdir = os.path.dirname(capi.lib_path())
+    try:
+        subprocess.run(
+            ["gcc", "-O2", demo_src, "-o", demo_bin,
+             f"-L{libdir}", "-lmxtpu_capi", f"-Wl,-rpath,{libdir}"],
+            check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"cannot compile C demo: {e}")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the embedded interpreter must not inherit a TPU platform pin: the demo
+    # runs on the host CPU backend
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [demo_bin, f"{prefix}-symbol.json", f"{prefix}-0000.params", "data",
+         ",".join(str(d) for d in in_shape)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, f"demo failed: {r.stderr[-2000:]}"
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["ok"] == 1
+    assert payload["shape"] == [in_shape[0], 3]
+
+    # same deterministic ramp the C program feeds
+    numel = int(np.prod(in_shape))
+    x = (0.01 * (np.arange(numel) % 100) - 0.5).astype(np.float32)
+    want = oracle(x.reshape(in_shape))
+    # the embedded interpreter compiles with its own XLA flags, so fp32
+    # reassociation can differ slightly from the in-process oracle
+    assert abs(payload["checksum"] - want.sum()) < 1e-3
+    assert abs(payload["first"] - want.flat[0]) < 1e-3
