@@ -56,6 +56,12 @@ class Predictor:
         if len(input_names) != len(input_shapes):
             raise ValueError("input_keys and input_shapes length mismatch")
         sym = load_json(symbol_json)
+        arg_names = set(sym.list_arguments())
+        for n in input_names:
+            if n not in arg_names:
+                raise KeyError(
+                    f"declared input {n!r} is not an argument of the symbol "
+                    f"(arguments: {sorted(arg_names)})")
         arg_params, aux_params = load_param_bytes(param_bytes)
         self._input_names = list(input_names)
         self._input_shapes = {n: tuple(int(d) for d in s)

@@ -1,0 +1,52 @@
+// C++ client of the mxtpu-cpp header binding (native/mxtpu_cpp.hpp) —
+// cpp-package usage-pattern parity: RAII predictor, exceptions, std::vector IO.
+// Usage: cpp_demo <symbol.json> <file.params> <input_name> <d0,d1,...>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+#include "mxtpu_cpp.hpp"
+
+static std::string slurp(const char* path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    std::fprintf(stderr, "usage: %s symbol.json file.params input d0,d1,...\n",
+                 argv[0]);
+    return 2;
+  }
+  std::vector<uint32_t> shape;
+  uint32_t numel = 1;
+  for (char* tok = std::strtok(argv[4], ","); tok;
+       tok = std::strtok(nullptr, ",")) {
+    shape.push_back(static_cast<uint32_t>(std::atoi(tok)));
+    numel *= shape.back();
+  }
+  try {
+    mxtpu::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                          {{argv[3], shape}});
+    std::vector<float> in(numel);
+    for (uint32_t i = 0; i < numel; ++i)
+      in[i] = 0.01f * static_cast<float>(i % 100) - 0.5f;
+    pred.set_input(argv[3], in);
+    pred.forward();
+    auto oshape = pred.output_shape(0);
+    auto out = pred.get_output(0);
+    double checksum = 0.0;
+    for (float v : out) checksum += v;
+    std::printf("{\"ok\":1,\"num_outputs\":%u,\"shape\":[", pred.num_outputs());
+    for (size_t i = 0; i < oshape.size(); ++i)
+      std::printf("%s%u", i ? "," : "", oshape[i]);
+    std::printf("],\"checksum\":%.6f}\n", checksum);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

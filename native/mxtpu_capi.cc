@@ -122,6 +122,10 @@ extern "C" {
 const char* MXGetLastError() { return g_last_error.c_str(); }
 
 int MXCAPIGetVersion(int* out) {
+  if (out == nullptr) {
+    g_last_error = "MXCAPIGetVersion: null argument";
+    return -1;
+  }
   *out = MXTPU_CAPI_ABI_VERSION;
   return 0;
 }

@@ -1,0 +1,132 @@
+// mxtpu-cpp — header-only C++ binding over the mxtpu C ABI.
+//
+// Reference parity: cpp-package/include/mxnet-cpp (27 headers wrapping the C
+// API in RAII classes; SURVEY §2.6). The TPU-native framework's stable ABI is
+// predict-scoped (native/mxtpu_capi.cc), so this binding wraps that surface:
+// a `mxtpu::Predictor` that loads a symbol-JSON + params checkpoint and runs
+// inference with exception-based error handling and std::vector buffers.
+// It demonstrates the bindings capability — any further language (JVM/R/...)
+// binds the same flat C functions.
+//
+// Usage:
+//   mxtpu::Predictor pred(symbol_json_string, param_blob,
+//                         {{"data", {8, 3, 224, 224}}});
+//   pred.set_input("data", my_floats);
+//   pred.forward();
+//   std::vector<float> probs = pred.get_output(0);
+//
+// Link against libmxtpu_capi.so; the library bootstraps the embedded CPython
+// interpreter on first use (set PYTHONPATH to the mxtpu repo).
+
+#ifndef MXTPU_CPP_HPP_
+#define MXTPU_CPP_HPP_
+
+#include <cstdint>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+typedef void* PredictorHandle;
+const char* MXGetLastError();
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id, uint32_t num_input,
+                 const char** input_keys, const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out);
+int MXPredGetNumOutputs(PredictorHandle h, uint32_t* out);
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim);
+int MXPredSetInput(PredictorHandle h, const char* key, const float* data,
+                   uint32_t size);
+int MXPredForward(PredictorHandle h);
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle h);
+}
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& where)
+      : std::runtime_error(where + ": " + MXGetLastError()) {}
+};
+
+class Predictor {
+ public:
+  using NamedShape = std::pair<std::string, std::vector<uint32_t>>;
+
+  // dev_type: 1 = cpu, 2 = accelerator (TPU), matching the C ABI enum.
+  Predictor(const std::string& symbol_json, const std::string& param_bytes,
+            const std::vector<NamedShape>& inputs, int dev_type = 1,
+            int dev_id = 0) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<uint32_t> dims;
+    for (const auto& kv : inputs) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(dims.size()));
+    }
+    if (MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                     static_cast<int>(param_bytes.size()), dev_type, dev_id,
+                     static_cast<uint32_t>(keys.size()), keys.data(),
+                     indptr.data(), dims.empty() ? nullptr : dims.data(),
+                     &handle_) != 0)
+      throw Error("MXPredCreate");
+  }
+
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+
+  ~Predictor() {
+    if (handle_ != nullptr) MXPredFree(handle_);
+  }
+
+  void set_input(const std::string& key, const std::vector<float>& data) {
+    if (MXPredSetInput(handle_, key.c_str(), data.data(),
+                       static_cast<uint32_t>(data.size())) != 0)
+      throw Error("MXPredSetInput");
+  }
+
+  void forward() {
+    if (MXPredForward(handle_) != 0) throw Error("MXPredForward");
+  }
+
+  uint32_t num_outputs() const {
+    uint32_t n = 0;
+    if (MXPredGetNumOutputs(handle_, &n) != 0)
+      throw Error("MXPredGetNumOutputs");
+    return n;
+  }
+
+  std::vector<uint32_t> output_shape(uint32_t index) const {
+    uint32_t* data = nullptr;
+    uint32_t ndim = 0;
+    if (MXPredGetOutputShape(handle_, index, &data, &ndim) != 0)
+      throw Error("MXPredGetOutputShape");
+    return std::vector<uint32_t>(data, data + ndim);
+  }
+
+  std::vector<float> get_output(uint32_t index) const {
+    auto shape = output_shape(index);
+    uint32_t size = std::accumulate(shape.begin(), shape.end(), 1u,
+                                    [](uint32_t a, uint32_t b) { return a * b; });
+    std::vector<float> out(size);
+    if (MXPredGetOutput(handle_, index, out.data(), size) != 0)
+      throw Error("MXPredGetOutput");
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_HPP_

@@ -1,0 +1,121 @@
+// ThreadSanitizer stress harness for the native IO library.
+//
+// The reference ships no sanitizer integration (SURVEY §5: correctness is "by
+// construction" plus threaded_engine_test.cc); this framework does better by
+// compiling its host-side C++ hot loops WITH -fsanitize=thread and hammering
+// them from concurrent callers — the way the Python layer actually uses them
+// (ImageIter's decode pool calls jpeg_decode/nhwc_u8_to_nchw_f32 from many
+// threads while a prefetch thread runs rio_read_batch).
+//
+// Built by tests/test_native_io.py as
+//   g++ -fsanitize=thread -O1 -g tsan_stress.cc mxtpu_io.cc \
+//       -DMXTPU_HAVE_JPEG -ljpeg -o tsan_stress
+// and run as a subprocess; any data race makes TSAN print "WARNING:
+// ThreadSanitizer" and exit(66) via the halt_on_error runtime flag the test
+// sets. Exit 0 == race-free under this workload.
+//
+// Usage: tsan_stress <file.rec>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int64_t rio_index(const char* path, int64_t* offsets, int64_t* sizes,
+                  int64_t max_records);
+int rio_read_batch(const char* path, const int64_t* offsets,
+                   const int64_t* sizes, const int64_t* out_offsets,
+                   int64_t n, char* out, int num_threads);
+void nhwc_u8_to_nchw_f32(const uint8_t* in, float* out, const float* mean,
+                         const float* std_, int64_t n, int64_t h, int64_t w,
+                         int64_t c, int scale255, int num_threads);
+#ifdef MXTPU_HAVE_JPEG
+int jpeg_dims(const uint8_t* buf, int64_t size, int64_t* h, int64_t* w,
+              int64_t* c);
+int jpeg_decode(const uint8_t* buf, int64_t size, uint8_t* out,
+                int64_t out_size);
+#endif
+}
+
+// recordio.pack layout: 24-byte IRHeader ("IfQQ") then the image bytes
+constexpr int64_t kIRHeaderSize = 24;
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s file.rec\n", argv[0]);
+    return 2;
+  }
+  const char* path = argv[1];
+
+  std::vector<int64_t> offsets(4096), sizes(4096);
+  int64_t n = rio_index(path, offsets.data(), sizes.data(), 4096);
+  if (n <= 0) {
+    std::fprintf(stderr, "rio_index failed: %lld\n",
+                 static_cast<long long>(n));
+    return 2;
+  }
+
+  std::vector<int64_t> out_offsets(n);
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    out_offsets[i] = total;
+    total += sizes[i];
+  }
+
+  // Concurrent callers, each also asking for an internal thread pool — the
+  // worst nesting the Python layer produces.
+  constexpr int kCallers = 4;
+  constexpr int kIters = 8;
+  std::vector<std::thread> callers;
+  for (int t = 0; t < kCallers; ++t) {
+    callers.emplace_back([&, t] {
+      std::vector<char> buf(total);
+      const int64_t N = 8, H = 24, W = 24, C = 3;
+      std::vector<uint8_t> img(N * H * W * C);
+      std::vector<float> outf(N * C * H * W);
+      float mean[3] = {123.f, 116.f, 103.f};
+      float stdv[3] = {58.f, 57.f, 57.f};
+      for (int it = 0; it < kIters; ++it) {
+        if (rio_read_batch(path, offsets.data(), sizes.data(),
+                           out_offsets.data(), n, buf.data(), 3) != 0) {
+          std::fprintf(stderr, "caller %d: rio_read_batch failed\n", t);
+          std::exit(2);
+        }
+#ifdef MXTPU_HAVE_JPEG
+        // the likeliest race site: concurrent libjpeg decodes of the record
+        // payloads (ImageIter's decode pool does exactly this)
+        std::vector<uint8_t> pix;
+        for (int64_t i = 0; i < n; ++i) {
+          const uint8_t* payload = reinterpret_cast<const uint8_t*>(
+              buf.data() + out_offsets[i]);
+          const uint8_t* jpg = payload + kIRHeaderSize;
+          int64_t jlen = sizes[i] - kIRHeaderSize;
+          int64_t jh = 0, jw = 0, jc = 0;
+          if (jpeg_dims(jpg, jlen, &jh, &jw, &jc) != 0) {
+            std::fprintf(stderr, "caller %d: jpeg_dims failed on rec %lld\n",
+                         t, static_cast<long long>(i));
+            std::exit(2);
+          }
+          pix.resize(jh * jw * 3);
+          if (jpeg_decode(jpg, jlen, pix.data(), pix.size()) != 0) {
+            std::fprintf(stderr, "caller %d: jpeg_decode failed on rec %lld\n",
+                         t, static_cast<long long>(i));
+            std::exit(2);
+          }
+        }
+#endif
+        for (size_t i = 0; i < img.size(); ++i)
+          img[i] = static_cast<uint8_t>((i * 31 + it + t) & 0xff);
+        nhwc_u8_to_nchw_f32(img.data(), outf.data(), mean, stdv, N, H, W, C,
+                            /*scale255=*/0, /*num_threads=*/3);
+      }
+    });
+  }
+  for (auto& th : callers) th.join();
+  std::printf("tsan_stress: ok (%lld records, %d callers x %d iters)\n",
+              static_cast<long long>(n), kCallers, kIters);
+  return 0;
+}

@@ -133,3 +133,44 @@ def test_pure_c_client(tmp_path):
     # reassociation can differ slightly from the in-process oracle
     assert abs(payload["checksum"] - want.sum()) < 1e-3
     assert abs(payload["first"] - want.flat[0]) < 1e-3
+
+
+def test_cpp_header_binding(tmp_path):
+    """Compile native/cpp_demo.cc against the mxtpu-cpp RAII header
+    (cpp-package parity, SURVEY §2.6) and run it — C++ host, no Python."""
+    prefix, in_shape, oracle = _make_checkpoint(tmp_path)
+
+    demo_src = os.path.join(REPO, "native", "cpp_demo.cc")
+    demo_bin = str(tmp_path / "cpp_demo")
+    libdir = os.path.dirname(capi.lib_path())
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", f"-I{libdir}", demo_src,
+             "-o", demo_bin, f"-L{libdir}", "-lmxtpu_capi",
+             f"-Wl,-rpath,{libdir}"],
+            check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"cannot compile C++ demo: {e}")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [demo_bin, f"{prefix}-symbol.json", f"{prefix}-0000.params", "data",
+         ",".join(str(d) for d in in_shape)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, f"cpp demo failed: {r.stderr[-2000:]}"
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["ok"] == 1 and payload["num_outputs"] == 1
+    assert payload["shape"] == [in_shape[0], 3]
+    numel = int(np.prod(in_shape))
+    x = (0.01 * (np.arange(numel) % 100) - 0.5).astype(np.float32)
+    want = oracle(x.reshape(in_shape))
+    assert abs(payload["checksum"] - want.sum()) < 1e-3
+
+    # error path surfaces through the C++ exception with the C-side message
+    r2 = subprocess.run(
+        [demo_bin, f"{prefix}-symbol.json", f"{prefix}-0000.params",
+         "wrong_input", ",".join(str(d) for d in in_shape)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r2.returncode == 1 and "not an argument of the symbol" in r2.stderr

@@ -1,0 +1,77 @@
+"""mx.rtc parity — runtime-compiled Pallas kernels (mxtpu/rtc.py).
+
+Reference capability: python/mxnet/rtc.py CudaModule/CudaKernel (NVRTC inline
+CUDA). Here the inline-device-code escape hatch is Pallas source compiled at
+runtime; on the CPU test backend kernels run in interpret mode.
+"""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, rtc
+
+SAXPY_SRC = """
+def saxpy(a_ref, x_ref, y_ref, out_ref):
+    out_ref[...] = a_ref[0] * x_ref[...] + y_ref[...]
+
+def scale2(x_ref, out_ref):
+    out_ref[...] = x_ref[...] * 2.0
+"""
+
+
+def test_saxpy_kernel_matches_numpy():
+    mod = rtc.PallasModule(SAXPY_SRC, exports=["saxpy", "scale2"])
+    k = mod.get_kernel("saxpy")
+    rs = np.random.RandomState(0)
+    a = np.float32(2.5)
+    x = rs.randn(16, 128).astype(np.float32)
+    y = rs.randn(16, 128).astype(np.float32)
+    out = k.launch([nd.array(np.array([a])), nd.array(x), nd.array(y)],
+                   out_shapes=((16, 128), np.float32))
+    np.testing.assert_allclose(out.asnumpy(), a * x + y, rtol=1e-6, atol=1e-6)
+
+
+def test_gridded_kernel():
+    """A gridded launch: each program instance handles one 8x128 tile."""
+    from jax.experimental import pallas as pl
+
+    src = """
+def tile_double(x_ref, out_ref):
+    out_ref[...] = x_ref[...] + x_ref[...]
+"""
+    mod = rtc.PallasModule(src)
+    k = mod.get_kernel("tile_double")
+    x = np.arange(32 * 128, dtype=np.float32).reshape(32, 128)
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    out = k.launch([nd.array(x)], out_shapes=((32, 128), np.float32),
+                   grid=(4,), in_specs=[spec], out_specs=spec)
+    np.testing.assert_allclose(out.asnumpy(), 2 * x)
+
+
+def test_cudamodule_alias_and_exports():
+    assert rtc.CudaModule is rtc.PallasModule
+    mod = rtc.PallasModule(SAXPY_SRC, exports=["saxpy"])
+    with pytest.raises(ValueError, match="not in exports"):
+        mod.get_kernel("scale2")
+    with pytest.raises(ValueError, match="no kernel function"):
+        rtc.PallasModule("x = 1").get_kernel("x")  # not callable
+    # mx.rtc namespace parity
+    assert mx.rtc.PallasModule is rtc.PallasModule
+
+
+def test_kernel_composes_with_jit_and_grad():
+    """Inline kernels are ordinary jax computations: they work under jit and
+    (forward-mode of the wrapped fn) inside a traced graph."""
+    import jax
+    import jax.numpy as jnp
+
+    mod = rtc.PallasModule(SAXPY_SRC)
+    k = mod.get_kernel("scale2")
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(k.launch([x], out_shapes=(x.shape, x.dtype)).data)
+
+    x = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
+    assert float(f(x)) == pytest.approx(float(2 * x.sum()))
