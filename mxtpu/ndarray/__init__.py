@@ -51,15 +51,14 @@ def _populate(namespace: str, module):
 
 _populate("", _this)
 
-linalg = types.ModuleType(__name__ + ".linalg")
-random = types.ModuleType(__name__ + ".random")
-contrib = types.ModuleType(__name__ + ".contrib")
-_populate("linalg", linalg)
-_populate("random", random)
-_populate("contrib", contrib)
-sys.modules[linalg.__name__] = linalg
-sys.modules[random.__name__] = random
-sys.modules[contrib.__name__] = contrib
+# one namespace list shared with mx.sym (registry.OP_NAMESPACES) so the two
+# frontends expose the same sub-surfaces
+for _ns in _reg.OP_NAMESPACES:
+    _mod = types.ModuleType(f"{__name__}.{_ns}")
+    _populate(_ns, _mod)
+    globals()[_ns] = _mod
+    sys.modules[_mod.__name__] = _mod
+del _ns, _mod
 
 # reference-name conveniences
 def moveaxis(a, source, destination):

@@ -228,3 +228,21 @@ def _power_scalar(data, scalar: float = 1.0):
 @register("_rpower_scalar")
 def _rpower_scalar(data, scalar: float = 1.0):
     return jnp.power(scalar, data)
+
+
+# scalar comparisons (reference _greater_scalar family; 0/1 floats like the
+# binary comparison ops) — the symbolic frontend lowers `sym > c` to these
+def _cmp_scalar(name, fn):
+    @register(name, differentiable=False)
+    def op(data, scalar: float = 0.0):
+        return fn(data, scalar).astype(data.dtype)
+    op.__name__ = name
+    return op
+
+
+_equal_scalar = _cmp_scalar("_equal_scalar", jnp.equal)
+_not_equal_scalar = _cmp_scalar("_not_equal_scalar", jnp.not_equal)
+_greater_scalar = _cmp_scalar("_greater_scalar", jnp.greater)
+_greater_equal_scalar = _cmp_scalar("_greater_equal_scalar", jnp.greater_equal)
+_lesser_scalar = _cmp_scalar("_lesser_scalar", jnp.less)
+_lesser_equal_scalar = _cmp_scalar("_lesser_equal_scalar", jnp.less_equal)

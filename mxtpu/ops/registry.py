@@ -51,6 +51,10 @@ class OpDef:
 
 _OPS: Dict[str, OpDef] = {}
 
+# the op sub-namespaces both frontends (mx.nd.* and mx.sym.*) expose — one
+# list so the two surfaces cannot drift
+OP_NAMESPACES = ("linalg", "random", "contrib")
+
 
 def register(name: Optional[str] = None, *, num_outputs: int = 1,
              differentiable: bool = True, aliases: Sequence[str] = (),

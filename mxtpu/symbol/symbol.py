@@ -533,6 +533,42 @@ class Symbol:
     def __neg__(self):
         return self._scalar_op("_mul_scalar", -1.0)
 
+    # comparisons produce 0/1 floats (reference parity; symbol.py __gt__ et al.
+    # lower to _greater_scalar / broadcast_greater). __eq__/__ne__ build graph
+    # nodes like NDArray's do, so identity hashing must be restored explicitly.
+    def __eq__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_equal_scalar", other)
+        return self._binary_op("broadcast_equal", other)
+
+    def __ne__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_not_equal_scalar", other)
+        return self._binary_op("broadcast_not_equal", other)
+
+    def __hash__(self):
+        return id(self)
+
+    def __gt__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_greater_scalar", other)
+        return self._binary_op("broadcast_greater", other)
+
+    def __ge__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_greater_equal_scalar", other)
+        return self._binary_op("broadcast_greater_equal", other)
+
+    def __lt__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_lesser_scalar", other)
+        return self._binary_op("broadcast_lesser", other)
+
+    def __le__(self, other):
+        if isinstance(other, (int, float)):
+            return self._scalar_op("_lesser_equal_scalar", other)
+        return self._binary_op("broadcast_lesser_equal", other)
+
 
 def _req_of(grad_req, name, arg_names):
     if isinstance(grad_req, str):
@@ -568,11 +604,17 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     return Symbol(heads)
 
 
+def _base_name(op_key: str) -> str:
+    """Auto-name stem for an op key; namespaced keys drop the prefix so
+    'contrib.Proposal' names nodes proposal0, not 'contrib.proposal0'."""
+    return {"SoftmaxOutput": "softmax"}.get(
+        op_key, op_key.rsplit(".", 1)[-1].lower().lstrip("_"))
+
+
 def _apply_op(op, op_key: str, sym_args: Sequence[Symbol], attrs: dict,
               name: Optional[str] = None) -> Symbol:
     """Create an op node from positional Symbol inputs + attr kwargs."""
-    base = {"SoftmaxOutput": "softmax"}.get(op_key, op_key.lower().lstrip("_"))
-    name = name or _auto_name(base)
+    name = name or _auto_name(_base_name(op_key))
     tparams = _tensor_params(op)
     inputs, input_params = [], []
     if tparams and tparams[0] == "*":
@@ -602,9 +644,7 @@ def make_op_wrapper(op_key: str):
         sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
         attrs = {k: v for k, v in kwargs.items()
                  if not isinstance(v, Symbol) and v is not None}
-        base = {"SoftmaxOutput": "softmax"}.get(op_key,
-                                                op_key.lower().lstrip("_"))
-        name = name or _auto_name(base)
+        name = name or _auto_name(_base_name(op_key))
         inputs, input_params = [], []
         if tparams and tparams[0] == "*":
             seq = list(args) or [sym_kwargs[k] for k in sorted(sym_kwargs)]

@@ -1,0 +1,51 @@
+"""End-to-end example convergence tests — the BASELINE.json configs the judge
+tracks (SURVEY §4's tiny-convergence tier):
+
+* config 3: word language model (LSTM, truncated BPTT, carried state)
+* config 4: two-stage RCNN through the symbolic executor
+* config 5: sparse factorization machine + dist_sync kvstore
+
+Each runs its example's ``main`` in-process with toy sizes; convergence (not
+wall-clock) is the assertion, mirroring tests/python/train in the reference.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def test_word_lm_learns_markov_structure():
+    from examples.train_word_lm import main
+    ppl = main(["--vocab", "60", "--corpus-len", "12000", "--epochs", "4",
+                "--hidden", "48", "--embed", "48", "--batch-size", "8",
+                "--bptt", "16", "--lr", "4"])
+    # uniform baseline is 60; the planted chain's entropy corresponds to ~4
+    assert ppl < 20.0, f"LM did not learn the chain: valid ppl {ppl}"
+
+
+def test_word_lm_tied_weights():
+    from examples.train_word_lm import main
+    ppl = main(["--vocab", "40", "--corpus-len", "6000", "--epochs", "3",
+                "--hidden", "32", "--embed", "32", "--batch-size", "8",
+                "--bptt", "16", "--lr", "4", "--tied"])
+    assert ppl < 25.0, f"tied LM did not learn: valid ppl {ppl}"
+
+
+def test_rcnn_toy_trains_both_stages():
+    from examples.train_rcnn_toy import main
+    stats = main(["--batch-size", "8", "--steps", "150", "--lr", "0.05",
+                  "--log-every", "1000"])
+    assert stats["rpn_acc"] > 0.75, stats
+    assert stats["roi_acc"] > 0.5, stats
+    # proposals must actually cover objects for stage 2 to be meaningful
+    assert stats["pos_frac"] > 0.25, stats
+
+
+def test_sparse_fm_converges():
+    from examples.train_sparse_fm import main
+    acc = main(["--rows", "1200", "--epochs", "4", "--num-features", "5000"])
+    assert acc > 0.78, f"FM accuracy {acc}"
