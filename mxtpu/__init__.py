@@ -43,7 +43,7 @@ import importlib as _importlib
 _SUBSYSTEMS = ["initializer", "optimizer", "lr_scheduler", "metric", "callback",
                "io", "recordio", "kvstore", "symbol", "gluon", "module", "parallel",
                "profiler", "test_utils", "model", "image", "visualization",
-               "contrib", "operator", "monitor", "rtc", "capi"]
+               "contrib", "operator", "monitor", "rtc", "capi", "rnn"]
 for _name in _SUBSYSTEMS:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)

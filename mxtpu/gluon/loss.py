@@ -87,17 +87,24 @@ class SoftmaxCrossEntropyLoss(Loss):
 
     def __init__(self, axis: int = -1, sparse_label: bool = True,
                  from_logits: bool = False, weight: Optional[float] = None,
-                 batch_axis: int = 0, **kwargs):
+                 batch_axis: int = 0, ignore_label=None, **kwargs):
+        """``ignore_label`` (extension beyond the reference gluon loss, matching
+        the symbolic ``SoftmaxOutput(use_ignore=True)`` capability): sparse
+        label positions equal to it contribute zero loss and zero gradient —
+        the masking contract bucketed/padded pipelines need."""
         super().__init__(weight, batch_axis, **kwargs)
         self._axis = axis
         self._sparse = sparse_label
         self._from_logits = from_logits
+        self._ignore_label = ignore_label
 
     def forward(self, pred, label, sample_weight=None):
         if not self._from_logits:
             pred = nd.log_softmax(pred, axis=self._axis)
         if self._sparse:
             loss = -nd.pick(pred, label, axis=self._axis, keepdims=False)
+            if self._ignore_label is not None:
+                loss = loss * (label != float(self._ignore_label))
         else:
             label = _reshape_like(pred, label)
             loss = -nd.sum(pred * label, axis=self._axis)

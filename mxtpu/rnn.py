@@ -1,0 +1,131 @@
+"""``mx.rnn`` legacy namespace — bucketing data iterator + cell re-exports.
+
+Reference: ``python/mxnet/rnn/`` (legacy RNN cells shared with gluon, plus
+``BucketSentenceIter`` in rnn/io.py — the variable-length batching front end
+that feeds ``BucketingModule``). On TPU, bucketing is also the recompilation
+policy: one XLA program per bucket shape, cached by the CachedOp signature
+(docs/faq/bucketing.md capability, SURVEY §5 long-context requirement).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .gluon.rnn.rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
+                                 LSTMCell, ModifierCell, RecurrentCell,
+                                 ResidualCell, RNNCell, SequentialRNNCell,
+                                 ZoneoutCell)
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["BucketSentenceIter", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ModifierCell", "ResidualCell", "ZoneoutCell", "RecurrentCell"]
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed iterator over tokenized sentences (rnn/io.py:BucketSentenceIter).
+
+    Sentences (lists of int ids) are assigned to the smallest bucket that fits,
+    padded with ``invalid_label``; each batch comes from ONE bucket and carries
+    its ``bucket_key`` so ``BucketingModule`` selects the matching compiled
+    program. Labels are the next-token shift of the data; pad positions hold
+    ``invalid_label``, which the loss must mask — pair with
+    ``SoftmaxCrossEntropyLoss(ignore_label=invalid_label)`` (the gluon-side
+    equivalent of the reference's ``SoftmaxOutput(use_ignore=True)``).
+    """
+
+    def __init__(self, sentences: Sequence[Sequence[int]], batch_size: int,
+                 buckets: Optional[List[int]] = None, invalid_label: int = -1,
+                 data_name: str = "data", label_name: str = "softmax_label",
+                 dtype: str = "float32", layout: str = "NT", shuffle: bool = False):
+        super().__init__(batch_size)
+        if buckets is None:
+            # reference default (rnn/io.py): keep only lengths with at least
+            # batch_size sentences as bucket boundaries — rarer lengths are
+            # absorbed into the next larger bucket instead of yielding zero
+            # batches; the max length is always a boundary so nothing long is
+            # silently dropped
+            counts: dict = {}
+            for s in sentences:
+                if len(s) >= 2:
+                    counts[len(s)] = counts.get(len(s), 0) + 1
+            buckets = sorted(l for l, c in counts.items() if c >= batch_size)
+            if counts and (not buckets or buckets[-1] < max(counts)):
+                buckets.append(max(counts))
+        self.buckets = sorted(buckets)
+        if not self.buckets:
+            raise ValueError(
+                "BucketSentenceIter: no usable buckets — every sentence is "
+                "shorter than 2 tokens or the bucket list is empty")
+        self.data_name, self.label_name = data_name, label_name
+        self.invalid_label = invalid_label
+        self.dtype = dtype
+        if layout != "NT":
+            raise ValueError("layout NT (batch, time) is the supported layout")
+        self._shuffle = shuffle
+
+        self.data: List[List[np.ndarray]] = [[] for _ in self.buckets]
+        ndiscard = 0
+        for s in sentences:
+            if len(s) < 2:
+                ndiscard += 1
+                continue
+            bkt = next((i for i, b in enumerate(self.buckets) if b >= len(s)),
+                       None)
+            if bkt is None:
+                ndiscard += 1
+                continue
+            row = np.full(self.buckets[bkt], invalid_label, np.int64)
+            row[:len(s)] = s
+            self.data[bkt].append(row)
+        self.ndiscard = ndiscard
+        self.default_bucket_key = max(self.buckets)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size, self.default_bucket_key),
+                         self.dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.default_bucket_key),
+                         self.dtype)]
+
+    def reset(self):
+        self._plan = []                       # (bucket_idx, start) per batch
+        for i, rows in enumerate(self.data):
+            if self._shuffle:
+                # fresh permutation each epoch, like NDArrayIter's np.random use
+                np.random.shuffle(rows)
+            for start in range(0, len(rows) - self.batch_size + 1,
+                               self.batch_size):
+                self._plan.append((i, start))
+        if self._shuffle:
+            np.random.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self) -> DataBatch:
+        from . import ndarray as nd
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        bkt, start = self._plan[self._cursor]
+        self._cursor += 1
+        rows = np.stack(self.data[bkt][start:start + self.batch_size])
+        # next-token labels; the pad slot after sentence end holds invalid_label
+        labels = np.full_like(rows, self.invalid_label)
+        labels[:, :-1] = rows[:, 1:]
+        key = self.buckets[bkt]
+        dt = np.dtype(self.dtype)
+        return DataBatch(
+            data=[nd.array(rows.astype(dt))],
+            label=[nd.array(labels.astype(dt))],
+            bucket_key=key,
+            provide_data=[DataDesc(self.data_name, (self.batch_size, key),
+                                   self.dtype)],
+            provide_label=[DataDesc(self.label_name, (self.batch_size, key),
+                                    self.dtype)])
