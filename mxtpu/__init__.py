@@ -43,7 +43,8 @@ import importlib as _importlib
 _SUBSYSTEMS = ["initializer", "optimizer", "lr_scheduler", "metric", "callback",
                "io", "recordio", "kvstore", "symbol", "gluon", "module", "parallel",
                "profiler", "test_utils", "model", "image", "visualization",
-               "contrib", "operator", "monitor", "rtc", "capi", "rnn"]
+               "contrib", "operator", "monitor", "rtc", "capi", "rnn",
+               "attribute", "engine"]
 for _name in _SUBSYSTEMS:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)
@@ -62,3 +63,5 @@ if "module" in globals():
 if "model" in globals():
     save_checkpoint = model.save_checkpoint
     load_checkpoint = model.load_checkpoint
+if "attribute" in globals():
+    AttrScope = attribute.AttrScope

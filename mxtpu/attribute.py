@@ -1,0 +1,68 @@
+"""AttrScope — ambient attributes for symbol construction (``mx.AttrScope``,
+python/mxnet/attribute.py parity).
+
+The reference's flagship use is ``ctx_group`` model-parallel placement:
+
+    with mx.AttrScope(ctx_group="dev1"):
+        h = mx.sym.FullyConnected(...)
+
+and bind-time ``group2ctx`` maps groups to devices (graph_executor.cc:408
+PlaceDevice inserting _CrossDeviceCopy). On TPU the placement capability maps
+to sharding: annotate parameters via ``DataParallelTrainer(param_shardings=…)``
+and GSPMD places the compute — there is no cross-device copy node to insert.
+AttrScope itself is kept at full fidelity: scoped attrs are merged into every
+node created inside the scope (user attrs use the reference's ``__name__``
+mangling, so they serialize with the graph, round-trip through JSON, and are
+visible to ``Symbol.attr``/``attr_dict`` — e.g. for a sharding policy keyed on
+``__ctx_group__``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["AttrScope", "current"]
+
+_state = threading.local()
+
+
+class AttrScope:
+    """Context manager attaching attributes to symbols created in scope.
+
+    Attribute values must be strings (reference attribute.py:40 enforces this
+    so graphs serialize portably). Names are mangled to ``__name__`` like the
+    reference's AttrScope.get, keeping user attrs disjoint from op config.
+    """
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            if not isinstance(v, str):
+                raise ValueError(
+                    f"AttrScope value for {k!r} must be a string, got "
+                    f"{type(v).__name__}")
+        self._attrs = {f"__{k}__": v for k, v in kwargs.items()}
+        self._prev: Optional[Dict[str, str]] = None
+
+    def get(self, attr: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        """Scope attrs merged under explicitly-given ones (explicit wins)."""
+        merged = dict(self._attrs)
+        if attr:
+            merged.update(attr)
+        return merged
+
+    def __enter__(self) -> "AttrScope":
+        self._prev = getattr(_state, "scope_attrs", None)
+        merged = dict(self._prev or {})
+        merged.update(self._attrs)
+        _state.scope_attrs = merged
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _state.scope_attrs = self._prev
+        self._prev = None
+
+
+def current() -> Dict[str, str]:
+    """The ambient attr dict new symbol nodes inherit ({} outside any scope)."""
+    return getattr(_state, "scope_attrs", None) or {}

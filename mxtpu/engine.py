@@ -1,0 +1,41 @@
+"""``mx.engine`` — bulk-execution control (python/mxnet/engine.py parity).
+
+The reference's engine batches consecutive async ops into one engine op to cut
+per-op dispatch overhead (op bulking, threaded_engine.h:404 BulkAppend/
+BulkFlush, env ``MXNET_ENGINE_BULK_SIZE``). On TPU that concern is owned by
+XLA: everything inside a ``jit``/``hybridize`` trace compiles into ONE fused
+program, which is bulking taken to its limit — so these context managers keep
+the reference API shape while documenting where the behavior went. They still
+carry real information: the bulk size is recorded and queryable, and
+``bulk(0)``/``set_bulk_size(0)`` is honored by running eagerly (no-op here,
+since eager dispatch is already per-op).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = 0
+
+
+def set_bulk_size(size: int) -> int:
+    """Set the bulk-execution budget; returns the previous value
+    (engine.py set_bulk_size parity). Informational on TPU: fusion happens at
+    jit boundaries, not dispatch time."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextmanager
+def bulk(size: int):
+    """``with mx.engine.bulk(n):`` scope (engine.py bulk parity). Under XLA the
+    equivalent lever is hybridizing the enclosing block so the scope becomes
+    one compiled program."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

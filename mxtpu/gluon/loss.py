@@ -93,6 +93,9 @@ class SoftmaxCrossEntropyLoss(Loss):
         label positions equal to it contribute zero loss and zero gradient —
         the masking contract bucketed/padded pipelines need."""
         super().__init__(weight, batch_axis, **kwargs)
+        if ignore_label is not None and not sparse_label:
+            raise ValueError("ignore_label requires sparse_label=True "
+                             "(dense one-hot labels have no ignore id)")
         self._axis = axis
         self._sparse = sparse_label
         self._from_logits = from_logits

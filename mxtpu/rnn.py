@@ -13,6 +13,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from . import ndarray as nd
 from .gluon.rnn.rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
                                  LSTMCell, ModifierCell, RecurrentCell,
                                  ResidualCell, RNNCell, SequentialRNNCell,
@@ -110,7 +111,6 @@ class BucketSentenceIter(DataIter):
         self._cursor = 0
 
     def next(self) -> DataBatch:
-        from . import ndarray as nd
         if self._cursor >= len(self._plan):
             raise StopIteration
         bkt, start = self._plan[self._cursor]

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..attribute import current as _scope_attrs
 from ..base import dtype_np, dtype_name
 from ..ops import registry as _reg
 
@@ -585,7 +586,8 @@ def _req_of(grad_req, name, arg_names):
 
 def Variable(name: str, attr=None, shape=None, dtype=None, init=None,
              stype=None, **kwargs) -> Symbol:
-    attrs = dict(attr or {})
+    attrs = dict(_scope_attrs())
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
@@ -669,7 +671,10 @@ def make_op_wrapper(op_key: str):
                 input_params.append(pname)
         n_out = op.num_outputs if op.num_outputs > 0 else \
             int(attrs.get("num_outputs", 1))
-        node = _Node(op_key, name, dict(attr or {}, **attrs), inputs,
+        node_attrs = dict(_scope_attrs())
+        node_attrs.update(attr or {})
+        node_attrs.update(attrs)
+        node = _Node(op_key, name, node_attrs, inputs,
                      input_params, num_outputs=n_out)
         if n_out == 1:
             return Symbol([(node, 0)])

@@ -1,0 +1,52 @@
+"""AttrScope (mx.AttrScope, attribute.py parity) and mx.engine bulk shims."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import engine, nd
+from mxtpu import symbol as sym
+from mxtpu.attribute import AttrScope
+
+
+def test_attr_scope_attaches_and_serializes():
+    with AttrScope(ctx_group="dev1", stage="encoder"):
+        a = sym.Variable("a")
+        fc = sym.FullyConnected(a, num_hidden=4, name="fc")
+        with AttrScope(ctx_group="dev2"):      # nesting: inner wins
+            inner = sym.FullyConnected(fc, num_hidden=2, name="inner")
+    outside = sym.FullyConnected(inner, num_hidden=2, name="outside")
+
+    assert a.attr("__ctx_group__") == "dev1"
+    assert fc.attr("__ctx_group__") == "dev1"
+    assert fc.attr("__stage__") == "encoder"
+    assert inner.attr("__ctx_group__") == "dev2"
+    assert inner.attr("__stage__") == "encoder"
+    assert outside.attr("__ctx_group__") is None
+
+    # user attrs ride the JSON round-trip with the graph
+    back = sym.load_json(outside.tojson())
+    groups = {name: attrs.get("__ctx_group__")
+              for name, attrs in back.attr_dict().items()}
+    assert groups.get("fc") == "dev1" and groups.get("inner") == "dev2"
+
+    # scoped attrs must not leak into op kwargs: the graph still evaluates
+    out = outside.eval(a=nd.array(np.ones((2, 3), np.float32)),
+                       **{n: nd.array(np.ones(s, np.float32)) for n, s in
+                          zip(["fc_weight", "fc_bias", "inner_weight",
+                               "inner_bias", "outside_weight", "outside_bias"],
+                              [(4, 3), (4,), (2, 4), (2,), (2, 2), (2,)])})
+    assert out[0].shape == (2, 2)
+
+    # non-string values are rejected (portable serialization contract)
+    with pytest.raises(ValueError, match="must be a string"):
+        AttrScope(ctx_group=3)
+
+
+def test_engine_bulk_shims():
+    assert engine.set_bulk_size(16) == 0
+    assert engine.set_bulk_size(0) == 16
+    with engine.bulk(8):
+        assert engine.set_bulk_size(8) == 8
+    assert engine.set_bulk_size(0) == 0       # restored on exit
+    assert mx.engine is engine
