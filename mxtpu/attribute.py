@@ -66,3 +66,12 @@ class AttrScope:
 def current() -> Dict[str, str]:
     """The ambient attr dict new symbol nodes inherit ({} outside any scope)."""
     return getattr(_state, "scope_attrs", None) or {}
+
+
+def apply(attr: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Ambient scope attrs merged under explicitly-given ones (explicit wins).
+    The single precedence rule every symbol-construction site routes through."""
+    merged = dict(current())
+    if attr:
+        merged.update(attr)
+    return merged

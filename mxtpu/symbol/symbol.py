@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..attribute import current as _scope_attrs
+from ..attribute import apply as _with_scope_attrs
 from ..base import dtype_np, dtype_name
 from ..ops import registry as _reg
 
@@ -586,8 +586,7 @@ def _req_of(grad_req, name, arg_names):
 
 def Variable(name: str, attr=None, shape=None, dtype=None, init=None,
              stype=None, **kwargs) -> Symbol:
-    attrs = dict(_scope_attrs())
-    attrs.update(attr or {})
+    attrs = _with_scope_attrs(attr)
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
@@ -615,7 +614,10 @@ def _base_name(op_key: str) -> str:
 
 def _apply_op(op, op_key: str, sym_args: Sequence[Symbol], attrs: dict,
               name: Optional[str] = None) -> Symbol:
-    """Create an op node from positional Symbol inputs + attr kwargs."""
+    """Create an op node from positional Symbol inputs + attr kwargs.
+    Operator-overload nodes inherit ambient AttrScope attrs like every other
+    frontend-created symbol."""
+    attrs = dict(_with_scope_attrs(None), **attrs)
     name = name or _auto_name(_base_name(op_key))
     tparams = _tensor_params(op)
     inputs, input_params = [], []
@@ -671,9 +673,7 @@ def make_op_wrapper(op_key: str):
                 input_params.append(pname)
         n_out = op.num_outputs if op.num_outputs > 0 else \
             int(attrs.get("num_outputs", 1))
-        node_attrs = dict(_scope_attrs())
-        node_attrs.update(attr or {})
-        node_attrs.update(attrs)
+        node_attrs = dict(_with_scope_attrs(attr), **attrs)
         node = _Node(op_key, name, node_attrs, inputs,
                      input_params, num_outputs=n_out)
         if n_out == 1:

@@ -24,6 +24,13 @@ def test_attr_scope_attaches_and_serializes():
     assert inner.attr("__stage__") == "encoder"
     assert outside.attr("__ctx_group__") is None
 
+    # operator-overload nodes inherit scope attrs too
+    with AttrScope(ctx_group="dev3"):
+        s = a + 1.0
+        c = a > 0.5
+    assert s.attr("__ctx_group__") == "dev3"
+    assert c.attr("__ctx_group__") == "dev3"
+
     # user attrs ride the JSON round-trip with the graph
     back = sym.load_json(outside.tojson())
     groups = {name: attrs.get("__ctx_group__")
