@@ -22,7 +22,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
-__all__ = ["AttrScope", "current"]
+__all__ = ["AttrScope", "apply", "current"]
 
 _state = threading.local()
 
@@ -43,13 +43,6 @@ class AttrScope:
                     f"{type(v).__name__}")
         self._attrs = {f"__{k}__": v for k, v in kwargs.items()}
         self._prev: Optional[Dict[str, str]] = None
-
-    def get(self, attr: Optional[Dict[str, str]] = None) -> Dict[str, str]:
-        """Scope attrs merged under explicitly-given ones (explicit wins)."""
-        merged = dict(self._attrs)
-        if attr:
-            merged.update(attr)
-        return merged
 
     def __enter__(self) -> "AttrScope":
         self._prev = getattr(_state, "scope_attrs", None)

@@ -1,0 +1,156 @@
+"""TensorBoard scalar logging — ``python/mxnet/contrib/tensorboard.py`` parity.
+
+The reference's ``LogMetricsCallback`` forwards metrics to an external
+``tensorboard`` package. This implementation has no dependency: it writes the
+TensorBoard on-disk format directly — TFRecord-framed protobuf ``Event``
+messages with masked CRC32C checksums — so standard TensorBoard can point at
+the logdir. Only the scalar summary family is encoded (the reference callback
+logs exactly that).
+
+Wire format (stable, documented by the TF event-file readers):
+  record  = uint64 len | crc32c_masked(len) | bytes | crc32c_masked(bytes)
+  Event   = 1: wall_time (double), 2: step (int64),
+            3: file_version (string, first record only), 5: Summary
+  Summary = repeated 1: Value;  Value = 1: tag (string), 2: simple_value (float)
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+# ---- CRC32C (Castagnoli), table-driven ------------------------------------
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---- minimal protobuf writers ---------------------------------------------
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _field_double(num: int, v: float) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<d", v)
+
+
+def _field_float(num: int, v: float) -> bytes:
+    return _varint((num << 3) | 5) + struct.pack("<f", v)
+
+
+def _field_varint(num: int, v: int) -> bytes:
+    return _varint(num << 3) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _event(wall_time: float, step: int = 0, file_version: Optional[str] = None,
+           summary: Optional[bytes] = None) -> bytes:
+    out = _field_double(1, wall_time)
+    if step:
+        out += _field_varint(2, step)
+    if file_version is not None:
+        out += _field_bytes(3, file_version.encode())
+    if summary is not None:
+        out += _field_bytes(5, summary)
+    return out
+
+
+def _scalar_summary(tag: str, value: float) -> bytes:
+    v = _field_bytes(1, tag.encode()) + _field_float(2, float(value))
+    return _field_bytes(1, v)
+
+
+class SummaryWriter:
+    """Write scalar events TensorBoard can read; no tensorboard dependency."""
+
+    _seq = 0
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        # pid + in-process counter uniquify concurrent writers on one logdir
+        SummaryWriter._seq += 1
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}.{os.getpid()}.{SummaryWriter._seq}")
+        self.path = os.path.join(logdir, fname)
+        self._f = open(self.path, "wb")
+        self._write(_event(time.time(), file_version="brain.Event:2"))
+
+    def _write(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header + struct.pack("<I", _masked_crc(header)) +
+                      payload + struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag: str, value: float, global_step: int = 0) -> None:
+        self._write(_event(time.time(), global_step,
+                           summary=_scalar_summary(tag, value)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging every metric to TensorBoard
+    (contrib/tensorboard.py LogMetricsCallback parity)."""
+
+    def __init__(self, logging_dir: str, prefix: Optional[str] = None):
+        self.prefix = prefix
+        self._writer = SummaryWriter(logging_dir)
+        self._step = 0
+
+    def __call__(self, param) -> None:
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            tag = f"{self.prefix}-{name}" if self.prefix else name
+            self._writer.add_scalar(tag, value, self._step)
+        self._writer.flush()

@@ -169,11 +169,14 @@ class TorchOp:
         return outs[0] if single else tuple(outs)
 
 
-def register_torch_op(name: str, fn: Callable, namespace: str = "contrib"):
+def register_torch_op(name: str, fn: Callable, namespace: str = "contrib",
+                      num_outputs: int = 1):
     """Register ``fn`` (torch tensors in → tensor(s) out) as a framework op.
 
     After this, ``mx.nd.contrib.<name>`` / ``mx.sym.contrib.<name>`` exist like
     any built-in op (mxnet.torch namespace parity). Returns the TorchOp.
+    Multi-output fns must declare ``num_outputs`` so the symbolic frontend
+    exposes every head (the nd path detects the tuple dynamically).
     """
     from ..ops import registry as _reg
 
@@ -188,7 +191,8 @@ def register_torch_op(name: str, fn: Callable, namespace: str = "contrib"):
 
     op_fn.__name__ = name
     op_fn.__doc__ = f"torch-bridge op {name!r} (plugin/torch parity)"
-    _reg.register(f"{namespace}.{name}" if namespace else name)(op_fn)
+    _reg.register(f"{namespace}.{name}" if namespace else name,
+                  num_outputs=num_outputs)(op_fn)
 
     # surface on the already-built nd/sym namespaces
     from .. import ndarray as nd_pkg

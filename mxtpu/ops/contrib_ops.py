@@ -189,3 +189,10 @@ def _count_sketch(data, h, s, out_dim: int = 0):
 @register("getnnz", namespace=NS, differentiable=False)
 def _getnnz(data, axis=None):
     return jnp.sum((data != 0).astype(jnp.int32), axis=axis)
+
+
+@register("quadratic", namespace=NS)
+def _quadratic(data, a: float = 0.0, b: float = 0.0, c: float = 0.0):
+    """contrib quadratic_op (the reference's custom-op tutorial op,
+    src/operator/contrib/quadratic_op-inl.h): a*x^2 + b*x + c."""
+    return a * data * data + b * data + c

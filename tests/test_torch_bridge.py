@@ -96,12 +96,35 @@ def test_symbolic_compose_and_executor(bridge_op):
 
 
 def test_multi_output_and_unused_grad():
-    def two_heads(x):
+    def two_heads(x, unused):
         return torch.relu(x), x.sum(dim=1)
 
-    op = TorchOp(two_heads, "two_heads")
+    op = register_torch_op("torch_two_heads", two_heads, num_outputs=2)
     rs = np.random.RandomState(4)
     x = rs.randn(3, 5).astype(np.float32)
-    r, s = op(nd.array(x))
+    u = rs.randn(3, 5).astype(np.float32)
+    r, s = op(nd.array(x), nd.array(u))
     np.testing.assert_allclose(np.asarray(r), np.maximum(x, 0), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(s), x.sum(1), rtol=1e-5)
+
+    # symbolic frontend exposes BOTH heads
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    heads = sym.contrib.torch_two_heads(a, b)
+    assert len(heads.list_outputs()) == 2
+    o0, o1 = heads[0].eval(a=nd.array(x), b=nd.array(u))[0], \
+        heads[1].eval(a=nd.array(x), b=nd.array(u))[0]
+    np.testing.assert_allclose(o0.asnumpy(), np.maximum(x, 0), rtol=1e-6)
+    np.testing.assert_allclose(o1.asnumpy(), x.sum(1), rtol=1e-5)
+
+    # unused input's gradient is the documented zero-fill (allow_unused path)
+    xn, un = nd.array(x), nd.array(u)
+    xn.attach_grad()
+    un.attach_grad()
+    with autograd.record():
+        r2, s2 = nd.contrib.torch_two_heads(xn, un)
+        loss = nd.sum(r2) + nd.sum(s2)
+    loss.backward()
+    np.testing.assert_allclose(un.grad.asnumpy(), np.zeros_like(u))
+    want_gx = (x > 0).astype(np.float32) + 1.0   # d relu + d sum
+    np.testing.assert_allclose(xn.grad.asnumpy(), want_gx, rtol=1e-6)
