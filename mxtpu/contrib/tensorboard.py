@@ -25,25 +25,24 @@ from typing import Optional
 __all__ = ["SummaryWriter", "LogMetricsCallback"]
 
 # ---- CRC32C (Castagnoli), table-driven ------------------------------------
-_CRC_TABLE = []
+def _build_crc_table():
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return tuple(table)
 
 
-def _crc_table():
-    if not _CRC_TABLE:
-        poly = 0x82F63B78
-        for i in range(256):
-            c = i
-            for _ in range(8):
-                c = (c >> 1) ^ poly if c & 1 else c >> 1
-            _CRC_TABLE.append(c)
-    return _CRC_TABLE
+_CRC_TABLE = _build_crc_table()  # built at import: immutable and thread-safe
 
 
 def _crc32c(data: bytes) -> int:
-    table = _crc_table()
     crc = 0xFFFFFFFF
     for b in data:
-        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
 
 

@@ -19,3 +19,4 @@ from . import detection  # noqa: F401
 from . import spatial  # noqa: F401
 from . import rnn  # noqa: F401
 from . import attention  # noqa: F401
+from . import image_ops  # noqa: F401

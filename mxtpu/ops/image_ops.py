@@ -1,0 +1,118 @@
+"""``mx.nd.image``/``mx.sym.image`` operator namespace — parity with the
+reference's C++ image ops (src/operator/image/image_random.cc, 845 LoC:
+to_tensor / normalize / flips / resize / crop registered under the ``image``
+op namespace; the Python transforms in gluon.data.vision wrap these).
+
+Conventions match the reference: ``to_tensor`` takes HWC (or NHWC) uint8-range
+input and yields CHW float32 in [0,1]; ``normalize`` takes CHW/NCHW; the
+flip/resize/crop family operates on HWC/NHWC.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import rng
+from ..base import dtype_np
+from .registry import register
+
+NS = "image"
+
+
+def _hwc_axis(data, axis_from_end: int) -> int:
+    # HWC (3d) or NHWC (4d): address spatial axes from the channel end
+    return data.ndim - 1 - axis_from_end
+
+
+@register("to_tensor", namespace=NS)
+def _to_tensor(data):
+    """HWC/NHWC [0,255] → CHW/NCHW float32 [0,1] (image_random.cc ToTensor)."""
+    out = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return out.transpose(2, 0, 1)
+    return out.transpose(0, 3, 1, 2)
+
+
+@register("normalize", namespace=NS)
+def _normalize(data, mean=0.0, std=1.0):
+    """(x - mean) / std per channel on CHW/NCHW (image_random.cc Normalize)."""
+    c_axis = 0 if data.ndim == 3 else 1
+    shape = [1] * data.ndim
+    shape[c_axis] = -1
+    m = jnp.reshape(jnp.atleast_1d(jnp.asarray(mean, jnp.float32)), shape)
+    s = jnp.reshape(jnp.atleast_1d(jnp.asarray(std, jnp.float32)), shape)
+    return (data - m) / s
+
+
+@register("flip_left_right", namespace=NS)
+def _flip_left_right(data):
+    return jnp.flip(data, axis=_hwc_axis(data, 1))
+
+
+@register("flip_top_bottom", namespace=NS)
+def _flip_top_bottom(data):
+    return jnp.flip(data, axis=_hwc_axis(data, 2))
+
+
+@register("random_flip_left_right", namespace=NS, differentiable=False)
+def _random_flip_left_right(data, p: float = 0.5, key=None):
+    k = key if key is not None else rng.next_key()
+    return jax.lax.cond(jax.random.uniform(k) < p,
+                        lambda d: jnp.flip(d, axis=_hwc_axis(d, 1)),
+                        lambda d: d, data)
+
+
+@register("random_flip_top_bottom", namespace=NS, differentiable=False)
+def _random_flip_top_bottom(data, p: float = 0.5, key=None):
+    k = key if key is not None else rng.next_key()
+    return jax.lax.cond(jax.random.uniform(k) < p,
+                        lambda d: jnp.flip(d, axis=_hwc_axis(d, 2)),
+                        lambda d: d, data)
+
+
+@register("resize", namespace=NS)
+def _resize(data, size=0, keep_ratio: bool = False, interp: int = 1):
+    """Resize HWC/NHWC to ``size`` (int → square / shorter-edge-with-ratio,
+    pair → (w, h)); interp 0=nearest, else bilinear (image_resize.cc)."""
+    method = "nearest" if interp == 0 else "linear"
+    if data.ndim == 3:
+        h, w, c = data.shape
+        batch = False
+    else:
+        _, h, w, c = data.shape
+        batch = True
+    if isinstance(size, (tuple, list)):
+        new_w, new_h = int(size[0]), int(size[1])
+    elif keep_ratio:
+        scale = float(size) / float(min(h, w))
+        if h < w:
+            new_h, new_w = int(size), max(1, int(round(w * scale)))
+        else:
+            new_w, new_h = int(size), max(1, int(round(h * scale)))
+    else:
+        new_w = new_h = int(size)
+    shape = ((data.shape[0], new_h, new_w, c) if batch
+             else (new_h, new_w, c))
+    out = jax.image.resize(data.astype(jnp.float32), shape, method=method)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        info = jnp.iinfo(data.dtype)
+        return jnp.clip(jnp.round(out), info.min, info.max).astype(data.dtype)
+    return out
+
+
+@register("crop", namespace=NS)
+def _crop(data, x: int = 0, y: int = 0, width: int = 1, height: int = 1):
+    """Fixed crop of HWC/NHWC at (x, y) sized (width, height); bounds are
+    CHECKed like the reference's crop.cc rather than silently clamped."""
+    img_h, img_w = (data.shape[0], data.shape[1]) if data.ndim == 3 else \
+        (data.shape[1], data.shape[2])
+    if width <= 0 or height <= 0:
+        raise ValueError(f"crop: width/height must be positive, got "
+                         f"({width}, {height})")
+    if x < 0 or y < 0 or x + width > img_w or y + height > img_h:
+        raise ValueError(f"crop: window ({x},{y},{width},{height}) out of "
+                         f"bounds for image ({img_h}, {img_w})")
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]

@@ -53,7 +53,7 @@ _OPS: Dict[str, OpDef] = {}
 
 # the op sub-namespaces both frontends (mx.nd.* and mx.sym.*) expose — one
 # list so the two surfaces cannot drift
-OP_NAMESPACES = ("linalg", "random", "contrib")
+OP_NAMESPACES = ("linalg", "random", "contrib", "image")
 
 
 def register(name: Optional[str] = None, *, num_outputs: int = 1,

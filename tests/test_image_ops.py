@@ -1,0 +1,99 @@
+"""mx.nd.image / mx.sym.image operator namespace (src/operator/image parity)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu import symbol as sym
+
+
+@pytest.fixture()
+def img():
+    return np.random.RandomState(0).randint(0, 255, (8, 6, 3)).astype(np.uint8)
+
+
+def test_to_tensor_and_normalize(img):
+    t = nd.image.to_tensor(nd.array(img))
+    assert t.shape == (3, 8, 6) and str(t.dtype) == "float32"
+    np.testing.assert_allclose(t.asnumpy(),
+                               img.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    n = nd.image.normalize(t, mean=(0.1, 0.2, 0.3), std=(0.5, 0.5, 0.5))
+    want = (img.transpose(2, 0, 1) / 255.0 -
+            np.array([0.1, 0.2, 0.3])[:, None, None]) / 0.5
+    np.testing.assert_allclose(n.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    # batch variant
+    batch = np.stack([img, img])
+    tb = nd.image.to_tensor(nd.array(batch))
+    assert tb.shape == (2, 3, 8, 6)
+    nb = nd.image.normalize(tb, mean=0.5, std=0.25)
+    np.testing.assert_allclose(nb.asnumpy()[0],
+                               (img.transpose(2, 0, 1) / 255.0 - 0.5) / 0.25,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flips_and_crop(img):
+    np.testing.assert_array_equal(
+        nd.image.flip_left_right(nd.array(img)).asnumpy(), img[:, ::-1])
+    np.testing.assert_array_equal(
+        nd.image.flip_top_bottom(nd.array(img)).asnumpy(), img[::-1])
+    c = nd.image.crop(nd.array(img), x=1, y=2, width=4, height=5)
+    np.testing.assert_array_equal(c.asnumpy(), img[2:7, 1:5])
+    # NHWC flip
+    batch = np.stack([img, img[::-1]])
+    np.testing.assert_array_equal(
+        nd.image.flip_left_right(nd.array(batch)).asnumpy(), batch[:, :, ::-1])
+
+
+def test_resize(img):
+    r = nd.image.resize(nd.array(img), size=(12, 16))   # (w, h)
+    assert r.shape == (16, 12, 3)
+    r2 = nd.image.resize(nd.array(img), size=4)
+    assert r2.shape == (4, 4, 3)
+    # keep_ratio: shorter edge -> 4, h=8 w=6 -> w is shorter -> w=4, h=round(8*4/6)
+    r3 = nd.image.resize(nd.array(img), size=4, keep_ratio=True)
+    assert r3.shape == (5, 4, 3)
+    # nearest on integers keeps dtype
+    r4 = nd.image.resize(nd.array(img), size=4, interp=0)
+    assert str(r4.dtype) == "uint8"
+
+
+def test_random_flip_seeded(img):
+    # seed 0's split-chain happens to start with a long run of low uniforms in
+    # this jax version; use a longer window so both outcomes appear
+    mx.rng.seed(123)
+    outs = [nd.image.random_flip_left_right(nd.array(img)).asnumpy()
+            for _ in range(24)]
+    flipped = sum(bool((o == img[:, ::-1]).all()) for o in outs)
+    kept = sum(bool((o == img).all()) for o in outs)
+    assert flipped + kept == 24 and flipped > 0 and kept > 0
+    # p=0 and p=1 are deterministic
+    np.testing.assert_array_equal(
+        nd.image.random_flip_top_bottom(nd.array(img), p=0.0).asnumpy(), img)
+    np.testing.assert_array_equal(
+        nd.image.random_flip_top_bottom(nd.array(img), p=1.0).asnumpy(),
+        img[::-1])
+
+
+def test_symbol_image_namespace(img):
+    a = sym.Variable("a")
+    out = sym.image.normalize(sym.image.to_tensor(a), mean=0.5, std=0.5)
+    got = out.eval(a=nd.array(img))[0]
+    want = (img.transpose(2, 0, 1) / 255.0 - 0.5) / 0.5
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_crop_bounds_checked(img):
+    with pytest.raises(ValueError, match="out of bounds"):
+        nd.image.crop(nd.array(img), x=4, y=0, width=4, height=4)
+    with pytest.raises(ValueError, match="positive"):
+        nd.image.crop(nd.array(img), x=0, y=0, width=0, height=4)
+
+
+def test_resize_rounds_integer_pixels():
+    # a 0/255 checker resized 2x: interpolated midpoints must round, not floor
+    img2 = np.zeros((2, 2, 3), np.uint8)
+    img2[0, 0] = img2[1, 1] = 255
+    r = nd.image.resize(nd.array(img2), size=4).asnumpy()
+    f = nd.image.resize(nd.array(img2.astype(np.float32)), size=4).asnumpy()
+    assert np.abs(r.astype(np.float32) - np.round(f)).max() <= 1e-3
