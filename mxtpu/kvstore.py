@@ -13,7 +13,10 @@ mechanisms:
   grads are all-reduced over the pod with an XLA collective (``parallel.collectives``) —
   replacing ps-lite push/pull (kvstore_dist.h) with ICI/DCN allreduce, per BASELINE's
   north star. Sync semantics match ``dist_sync`` (every worker sees the same reduced
-  value); ``dist_async`` has no XLA equivalent and raises with guidance.
+  value). ``dist_async`` keeps the reference's asynchronous-SGD semantics via a
+  HOST-side parameter server (``mxtpu.ps``): rank 0 owns the authoritative copy,
+  pushes apply the server-side optimizer the moment they arrive, pulls read the
+  current state — no worker synchronization (kvstore_dist_server.h async mode).
 
 Types accepted for parity: local | device | tpu | dist | dist_sync | dist_device_sync
 (kvstore.cc:40-76 type strings; nccl → tpu).
@@ -41,19 +44,38 @@ class KVStore:
         kv_type = {"nccl": "tpu", "device": "tpu"}.get(kv_type, kv_type)
         if kv_type.startswith("dist"):
             self._distributed = True
-            # connect the pod if the launcher's DMLC_* env contract is present
-            # (tools/launch.py local mode; InitPSEnv parity kvstore.h:257)
-            from . import dist as dist_mod
-            dist_mod.auto_initialize()
+            if "async" not in kv_type:
+                # connect the pod if the launcher's DMLC_* env contract is
+                # present (tools/launch.py; InitPSEnv parity kvstore.h:257).
+                # The async mode deliberately skips this: its transport is the
+                # host-side PS, and blocking on the jax.distributed
+                # coordinator would reintroduce worker synchronization.
+                from . import dist as dist_mod
+                dist_mod.auto_initialize()
         elif kv_type in ("local", "local_allreduce_cpu", "local_allreduce_device",
                          "tpu"):
             self._distributed = False
         else:
             raise ValueError(f"unknown kvstore type {kv_type!r}")
-        if "async" in kv_type:
-            raise NotImplementedError(
-                "dist_async: XLA collectives are synchronous; use dist_sync (see "
-                "SURVEY.md §7 hard-parts — async PS would need a host-side service)")
+        self._async = "async" in kv_type
+        self._ps = None
+        if self._async:
+            # dist_async: XLA collectives are synchronous, so async SGD runs
+            # where the reference ran it — a HOST-side parameter server
+            # (mxtpu/ps.py; kvstore_dist_server.h async-mode parity: pushes
+            # apply on arrival, no aggregation wait)
+            import os
+
+            from . import ps as ps_mod
+            self._ps_world = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+            self._ps_rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+            host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+            port = ps_mod.default_port()
+            if self._ps_rank == 0:
+                # port 0 (ephemeral) works single-host: the bound port is read
+                # back; multi-process launches carry a concrete port in env
+                port = ps_mod.start_server(port, self._ps_world).port
+            self._ps = ps_mod.PSClient(host, port)
         self.type = kv_type
         self._store: Dict[Any, NDArray] = {}
         self._updater: Optional[Callable] = None
@@ -63,32 +85,55 @@ class KVStore:
     # -- identity ----------------------------------------------------------
     @property
     def rank(self) -> int:
+        if self._async:
+            return self._ps_rank
         return jax.process_index() if self._distributed else 0
 
     @property
     def num_workers(self) -> int:
+        if self._async:
+            return self._ps_world
         return jax.process_count() if self._distributed else 1
 
     def barrier(self):
-        if self._distributed and jax.process_count() > 1:
+        if self._async:
+            self._ps.barrier()        # server-side count-to-world barrier
+        elif self._distributed and jax.process_count() > 1:
             # a tiny psum over all processes is the canonical XLA barrier
             from .parallel import collectives
             collectives.process_barrier()
 
     # -- data --------------------------------------------------------------
     def init(self, key, value):
+        import numpy as np
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
                 # materialized copy, not an alias: the caller's weight buffer may be
                 # donated by a later optimizer step (see NDArray.copy)
                 self._store[k] = NDArray(jnp.array(v.data, copy=True))
+            if self._async:
+                self._ps.init(str(k), np.asarray(v.data))  # server first-wins
 
     def push(self, key, value, priority: int = 0):
         """Accumulate: list-of-values are reduced (Comm::Reduce parity, comm.h:103);
         in dist mode the reduced grad is all-reduced across workers."""
         from .ndarray import sparse as _sparse
         keys, values = self._normalize_push(key, value)
+        if self._async:
+            # async PS: locally reduce the pushed list, ship the grad; the
+            # SERVER applies its updater immediately on arrival (no
+            # worker-sync). Row-sparse grads densify for transport here
+            # (flagged deviation, as in the dist_sync path below).
+            import numpy as np
+            for k, vlist in zip(keys, values):
+                red = None
+                for v in vlist:
+                    dense = v._dense() if getattr(
+                        v, "stype", "default") == "row_sparse" else v.data
+                    red = dense if red is None else red + dense
+                self._ps.push(str(k), np.asarray(red))
+            return
         for k, vlist in zip(keys, values):
             if any(getattr(v, "stype", "default") == "row_sparse" for v in vlist):
                 # sparse push (kvstore_dist.h:436 DataHandleRowSparse semantics):
@@ -134,7 +179,12 @@ class KVStore:
     def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True):
         keys, outs = self._normalize_push(key, out)
         for k, olist in zip(keys, outs):
-            src = self._store[k]
+            if self._async:
+                fetched = jnp.asarray(self._ps.pull(str(k)))
+                self._store[k] = NDArray(fetched)   # cache the latest view
+                src = self._store[k]
+            else:
+                src = self._store[k]
             for o in olist:
                 o._set_data(src.data.astype(o.dtype).reshape(o.shape))
 
@@ -156,6 +206,11 @@ class KVStore:
         keys, outs = self._normalize_push(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(outs[0])
         for k, olist in zip(keys, outs):
+            if self._async:
+                # refresh from the server, then gather rows locally (the wire
+                # carries the full value; a row-subset server command would be
+                # the O(|rows|) upgrade)
+                self._store[k] = NDArray(jnp.asarray(self._ps.pull(str(k))))
             src = self._store[k]
             for i, (o, rid) in enumerate(zip(olist, rids)):
                 rid_host = np.unique(np.asarray(
@@ -173,9 +228,19 @@ class KVStore:
     def set_optimizer(self, optimizer):
         self._optimizer = opt_mod.create(optimizer) if not isinstance(
             optimizer, opt_mod.Optimizer) else optimizer
+        if self._async:
+            # ship the (picklable) optimizer to the server — reference
+            # kvstore.py set_optimizer serializes it for the server role
+            self._ps.set_optimizer(self._optimizer)
+            return
         self._updater = opt_mod.get_updater(self._optimizer)
 
     def _set_updater(self, updater: Callable):
+        if self._async:
+            raise NotImplementedError(
+                "dist_async applies updates on the server: use "
+                "set_optimizer(...) (serialized to the server role) instead "
+                "of an arbitrary local updater callable")
         self._updater = updater
 
     def set_gradient_compression(self, compression_params: dict):
@@ -215,12 +280,21 @@ class KVStore:
         return codes.astype(jnp.float32) * thr
 
     def save_optimizer_states(self, fname: str, dump_optimizer: bool = False):
+        if self._async:
+            # async mode: the authoritative optimizer state lives on the server
+            with open(fname, "wb") as f:
+                f.write(self._ps.get_optimizer_states())
+            return
         if self._updater is None:
             raise RuntimeError("no optimizer set on kvstore")
         with open(fname, "wb") as f:
             f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname: str):
+        if self._async:
+            with open(fname, "rb") as f:
+                self._ps.set_optimizer_states(f.read())
+            return
         if self._updater is None:
             raise RuntimeError("no optimizer set on kvstore")
         with open(fname, "rb") as f:

@@ -1,0 +1,295 @@
+"""Host-side asynchronous parameter server — the ``dist_async`` backend.
+
+Reference: ps-lite's server role applied updates the moment each worker's push
+arrived (``kvstore_dist_server.h`` async mode: no ``ps::NumWorkers()`` wait, in
+contrast to sync's aggregate-then-apply at :283-295), giving Hogwild-style
+asynchronous SGD across workers. XLA collectives cannot express that — they
+are bulk-synchronous — so the TPU-native design runs the server where the
+reference ran it: ON THE HOST. Rank 0 owns a TCP server thread holding the
+authoritative numpy copy of every key; workers' pushes apply the (pickled,
+importable) optimizer immediately on arrival; pulls read the current state.
+The accelerators stay busy on compute while parameter traffic rides the host
+NIC exactly like ps-lite's ZMQ transport.
+
+Wire protocol (little-endian, no pickle except the SET_OPTIMIZER payload):
+  request  = u8 cmd | u16 keylen | key utf8 | u32 metalen | meta | u64 len | payload
+  response = u8 status | u32 metalen | meta | u64 len | payload
+meta is the ascii "dtype:shape,shape,..." descriptor of the array payload.
+Commands: 0 INIT (first-wins), 1 PUSH (apply updater), 2 PULL, 3 SET_OPTIMIZER
+(pickled mxtpu optimizer), 4 BARRIER (blocks until world_size arrivals).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ParamServer", "PSClient", "start_server", "default_port"]
+
+(CMD_INIT, CMD_PUSH, CMD_PULL, CMD_SET_OPT, CMD_BARRIER, CMD_GET_STATES,
+ CMD_SET_STATES) = range(7)
+STATUS_OK, STATUS_ERR = 0, 1
+
+
+def default_port() -> int:
+    """PS port derived from the launcher contract (coordinator port + 1)."""
+    import os
+    return int(os.environ.get("MXTPU_PS_PORT",
+                              int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+                              + 1))
+
+
+# ---- framing ---------------------------------------------------------------
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _encode_array(arr: Optional[np.ndarray]) -> Tuple[bytes, bytes]:
+    if arr is None:
+        return b"", b""
+    meta = f"{arr.dtype.str}:{','.join(map(str, arr.shape))}".encode()
+    return meta, np.ascontiguousarray(arr).tobytes()
+
+
+def _decode_array(meta: bytes, payload: bytes) -> Optional[np.ndarray]:
+    if not meta:
+        return None
+    dtype_s, shape_s = meta.decode().split(":")
+    shape = tuple(int(d) for d in shape_s.split(",")) if shape_s else ()
+    return np.frombuffer(payload, dtype=np.dtype(dtype_s)).reshape(shape).copy()
+
+
+def _send_msg(sock: socket.socket, head: bytes, meta: bytes, payload: bytes):
+    sock.sendall(head + struct.pack("<I", len(meta)) + meta +
+                 struct.pack("<Q", len(payload)) + payload)
+
+
+class ParamServer:
+    """The rank-0 server thread pool (one thread per worker connection)."""
+
+    def __init__(self, port: int, world_size: int):
+        self.world_size = world_size
+        self._store: Dict[str, np.ndarray] = {}
+        self._updater = None          # (key, grad ndarray, stored NDArray-like)
+        self._updater_obj = None      # the Updater (state save/load)
+        self._lock = threading.Lock()
+        self._barrier = threading.Barrier(world_size)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(world_size + 4)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="mxtpu-ps-accept")
+        t.start()
+        self._threads.append(t)
+
+    # -- server internals --------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True,
+                                 name="mxtpu-ps-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _apply_push(self, key: str, grad: np.ndarray):
+        with self._lock:
+            stored = self._store.get(key)
+            if stored is None:
+                raise KeyError(f"push before init for key {key!r}")
+            if self._updater is not None:
+                self._updater(key, grad, stored)      # in-place on stored
+            else:
+                stored += grad                        # default: accumulate
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                head = _recv_exact(conn, 3)
+                cmd, keylen = head[0], struct.unpack("<H", head[1:3])[0]
+                key = _recv_exact(conn, keylen).decode() if keylen else ""
+                (metalen,) = struct.unpack("<I", _recv_exact(conn, 4))
+                meta = _recv_exact(conn, metalen)
+                (plen,) = struct.unpack("<Q", _recv_exact(conn, 8))
+                payload = _recv_exact(conn, plen)
+                status, rmeta, rpayload = STATUS_OK, b"", b""
+                try:
+                    if cmd == CMD_INIT:
+                        val = _decode_array(meta, payload)
+                        with self._lock:
+                            self._store.setdefault(key, val)   # first wins
+                    elif cmd == CMD_PUSH:
+                        self._apply_push(key, _decode_array(meta, payload))
+                    elif cmd == CMD_PULL:
+                        # encode UNDER the lock: concurrent pushes mutate the
+                        # stored buffer in place; encoding outside would ship
+                        # a torn snapshot
+                        with self._lock:
+                            val = self._store.get(key)
+                            if val is None:
+                                raise KeyError(f"pull before init: {key!r}")
+                            rmeta, rpayload = _encode_array(val)
+                    elif cmd == CMD_SET_OPT:
+                        self._set_optimizer_bytes(payload)
+                    elif cmd == CMD_BARRIER:
+                        try:
+                            self._barrier.wait(timeout=300)
+                        except threading.BrokenBarrierError:
+                            # a peer died or timed out; replace the barrier so
+                            # the job (or the next one on this singleton) can
+                            # still synchronize, and report clearly
+                            with self._lock:
+                                if self._barrier.broken:
+                                    self._barrier = threading.Barrier(
+                                        self.world_size)
+                            raise RuntimeError(
+                                "barrier broken: a worker exited or timed "
+                                "out while peers waited")
+                    elif cmd == CMD_GET_STATES:
+                        with self._lock:
+                            if self._updater_obj is None:
+                                raise RuntimeError("no optimizer set on server")
+                            rpayload = self._updater_obj.get_states()
+                    elif cmd == CMD_SET_STATES:
+                        with self._lock:
+                            if self._updater_obj is None:
+                                raise RuntimeError("no optimizer set on server")
+                            self._updater_obj.set_states(payload)
+                    else:
+                        raise ValueError(f"unknown cmd {cmd}")
+                except Exception as e:  # report, keep serving
+                    status = STATUS_ERR
+                    rmeta, rpayload = b"", repr(e).encode()
+                _send_msg(conn, bytes([status]), rmeta, rpayload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _set_optimizer_bytes(self, payload: bytes):
+        from . import optimizer as opt_mod
+        opt = pickle.loads(payload)
+        updater = opt_mod.get_updater(opt)
+
+        def apply(key, grad, stored):
+            from .ndarray.ndarray import NDArray
+            import jax.numpy as jnp
+            w = NDArray(jnp.asarray(stored))
+            updater(key, NDArray(jnp.asarray(grad)), w)
+            stored[...] = np.asarray(w.data)
+
+        with self._lock:
+            self._updater = apply
+            self._updater_obj = updater
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """One worker's persistent connection to the parameter server."""
+
+    def __init__(self, host: str, port: int, timeout: float = 300.0,
+                 retries: int = 50):
+        import time
+        last = None
+        for _ in range(retries):           # the server may still be binding
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        else:
+            raise ConnectionError(f"cannot reach param server "
+                                  f"{host}:{port}: {last}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _request_raw(self, cmd: int, key: str = "",
+                     arr: Optional[np.ndarray] = None,
+                     raw: bytes = b"") -> Tuple[bytes, bytes]:
+        kb = key.encode()
+        meta, payload = _encode_array(arr)
+        if raw:
+            payload = raw
+        with self._lock:
+            _send_msg(self._sock,
+                      bytes([cmd]) + struct.pack("<H", len(kb)) + kb,
+                      meta, payload)
+            status = _recv_exact(self._sock, 1)[0]
+            (metalen,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            rmeta = _recv_exact(self._sock, metalen)
+            (plen,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+            rpayload = _recv_exact(self._sock, plen)
+        if status != STATUS_OK:
+            raise RuntimeError(f"param server error: {rpayload.decode()}")
+        return rmeta, rpayload
+
+    def _request(self, cmd: int, key: str = "",
+                 arr: Optional[np.ndarray] = None,
+                 raw: bytes = b"") -> Optional[np.ndarray]:
+        return _decode_array(*self._request_raw(cmd, key, arr, raw))
+
+    def init(self, key: str, value: np.ndarray):
+        self._request(CMD_INIT, key, value)
+
+    def push(self, key: str, grad: np.ndarray):
+        self._request(CMD_PUSH, key, grad)
+
+    def pull(self, key: str) -> np.ndarray:
+        return self._request(CMD_PULL, key)
+
+    def set_optimizer(self, optimizer):
+        self._request(CMD_SET_OPT, "", raw=pickle.dumps(optimizer))
+
+    def get_optimizer_states(self) -> bytes:
+        return self._request_raw(CMD_GET_STATES)[1]
+
+    def set_optimizer_states(self, states: bytes):
+        self._request(CMD_SET_STATES, "", raw=states)
+
+    def barrier(self):
+        self._request(CMD_BARRIER)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+_server: Optional[ParamServer] = None
+_server_lock = threading.Lock()
+
+
+def start_server(port: int, world_size: int) -> ParamServer:
+    """Start (once) the in-process server — called on rank 0."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            _server = ParamServer(port, world_size)
+        return _server

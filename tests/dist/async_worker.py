@@ -1,0 +1,61 @@
+"""Multi-process dist_async worker — asynchronous-SGD semantics over the
+host-side parameter server (kvstore_dist_server.h async-mode parity):
+pushes apply on arrival with NO worker synchronization; pulls see whatever
+state the server currently holds."""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxtpu as mx
+from mxtpu import nd, optimizer
+
+rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+world = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+kv = mx.kvstore.create("dist_async")
+assert kv.rank == rank and kv.num_workers == world
+assert kv.type == "dist_async"
+
+# --- accumulate-mode (no optimizer): pushes sum on the server --------------
+kv.init("acc", nd.array(np.zeros((3, 2), np.float32)))
+kv.barrier()                       # all inits done
+kv.push("acc", nd.array(np.full((3, 2), float(rank + 1), np.float32)))
+kv.barrier()                       # all pushes arrived
+out = nd.zeros((3, 2))
+kv.pull("acc", out=out)
+np.testing.assert_allclose(out.asnumpy(), world * (world + 1) / 2.0)
+
+# list-of-values push reduces locally before the wire (still accumulate mode:
+# the server-wide optimizer below would otherwise apply to this key too)
+kv.push("acc", [nd.array(np.ones((3, 2), np.float32))] * 2)
+kv.barrier()
+out2 = nd.zeros((3, 2))
+kv.pull("acc", out=out2)
+np.testing.assert_allclose(
+    out2.asnumpy(), world * (world + 1) / 2.0 + 2.0 * world)
+
+# --- async SGD via a server-side optimizer --------------------------------
+kv2 = mx.kvstore.create("dist_async")
+kv2.init("w", nd.array(np.ones((4,), np.float32)))
+if rank == 0:
+    kv2.set_optimizer(optimizer.SGD(learning_rate=0.5))
+kv2.barrier()                      # optimizer installed before anyone pushes
+steps = 3
+for _ in range(steps):
+    kv2.push("w", nd.array(np.ones((4,), np.float32)))   # grad = 1
+    out = nd.zeros((4,))
+    kv2.pull("w", out=out)        # async: some partial state, no barrier
+kv2.barrier()                      # drain all pushes
+final = nd.zeros((4,))
+kv2.pull("w", out=final)
+# every push moved w by -0.5: w = 1 - 0.5 * world * steps
+np.testing.assert_allclose(final.asnumpy(), 1.0 - 0.5 * world * steps,
+                           rtol=1e-6)
+
+print("ASYNC_WORKER_OK", flush=True)

@@ -1,0 +1,106 @@
+"""dist_async — host-side asynchronous parameter server (mxtpu/ps.py).
+
+Single-process loopback tests of the server/client protocol + a REAL
+2-process async run via tools/launch.py (the reference's async ps-lite tier).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def async_kv(monkeypatch):
+    monkeypatch.setenv("MXTPU_PS_PORT", "0")     # ephemeral loopback server
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    import mxtpu as mx
+    from mxtpu import ps
+    yield mx.kvstore.create("dist_async")
+    # the server is process-global (one per job, like the reference's server
+    # role) — reset between tests so keys/optimizer don't leak across them
+    with ps._server_lock:
+        if ps._server is not None:
+            ps._server.stop()
+            ps._server = None
+
+
+def test_async_accumulate_and_pull(async_kv):
+    from mxtpu import nd
+    kv = async_kv
+    assert kv.type == "dist_async" and kv.num_workers == 1
+    kv.init("a", nd.array(np.zeros((2, 3), np.float32)))
+    kv.push("a", nd.array(np.ones((2, 3), np.float32)))
+    kv.push("a", [nd.array(np.full((2, 3), 2.0, np.float32))] * 2)
+    out = nd.zeros((2, 3))
+    kv.pull("a", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 5.0)
+    kv.barrier()                                  # world=1: returns at once
+
+
+def test_async_server_side_optimizer(async_kv):
+    from mxtpu import nd, optimizer
+    kv = async_kv
+    kv.init("w", nd.array(np.full((4,), 2.0, np.float32)))
+    kv.set_optimizer(optimizer.SGD(learning_rate=0.1))
+    for _ in range(5):
+        kv.push("w", nd.array(np.ones((4,), np.float32)))
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0 - 0.1 * 5, rtol=1e-6)
+    # local arbitrary updaters are a sync-mode concept
+    with pytest.raises(NotImplementedError, match="server"):
+        kv._set_updater(lambda k, g, w: None)
+
+
+def test_async_errors_surface(async_kv):
+    from mxtpu import nd
+    with pytest.raises(RuntimeError, match="pull before init"):
+        async_kv.pull("never_inited", out=nd.zeros((1,)))
+
+
+def test_async_row_sparse_pull_refreshes(async_kv):
+    from mxtpu import nd
+    from mxtpu.ndarray import sparse
+    kv = async_kv
+    kv.init("emb", nd.array(np.arange(12, dtype=np.float32).reshape(6, 2)))
+    kv.push("emb", nd.array(np.ones((6, 2), np.float32)))
+    out = sparse.row_sparse_array((np.zeros((2, 2), np.float32), [1, 4]),
+                                  shape=(6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 4]))
+    want = np.arange(12, dtype=np.float32).reshape(6, 2) + 1.0
+    np.testing.assert_allclose(out.data.asnumpy(), want[[1, 4]])
+
+
+def test_dist_async_two_processes():
+    worker = os.path.join(ROOT, "tests", "dist", "async_worker.py")
+    launcher = os.path.join(ROOT, "tools", "launch.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, launcher, "-n", "2", sys.executable, worker],
+        capture_output=True, text=True, timeout=280, env=env, cwd=ROOT)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert out.count("ASYNC_WORKER_OK") == 2, out[-4000:]
+
+
+def test_async_optimizer_state_roundtrip(async_kv, tmp_path):
+    from mxtpu import nd, optimizer
+    kv = async_kv
+    kv.init("w", nd.array(np.ones((3,), np.float32)))
+    kv.set_optimizer(optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push("w", nd.array(np.ones((3,), np.float32)))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)            # states live on the server
+    assert os.path.getsize(fname) > 0
+    kv.load_optimizer_states(fname)
+    out = nd.zeros((3,))
+    kv.pull("w", out=out)
+    assert np.all(np.isfinite(out.asnumpy()))
