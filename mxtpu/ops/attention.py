@@ -60,15 +60,37 @@ def attention_reference(q, k, v, causal: bool = False, scale: Optional[float] = 
 # ---------------------------------------------------------------------------
 
 
-def _pick_block(t: int, cap: int = 128) -> int:
+def _pick_block(t: int, cap: int = 512) -> int:
     """Largest legal q/k block: Mosaic requires the lse/delta row blocks'
     last dim to be 128-divisible or equal to the full axis, so blocks are
-    either 128 (t % 128 == 0) or the whole axis (t <= 128, t % 8 == 0)."""
+    multiples of 128 dividing t, or the whole axis (t <= 128, t % 8 == 0).
+
+    Cap 512 measured fastest on v5e at production shapes (B4 H16 T2048 D64
+    fwd+bwd: 15.1 ms @128 → 6.7 ms @512, vs 20.7 ms XLA reference); 1024
+    exceeds VMEM and fails to compile. Launch sites scale the cap down with
+    the padded head dim (`_block_cap`) so large-D shapes stay inside VMEM."""
+    if cap < 128:
+        # honor small caps with a divisor of 128 (divides any legal t)
+        for b in (64, 32, 16, 8):
+            if b <= cap:
+                return min(b, t) if t % 128 == 0 or (
+                    t <= 128 and t % b == 0) else 0
+        return 0
     if t % 128 == 0:
-        return min(128, cap)
+        b = min(cap - cap % 128, t)
+        while b > 128 and t % b != 0:
+            b -= 128
+        return b
     if t <= 128 and t % 8 == 0:
         return t
     return 0
+
+
+def _block_cap(dp: int) -> int:
+    """VMEM-aware block cap: 512 validated at Dp=128; scale down linearly in
+    the padded head dim so the per-program tiles stay in the same budget
+    (Dp=256 → 256, Dp≥512 → 128, the previously-validated floor)."""
+    return max(128, 512 * 128 // max(dp, 128))
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
@@ -208,19 +230,19 @@ def _pad_d(x):
 
 
 def _flash_attention_pallas(q, k, v, causal: bool, scale: float,
-                            block_q: int = 128, block_k: int = 128,
+                            block_q: int = 512, block_k: int = 512,
                             interpret: bool = False):
     """Forward kernel launch; returns (out, lse). q,k,v: (B, H, T, D)."""
     from jax.experimental import pallas as pl
 
     B, H, T, D = q.shape
     Tk = k.shape[2]
-    block_q = _pick_block(T, block_q)
-    block_k = _pick_block(Tk, block_k)
     qq = _pad_d(q.reshape(B * H, T, D))
     kk = _pad_d(k.reshape(B * H, Tk, D))
     vv = _pad_d(v.reshape(B * H, Tk, D))
     Dp = qq.shape[-1]
+    block_q = _pick_block(T, min(block_q, _block_cap(Dp)))
+    block_k = _pick_block(Tk, min(block_k, _block_cap(Dp)))
     grid = (B * H, T // block_q)
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
@@ -247,7 +269,7 @@ def _flash_attention_pallas(q, k, v, causal: bool, scale: float,
 
 
 def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
-                           block_q: int = 128, block_k: int = 128,
+                           block_q: int = 512, block_k: int = 512,
                            interpret: bool = False, lse_cot=None):
     """Flash backward: dq via q-block grid, dk/dv via k-block grid.
 
@@ -258,8 +280,9 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
 
     B, H, T, D = q.shape
     Tk = k.shape[2]
-    block_q = _pick_block(T, block_q)
-    block_k = _pick_block(Tk, block_k)
+    cap = _block_cap(-(-D // 128) * 128)
+    block_q = _pick_block(T, min(block_q, cap))
+    block_k = _pick_block(Tk, min(block_k, cap))
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if lse_cot is not None:
         delta = delta - lse_cot.astype(jnp.float32)
