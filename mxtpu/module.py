@@ -575,3 +575,96 @@ class SequentialModule(BaseModule):
     def update_metric(self, eval_metric, labels):
         self._modules[self._label_module_index()].update_metric(eval_metric,
                                                                 labels)
+
+
+class PythonModule(BaseModule):
+    """Parameter-less module written directly in Python
+    (python_module.py:PythonModule parity): computation supplied by
+    subclassing or a ``forward_fn``; get_params is empty, init/update are
+    no-ops. The glue that lets hand-written stages (losses, samplers,
+    metrics-side computations) slot into SequentialModule/fit pipelines."""
+
+    def __init__(self, data_names=("data",), label_names=("softmax_label",),
+                 output_names=("output",), logger=logging, forward_fn=None):
+        super().__init__(logger)
+        self.data_names = list(data_names)
+        self.label_names = list(label_names or [])
+        self.output_names = list(output_names)
+        self._forward_fn = forward_fn
+        self._outputs: List = []
+        self._labels: List = []
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._for_training = for_training
+        self.binded = True
+
+    def init_params(self, initializer=None, **kwargs):
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self.optimizer_initialized = True
+
+    def get_params(self):
+        return {}, {}
+
+    def forward(self, data_batch: DataBatch, is_train=None):
+        self._labels = list(data_batch.label or [])
+        outs = self._forward_impl(list(data_batch.data), self._labels)
+        self._outputs = outs if isinstance(outs, (list, tuple)) else [outs]
+
+    def _forward_impl(self, data, labels):
+        if self._forward_fn is None:
+            raise NotImplementedError(
+                "subclass PythonModule and implement _forward_impl, or pass "
+                "forward_fn=")
+        return self._forward_fn(data, labels)
+
+    def backward(self, out_grads=None):
+        pass                       # parameter-less: nothing to do by default
+
+    def update(self):
+        pass
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._outputs)
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self._outputs)
+
+    def _monitor_blocks(self):
+        return []
+
+
+class PythonLossModule(PythonModule):
+    """Loss stage in Python (python_module.py:PythonLossModule): forward
+    passes scores through; backward injects ``grad_func(scores, labels)``
+    into the tape so upstream modules receive it via the connected-tape
+    chain (here: by re-recording the forward with the custom cotangent)."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(data_names, label_names, (name + "_output",), logger)
+        self._grad_func = grad_func
+        self._scores = None
+
+    def _forward_impl(self, data, labels):
+        self._scores = data[0]
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        if self._scores is None:
+            raise RuntimeError("backward before forward")
+        if self._grad_func is not None:
+            grad = self._grad_func(self._scores, self._labels)
+        elif self._labels:
+            # default: d/dscores of softmax CE with the given sparse labels
+            probs = nd.softmax(self._scores)
+            onehot = nd.one_hot(self._labels[0], int(self._scores.shape[-1]))
+            grad = probs - onehot
+        else:
+            raise RuntimeError("PythonLossModule needs labels or grad_func")
+        self._scores.backward(out_grad=grad)

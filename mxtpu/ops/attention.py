@@ -70,12 +70,9 @@ def _pick_block(t: int, cap: int = 512) -> int:
     exceeds VMEM and fails to compile. Launch sites scale the cap down with
     the padded head dim (`_block_cap`) so large-D shapes stay inside VMEM."""
     if cap < 128:
-        # honor small caps with a divisor of 128 (divides any legal t)
-        for b in (64, 32, 16, 8):
-            if b <= cap:
-                return min(b, t) if t % 128 == 0 or (
-                    t <= 128 and t % b == 0) else 0
-        return 0
+        # below 128 only a whole-axis block is Mosaic-legal (the lse/delta
+        # row block must be 128-divisible or the full axis)
+        return t if (t <= cap and t % 8 == 0) else 0
     if t % 128 == 0:
         b = min(cap - cap % 128, t)
         while b > 128 and t % b != 0:
@@ -280,9 +277,6 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
 
     B, H, T, D = q.shape
     Tk = k.shape[2]
-    cap = _block_cap(-(-D // 128) * 128)
-    block_q = _pick_block(T, min(block_q, cap))
-    block_k = _pick_block(Tk, min(block_k, cap))
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if lse_cot is not None:
         delta = delta - lse_cot.astype(jnp.float32)
@@ -294,6 +288,9 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
     vv = _pad_d(v.reshape(B * H, Tk, D))
     gg = _pad_d(g.reshape(B * H, T, D))
     Dp = qq.shape[-1]
+    # same padded-D cap as the forward (blocks must match its VMEM budget)
+    block_q = _pick_block(T, min(block_q, _block_cap(Dp)))
+    block_k = _pick_block(Tk, min(block_k, _block_cap(Dp)))
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
                                   causal=causal, scale=scale)
