@@ -91,3 +91,23 @@ def _l2_normalization(data, eps: float = 1e-10, mode: str = "instance"):
         raise ValueError(f"unknown L2Normalization mode {mode!r}")
     norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
     return data / norm
+
+
+@register("histogram", num_outputs=2, differentiable=False)
+def _histogram(data, bins=None, bin_cnt: int = 10, range=None):
+    """src/operator/tensor/histogram.cc: counts + bin edges. ``bins`` may be
+    an explicit edges array (then bin_cnt/range are ignored)."""
+    flat = data.reshape(-1)
+    if bins is not None and not isinstance(bins, int):
+        edges = jnp.asarray(bins)
+        counts, _ = jnp.histogram(flat, bins=edges)
+        return counts.astype(jnp.int32), edges  # x64-disabled dtype floor
+    n = bins if isinstance(bins, int) else bin_cnt
+    if range is not None:
+        lo, hi = range
+    elif flat.size == 0:
+        lo, hi = 0.0, 1.0          # numpy's empty-input default window
+    else:
+        lo, hi = jnp.min(flat), jnp.max(flat)
+    counts, edges = jnp.histogram(flat, bins=n, range=(lo, hi))
+    return counts.astype(jnp.int32), edges

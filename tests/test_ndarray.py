@@ -205,3 +205,20 @@ def test_gather_scatter_nd():
 
 def test_waitall_runs():
     nd.waitall()
+
+
+def test_histogram():
+    x = nd.array(np.array([0., 1., 1., 2., 5., 9.], np.float32))
+    c, e = nd.histogram(x, bin_cnt=3, range=(0, 9))
+    nc, ne = np.histogram(np.array([0, 1, 1, 2, 5, 9.]), bins=3, range=(0, 9))
+    np.testing.assert_array_equal(c.asnumpy(), nc)
+    np.testing.assert_allclose(e.asnumpy(), ne)
+    # explicit edges form
+    c2, e2 = nd.histogram(x, bins=np.array([0., 2., 10.], np.float32))
+    np.testing.assert_array_equal(c2.asnumpy(), [3, 3])
+
+
+def test_histogram_empty_input():
+    c, e = nd.histogram(nd.array(np.array([], np.float32)), bin_cnt=4)
+    np.testing.assert_array_equal(c.asnumpy(), [0, 0, 0, 0])
+    np.testing.assert_allclose(e.asnumpy(), np.linspace(0, 1, 5))
