@@ -17,6 +17,14 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Tests spawn MANY python subprocesses (dist workers, C-ABI demos, example
+# runs). The environment's sitecustomize claims the tunneled TPU in every
+# fresh interpreter when PALLAS_AXON_POOL_IPS is set — a ~90 s blocking
+# handshake per child that CPU-only test children never need. Dropping the
+# gate here lets children skip the claim; the driver's bench/dryrun paths
+# don't import this conftest and keep their chip access.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

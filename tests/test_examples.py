@@ -49,3 +49,50 @@ def test_sparse_fm_converges():
     from examples.train_sparse_fm import main
     acc = main(["--rows", "1200", "--epochs", "4", "--num-features", "5000"])
     assert acc > 0.78, f"FM accuracy {acc}"
+
+
+def _run_example(script, args, timeout=280, virtual_devices=False):
+    import subprocess
+    # do NOT inherit conftest's 8-virtual-device XLA_FLAGS: on a 1-core
+    # harness VM eight device threads contend and slow examples ~8x; only
+    # mesh-using examples ask for them
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    if virtual_devices:
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "examples", script)]
+                       + args, capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-3000:]
+    return r.stdout
+
+
+def test_train_mnist_example():
+    out = _run_example("train_mnist.py",
+                       ["--num-epochs", "3", "--batch-size", "64"],
+                       timeout=520)
+    acc = float(out.strip().splitlines()[-1].split()[-1])
+    assert acc > 0.9, out[-1500:]
+
+
+def test_train_gluon_sharded_example():
+    out = _run_example("train_gluon_sharded.py", ["--steps", "12"],
+                       virtual_devices=True)
+    assert "mesh=dp" in out
+
+
+def test_train_ssd_toy_example():
+    out = _run_example("train_ssd_toy.py",
+                       ["--steps", "60", "--batch-size", "8"], timeout=520)
+    last = out.strip().splitlines()[-1]
+    assert "mean IoU" in last, out[-1500:]
+
+
+def test_quantize_inference_example():
+    out = _run_example("quantize_inference.py", [])
+    lines = {l.split(":")[0].strip(): l for l in out.strip().splitlines()
+             if ":" in l}
+    assert "fp32 acc" in lines and "int8 acc" in lines, out[-1500:]
+    agree = float(lines["agreement"].split()[-1])
+    assert agree > 0.9, out[-1500:]
