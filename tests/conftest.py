@@ -28,3 +28,17 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def subprocess_env(virtual_devices: int = 0):
+    """Env for test-spawned python children: no TPU claim, no inherited
+    8-virtual-device XLA_FLAGS (8 device threads thrash a 1-core VM), repo on
+    PYTHONPATH. One copy here so every subprocess test scrubs identically."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    if virtual_devices:
+        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                            f"{virtual_devices}")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env

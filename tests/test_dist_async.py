@@ -104,3 +104,53 @@ def test_async_optimizer_state_roundtrip(async_kv, tmp_path):
     out = nd.zeros((3,))
     kv.pull("w", out=out)
     assert np.all(np.isfinite(out.asnumpy()))
+
+
+def test_async_concurrent_push_pull_consistency(async_kv):
+    """Many threads pushing while others pull: the store lock must make every
+    pulled snapshot a value that actually existed (accumulate mode: every
+    snapshot is k * ones for an integer k), and the final value exact."""
+    import threading
+
+    from mxtpu import nd
+    kv = async_kv
+    kv.init("c", nd.array(np.zeros((64, 64), np.float32)))
+    n_pushers, pushes_each = 4, 8
+    errors = []
+    start = threading.Barrier(n_pushers + 2)   # pullers overlap the pushes
+
+    def pusher():
+        try:
+            import mxtpu as mx
+            my_kv = mx.kvstore.create("dist_async")   # own socket: true
+            start.wait(timeout=60)
+            for _ in range(pushes_each):              # server-side concurrency
+                my_kv.push("c", nd.array(np.ones((64, 64), np.float32)))
+        except Exception as e:              # pragma: no cover
+            errors.append(e)
+
+    def puller():
+        try:
+            import mxtpu as mx
+            my_kv = mx.kvstore.create("dist_async")
+            start.wait(timeout=60)
+            for _ in range(12):
+                out = nd.zeros((64, 64))
+                my_kv.pull("c", out=out)
+                arr = out.asnumpy()
+                # torn snapshots would mix k and k+1 within one array
+                assert arr.min() == arr.max(), \
+                    f"torn snapshot: {arr.min()} vs {arr.max()}"
+        except Exception as e:              # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=pusher) for _ in range(n_pushers)] + \
+              [threading.Thread(target=puller) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    out = nd.zeros((64, 64))
+    kv.pull("c", out=out)
+    np.testing.assert_allclose(out.asnumpy(), n_pushers * pushes_each)

@@ -51,16 +51,11 @@ def test_sparse_fm_converges():
     assert acc > 0.78, f"FM accuracy {acc}"
 
 
-def _run_example(script, args, timeout=280, virtual_devices=False):
+def _run_example(script, args, timeout=280, virtual_devices=0):
     import subprocess
-    # do NOT inherit conftest's 8-virtual-device XLA_FLAGS: on a 1-core
-    # harness VM eight device threads contend and slow examples ~8x; only
-    # mesh-using examples ask for them
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    if virtual_devices:
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    from conftest import subprocess_env
+    env = subprocess_env(virtual_devices)
     r = subprocess.run([sys.executable, os.path.join(REPO, "examples", script)]
                        + args, capture_output=True, text=True, timeout=timeout,
                        env=env, cwd=REPO)
@@ -78,8 +73,11 @@ def test_train_mnist_example():
 
 def test_train_gluon_sharded_example():
     out = _run_example("train_gluon_sharded.py", ["--steps", "12"],
-                       virtual_devices=True)
+                       virtual_devices=4)
     assert "mesh=dp" in out
+    losses = [float(l.split()[-1]) for l in out.splitlines()
+              if l.strip().startswith("step")]
+    assert losses and losses[-1] < losses[0], losses
 
 
 def test_train_ssd_toy_example():
@@ -87,12 +85,15 @@ def test_train_ssd_toy_example():
                        ["--steps", "60", "--batch-size", "8"], timeout=520)
     last = out.strip().splitlines()[-1]
     assert "mean IoU" in last, out[-1500:]
+    iou = float(last.split("mean IoU")[1].split(";")[0])
+    assert iou > 0.3, last
 
 
 def test_quantize_inference_example():
     out = _run_example("quantize_inference.py", [])
     lines = {l.split(":")[0].strip(): l for l in out.strip().splitlines()
              if ":" in l}
-    assert "fp32 acc" in lines and "int8 acc" in lines, out[-1500:]
+    assert "fp32 acc" in lines and "int8 acc" in lines \
+        and "agreement" in lines, out[-1500:]
     agree = float(lines["agreement"].split()[-1])
     assert agree > 0.9, out[-1500:]
