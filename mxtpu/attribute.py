@@ -11,10 +11,11 @@ PlaceDevice inserting _CrossDeviceCopy). On TPU the placement capability maps
 to sharding: annotate parameters via ``DataParallelTrainer(param_shardings=…)``
 and GSPMD places the compute — there is no cross-device copy node to insert.
 AttrScope itself is kept at full fidelity: scoped attrs are merged into every
-node created inside the scope (user attrs use the reference's ``__name__``
-mangling, so they serialize with the graph, round-trip through JSON, and are
-visible to ``Symbol.attr``/``attr_dict`` — e.g. for a sharding policy keyed on
-``__ctx_group__``).
+node created inside the scope under their PLAIN names (reference
+attribute.py:52 ``AttrScope.get`` stores ``kwargs`` unmangled), so they
+serialize with the graph, round-trip through JSON, and are visible to
+``Symbol.attr('ctx_group')``/``attr_dict``/``list_attr`` exactly as
+reference-style migration code expects.
 """
 
 from __future__ import annotations
@@ -31,8 +32,8 @@ class AttrScope:
     """Context manager attaching attributes to symbols created in scope.
 
     Attribute values must be strings (reference attribute.py:40 enforces this
-    so graphs serialize portably). Names are mangled to ``__name__`` like the
-    reference's AttrScope.get, keeping user attrs disjoint from op config.
+    so graphs serialize portably). Names are stored unmangled, matching the
+    reference's AttrScope.get — ``sym.attr('ctx_group')`` must find them.
     """
 
     def __init__(self, **kwargs):
@@ -41,7 +42,7 @@ class AttrScope:
                 raise ValueError(
                     f"AttrScope value for {k!r} must be a string, got "
                     f"{type(v).__name__}")
-        self._attrs = {f"__{k}__": v for k, v in kwargs.items()}
+        self._attrs = dict(kwargs)
         self._prev: Optional[Dict[str, str]] = None
 
     def __enter__(self) -> "AttrScope":

@@ -141,12 +141,48 @@ class Registry:
         return sorted(self._registry)
 
 
+def capture_init_spec(cls):
+    """Wrap ``cls.__init__`` to record the outermost constructor call's
+    ``(args, kwargs)`` on the instance as ``_init_spec`` — the parameter
+    server's restricted wire format (``ps.serialize_optimizer``) re-creates
+    objects from this spec instead of shipping pickle. Applied from
+    ``__init_subclass__`` so every subclass is covered; the guard keeps inner
+    ``super().__init__`` calls from overwriting the outermost spec."""
+    import functools
+    init = cls.__dict__.get("__init__")
+    if init is None or getattr(init, "_captures_spec", False):
+        return
+
+    @functools.wraps(init)
+    def wrapped(self, *args, **kwargs):
+        if not hasattr(self, "_init_spec"):
+            self._init_spec = (args, dict(kwargs))
+        init(self, *args, **kwargs)
+
+    wrapped._captures_spec = True
+    cls.__init__ = wrapped
+
+
 # ---------------------------------------------------------------------------
 # misc
 # ---------------------------------------------------------------------------
 
 class MXTPUError(RuntimeError):
     """Framework-level error (the reference surfaces dmlc::Error through MXGetLastError)."""
+
+
+class NotImplementedForSymbol(MXTPUError):
+    """Raised when an NDArray-only dunder is used on a Symbol (reference
+    ``base.py`` NotImplementedForSymbol; e.g. ``bool(sym)`` — comparison
+    symbols build graph nodes, so truthiness must fail loudly)."""
+
+    def __init__(self, function, alias=None, *args):
+        name = getattr(function, "__name__", str(function))
+        msg = f"Function {name}"
+        if alias:
+            msg += f" (namely operator '{alias}')"
+        msg += " is not implemented for Symbol and only available in NDArray."
+        super().__init__(msg)
 
 
 def check(cond: bool, msg: str = "check failed"):

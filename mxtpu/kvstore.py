@@ -71,9 +71,16 @@ class KVStore:
             self._ps_rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
             host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
             port = ps_mod.default_port()
+            if port == 0 and self._ps_world > 1:
+                # non-zero ranks derive the port from env alone — an ephemeral
+                # binding on rank 0 could never be discovered by them
+                raise ValueError(
+                    "MXTPU_PS_PORT=0 (ephemeral) is only valid single-worker: "
+                    "with DMLC_NUM_WORKER>1 every rank must share a concrete "
+                    "port; set MXTPU_PS_PORT or DMLC_PS_ROOT_PORT")
             if self._ps_rank == 0:
-                # port 0 (ephemeral) works single-host: the bound port is read
-                # back; multi-process launches carry a concrete port in env
+                # port 0 (ephemeral) works single-worker: the bound port is
+                # read back from the socket
                 port = ps_mod.start_server(port, self._ps_world).port
             self._ps = ps_mod.PSClient(host, port)
         self.type = kv_type

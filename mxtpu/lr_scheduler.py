@@ -4,16 +4,25 @@ from __future__ import annotations
 
 import math
 
+from .base import capture_init_spec
+
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler", "PolyScheduler",
            "CosineScheduler", "WarmupScheduler"]
 
 
 class LRScheduler:
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        capture_init_spec(cls)
+
     def __init__(self, base_lr: float = 0.01):
         self.base_lr = base_lr
 
     def __call__(self, num_update: int) -> float:
         raise NotImplementedError
+
+
+capture_init_spec(LRScheduler)
 
 
 class FactorScheduler(LRScheduler):

@@ -18,7 +18,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .base import Registry
+from .base import Registry, capture_init_spec
 from .lr_scheduler import LRScheduler
 from .ndarray.ndarray import NDArray
 
@@ -33,6 +33,10 @@ def create(name, **kwargs) -> "Optimizer":
 
 
 class Optimizer:
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        capture_init_spec(cls)
+
     def __init__(self, learning_rate: float = 0.01, wd: float = 0.0,
                  rescale_grad: float = 1.0, clip_gradient: Optional[float] = None,
                  lr_scheduler: Optional[LRScheduler] = None,
@@ -200,6 +204,11 @@ class Optimizer:
 
     def update_multi_precision(self, index, weight, grad, state):
         return self.update(index, weight, grad, state)
+
+
+# subclasses WITHOUT their own __init__ (SGLD, NAG, Test, …) reach the base
+# ctor directly — wrap it too so their spec is still captured
+capture_init_spec(Optimizer)
 
 
 @register(name="sgd")

@@ -6,22 +6,34 @@ contrast to sync's aggregate-then-apply at :283-295), giving Hogwild-style
 asynchronous SGD across workers. XLA collectives cannot express that — they
 are bulk-synchronous — so the TPU-native design runs the server where the
 reference ran it: ON THE HOST. Rank 0 owns a TCP server thread holding the
-authoritative numpy copy of every key; workers' pushes apply the (pickled,
-importable) optimizer immediately on arrival; pulls read the current state.
+authoritative numpy copy of every key; workers' pushes apply the serialized
+optimizer immediately on arrival; pulls read the current state.
 The accelerators stay busy on compute while parameter traffic rides the host
 NIC exactly like ps-lite's ZMQ transport.
 
-Wire protocol (little-endian, no pickle except the SET_OPTIMIZER payload):
+Wire protocol (little-endian):
   request  = u8 cmd | u16 keylen | key utf8 | u32 metalen | meta | u64 len | payload
   response = u8 status | u32 metalen | meta | u64 len | payload
 meta is the ascii "dtype:shape,shape,..." descriptor of the array payload.
-Commands: 0 INIT (first-wins), 1 PUSH (apply updater), 2 PULL, 3 SET_OPTIMIZER
-(pickled mxtpu optimizer), 4 BARRIER (blocks until world_size arrivals).
+Commands: 0 INIT (first-wins), 1 PUSH (apply updater), 2 PULL, 3 SET_OPTIMIZER,
+4 BARRIER (blocks until world_size arrivals).
+
+The SET_OPTIMIZER payload is a restricted spec — ``b"J" + json`` carrying the
+optimizer's registry name and its captured constructor arguments (re-instantiated
+through ``optimizer.create``; LR schedulers are encoded the same way, resolved
+only against ``mxtpu.lr_scheduler`` classes). Arbitrary pickle is NOT accepted
+unless both sides share ``MXTPU_PS_SECRET``, in which case an HMAC-SHA256-signed
+pickle (``b"P" + mac + body``) is allowed for exotic optimizers whose ctor args
+aren't JSON scalars. The server binds the interface named by DMLC_PS_ROOT_URI
+(default loopback), not 0.0.0.0 — unauthenticated remote reachability plus
+pickle was an RCE surface (round-3 advisor finding).
 """
 
 from __future__ import annotations
 
-import io
+import hmac
+import json
+import os
 import pickle
 import socket
 import struct
@@ -39,10 +51,119 @@ STATUS_OK, STATUS_ERR = 0, 1
 
 def default_port() -> int:
     """PS port derived from the launcher contract (coordinator port + 1)."""
-    import os
     return int(os.environ.get("MXTPU_PS_PORT",
                               int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
                               + 1))
+
+
+def default_bind_host() -> str:
+    """The interface the server binds: the launcher's root URI (it names rank
+    0's address), falling back to loopback — never 0.0.0.0."""
+    return os.environ.get("MXTPU_PS_BIND",
+                          os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"))
+
+
+# ---- restricted optimizer serialization ------------------------------------
+def _spec_value(v):
+    """JSON-encode one ctor argument; LRSchedulers become tagged specs."""
+    from . import lr_scheduler as lrs_mod
+    if isinstance(v, lrs_mod.LRScheduler):
+        if getattr(lrs_mod, type(v).__name__, None) is not type(v):
+            # a user-defined scheduler would serialize by bare name but could
+            # never resolve server-side — fail here so the signed-pickle
+            # fallback is actually reachable
+            raise TypeError(f"scheduler {type(v).__name__} is not an "
+                            f"mxtpu.lr_scheduler class")
+        args, kwargs = getattr(v, "_init_spec", ((), {}))
+        return {"__lr_scheduler__": type(v).__name__,
+                "args": [_spec_value(a) for a in args],
+                "kwargs": {k: _spec_value(x) for k, x in kwargs.items()}}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_spec_value(x) for x in v]
+    raise TypeError(f"cannot serialize optimizer ctor argument {v!r} for the "
+                    f"restricted wire format")
+
+
+def _spec_resolve(v):
+    from . import lr_scheduler as lrs_mod
+    if isinstance(v, dict) and "__lr_scheduler__" in v:
+        cls = getattr(lrs_mod, v["__lr_scheduler__"], None)
+        if cls is None or not (isinstance(cls, type)
+                               and issubclass(cls, lrs_mod.LRScheduler)):
+            raise ValueError(f"unknown lr scheduler {v['__lr_scheduler__']!r}")
+        return cls(*[_spec_resolve(a) for a in v["args"]],
+                   **{k: _spec_resolve(x) for k, x in v["kwargs"].items()})
+    if isinstance(v, list):
+        return [_spec_resolve(x) for x in v]
+    return v
+
+
+def serialize_optimizer(opt) -> bytes:
+    """Optimizer → wire bytes: restricted JSON spec, or HMAC-signed pickle when
+    MXTPU_PS_SECRET is shared (for ctor args the JSON form can't carry)."""
+    from . import optimizer as opt_mod
+    try:
+        name = next(k for k, c in opt_mod.registry._registry.items()
+                    if c is type(opt))
+        args, kwargs = opt._init_spec   # always set (base __init__ captures)
+        spec = {"name": name, "args": [_spec_value(a) for a in args],
+                "kwargs": {k: _spec_value(v) for k, v in kwargs.items()},
+                # post-construction mutations the ctor spec can't carry
+                # (reference pickle transport shipped the whole object)
+                "state": {"lr": opt.lr, "wd": opt.wd,
+                          "rescale_grad": opt.rescale_grad,
+                          "clip_gradient": opt.clip_gradient,
+                          "num_update": opt.num_update,
+                          "lr_mult": [[_spec_value(k), v]
+                                      for k, v in opt.lr_mult.items()],
+                          "wd_mult": [[_spec_value(k), v]
+                                      for k, v in opt.wd_mult.items()]}}
+        return b"J" + json.dumps(spec).encode()
+    except (TypeError, StopIteration) as e:
+        secret = os.environ.get("MXTPU_PS_SECRET", "")
+        if not secret:
+            raise TypeError(
+                f"optimizer {type(opt).__name__} cannot use the restricted "
+                f"wire format ({e}); set MXTPU_PS_SECRET on every rank to "
+                f"allow HMAC-authenticated pickle transport") from e
+        body = pickle.dumps(opt)
+        mac = hmac.new(secret.encode(), body, "sha256").digest()
+        return b"P" + mac + body
+
+
+def deserialize_optimizer(payload: bytes):
+    from . import optimizer as opt_mod
+    tag, body = payload[:1], payload[1:]
+    if tag == b"J":
+        spec = json.loads(body.decode())
+        opt = opt_mod.registry.get(spec["name"])(
+            *[_spec_resolve(a) for a in spec["args"]],
+            **{k: _spec_resolve(v) for k, v in spec["kwargs"].items()})
+        st = spec.get("state")
+        if st:
+            opt.set_learning_rate(st["lr"])
+            opt.wd = st["wd"]
+            opt.rescale_grad = st["rescale_grad"]
+            opt.clip_gradient = st["clip_gradient"]
+            opt.num_update = st["num_update"]
+            opt.set_lr_mult({k: v for k, v in st["lr_mult"]})
+            opt.set_wd_mult({k: v for k, v in st["wd_mult"]})
+        return opt
+    if tag == b"P":
+        secret = os.environ.get("MXTPU_PS_SECRET", "")
+        if not secret:
+            raise PermissionError(
+                "signed-pickle optimizer payload refused: MXTPU_PS_SECRET is "
+                "not set on the server")
+        mac, body = body[:32], body[32:]
+        if not hmac.compare_digest(
+                mac, hmac.new(secret.encode(), body, "sha256").digest()):
+            raise PermissionError("optimizer payload HMAC mismatch")
+        return pickle.loads(body)
+    raise ValueError("unrecognized optimizer payload (legacy raw pickle is "
+                     "no longer accepted)")
 
 
 # ---- framing ---------------------------------------------------------------
@@ -79,8 +200,9 @@ def _send_msg(sock: socket.socket, head: bytes, meta: bytes, payload: bytes):
 class ParamServer:
     """The rank-0 server thread pool (one thread per worker connection)."""
 
-    def __init__(self, port: int, world_size: int):
+    def __init__(self, port: int, world_size: int, host: Optional[str] = None):
         self.world_size = world_size
+        host = host if host is not None else default_bind_host()
         self._store: Dict[str, np.ndarray] = {}
         self._updater = None          # (key, grad ndarray, stored NDArray-like)
         self._updater_obj = None      # the Updater (state save/load)
@@ -88,7 +210,7 @@ class ParamServer:
         self._barrier = threading.Barrier(world_size)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("0.0.0.0", port))
+        self._sock.bind((host, port))
         self._sock.listen(world_size + 4)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
@@ -186,7 +308,7 @@ class ParamServer:
 
     def _set_optimizer_bytes(self, payload: bytes):
         from . import optimizer as opt_mod
-        opt = pickle.loads(payload)
+        opt = deserialize_optimizer(payload)
         updater = opt_mod.get_updater(opt)
 
         def apply(key, grad, stored):
@@ -212,20 +334,28 @@ class PSClient:
     """One worker's persistent connection to the parameter server."""
 
     def __init__(self, host: str, port: int, timeout: float = 300.0,
-                 retries: int = 50):
+                 connect_deadline: float = 60.0):
         import time
+        # time-based deadline, not a fixed attempt count: rank 0's server may
+        # take tens of seconds to come up in multi-host launches
+        deadline = time.monotonic() + connect_deadline
         last = None
-        for _ in range(retries):           # the server may still be binding
+        while True:
+            # cap each attempt at the remaining deadline so a blackholed SYN
+            # can't stretch one connect() past the promised window
+            remaining = deadline - time.monotonic()
             try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=timeout)
+                self._sock = socket.create_connection(
+                    (host, port), timeout=min(timeout, max(0.5, remaining)))
+                self._sock.settimeout(timeout)   # operational timeout
                 break
             except OSError as e:
                 last = e
-                time.sleep(0.1)
-        else:
-            raise ConnectionError(f"cannot reach param server "
-                                  f"{host}:{port}: {last}")
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"cannot reach param server {host}:{port} within "
+                        f"{connect_deadline:.0f}s: {last}") from e
+                time.sleep(0.2)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
 
@@ -264,7 +394,7 @@ class PSClient:
         return self._request(CMD_PULL, key)
 
     def set_optimizer(self, optimizer):
-        self._request(CMD_SET_OPT, "", raw=pickle.dumps(optimizer))
+        self._request(CMD_SET_OPT, "", raw=serialize_optimizer(optimizer))
 
     def get_optimizer_states(self) -> bytes:
         return self._request_raw(CMD_GET_STATES)[1]

@@ -59,13 +59,19 @@ def _reset_names():  # test helper (NameManager parity)
 
 
 class _Node:
-    """One DAG node: a variable (op_key None) or an op application."""
+    """One DAG node: a variable (op_key None) or an op application.
+
+    ``attrs`` holds op config AND user/scope attrs (both visible to
+    ``Symbol.attr``, as in the reference); ``user_keys`` names the subset that
+    is user metadata (AttrScope / ``attr=``) so op-kwarg extraction skips it —
+    user attrs keep their plain reference names (``ctx_group``, not
+    ``__ctx_group__``)."""
 
     __slots__ = ("op_key", "name", "attrs", "inputs", "input_params", "is_aux",
-                 "num_outputs")
+                 "num_outputs", "user_keys")
 
     def __init__(self, op_key, name, attrs=None, inputs=(), input_params=(),
-                 is_aux=False, num_outputs=1):
+                 is_aux=False, num_outputs=1, user_keys=()):
         self.op_key = op_key
         self.name = name
         self.attrs = dict(attrs or {})
@@ -73,6 +79,14 @@ class _Node:
         self.input_params = list(input_params)  # param name per input; "*" varargs
         self.is_aux = is_aux
         self.num_outputs = num_outputs
+        self.user_keys = frozenset(user_keys)
+
+
+def _op_attrs(node: _Node) -> dict:
+    """The op-kwarg subset of a node's attrs: internal ``__*__`` markers and
+    user/scope attrs excluded."""
+    return {k: v for k, v in node.attrs.items()
+            if not k.startswith("__") and k not in node.user_keys}
 
 
 def _tensor_params(op) -> List[str]:
@@ -205,7 +219,7 @@ def eval_graph(heads, feed: Dict[str, Any], is_train: bool = False,
                 var_args.append(val)
             else:
                 kw[pname] = val
-        attrs = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+        attrs = _op_attrs(node)
         if node.op_key == "BatchNorm" and is_train \
                 and not attrs.get("use_global_stats", False):
             res, mean, v = _reg.get_op("batch_norm_train").fn(
@@ -374,7 +388,7 @@ class Symbol:
                     known[child.name] = tuple(int(x) for x in derived[pname])
                     memo[id(child)] = (known[child.name],)
                     in_shapes[pname] = known[child.name]
-            attrs = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+            attrs = _op_attrs(node)
             if op.resolve_kwargs is not None:
                 attrs = op.resolve_kwargs(attrs)
 
@@ -469,6 +483,7 @@ class Symbol:
                 "param_names": list(n.input_params),
                 "is_aux": n.is_aux,
                 "num_outputs": n.num_outputs,
+                "user_keys": sorted(n.user_keys),
             })
         payload = {
             "nodes": out_nodes,
@@ -550,6 +565,14 @@ class Symbol:
     def __hash__(self):
         return id(self)
 
+    def __bool__(self):
+        # reference symbol.py:107: since __eq__ builds a graph node, truthiness
+        # of `a == b` would silently be True for any pair — raise instead
+        from ..base import NotImplementedForSymbol
+        raise NotImplementedForSymbol(self.__bool__, "bool")
+
+    __nonzero__ = __bool__
+
     def __gt__(self, other):
         if isinstance(other, (int, float)):
             return self._scalar_op("_greater_scalar", other)
@@ -587,11 +610,12 @@ def _req_of(grad_req, name, arg_names):
 def Variable(name: str, attr=None, shape=None, dtype=None, init=None,
              stype=None, **kwargs) -> Symbol:
     attrs = _with_scope_attrs(attr)
+    user_keys = set(attrs)
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
         attrs["__dtype__"] = dtype_name(dtype_np(dtype))
-    node = _Node(None, name, attrs)
+    node = _Node(None, name, attrs, user_keys=user_keys)
     return Symbol([(node, 0)])
 
 
@@ -617,7 +641,9 @@ def _apply_op(op, op_key: str, sym_args: Sequence[Symbol], attrs: dict,
     """Create an op node from positional Symbol inputs + attr kwargs.
     Operator-overload nodes inherit ambient AttrScope attrs like every other
     frontend-created symbol."""
-    attrs = dict(_with_scope_attrs(None), **attrs)
+    scope = _with_scope_attrs(None)
+    user_keys = set(scope) - set(attrs)   # an op kwarg shadowing a scope name wins
+    attrs = dict(scope, **attrs)
     name = name or _auto_name(_base_name(op_key))
     tparams = _tensor_params(op)
     inputs, input_params = [], []
@@ -631,7 +657,8 @@ def _apply_op(op, op_key: str, sym_args: Sequence[Symbol], attrs: dict,
             input_params.append(pname)
     n_out = op.num_outputs if op.num_outputs > 0 else \
         int(attrs.get("num_outputs", 1))
-    node = _Node(op_key, name, attrs, inputs, input_params, num_outputs=n_out)
+    node = _Node(op_key, name, attrs, inputs, input_params, num_outputs=n_out,
+                 user_keys=user_keys)
     if n_out == 1:
         return Symbol([(node, 0)])
     return Symbol([(node, i) for i in range(n_out)])
@@ -673,9 +700,11 @@ def make_op_wrapper(op_key: str):
                 input_params.append(pname)
         n_out = op.num_outputs if op.num_outputs > 0 else \
             int(attrs.get("num_outputs", 1))
-        node_attrs = dict(_with_scope_attrs(attr), **attrs)
+        scope = _with_scope_attrs(attr)
+        node_attrs = dict(scope, **attrs)
         node = _Node(op_key, name, node_attrs, inputs,
-                     input_params, num_outputs=n_out)
+                     input_params, num_outputs=n_out,
+                     user_keys=set(scope) - set(attrs))
         if n_out == 1:
             return Symbol([(node, 0)])
         return Symbol([(node, i) for i in range(n_out)])
@@ -700,7 +729,8 @@ def load_json(json_str: str) -> Symbol:
         attrs = {k: _parse_attr(v) for k, v in spec.get("attrs", {}).items()}
         node = _Node(None if spec["op"] == "null" else spec["op"], spec["name"],
                      attrs, is_aux=spec.get("is_aux", False),
-                     num_outputs=spec.get("num_outputs", 1))
+                     num_outputs=spec.get("num_outputs", 1),
+                     user_keys=spec.get("user_keys", ()))
         node.inputs = [(nodes[i], j) for i, j in spec.get("inputs", [])]
         node.input_params = list(spec.get("param_names", []))
         nodes.append(node)

@@ -226,3 +226,19 @@ def test_symbolblock_imports_export(tmp_path):
     with autograd.predict_mode():
         out = blk2(x, y).asnumpy()
     np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_symbol_bool_raises():
+    """bool(sym) must raise (reference symbol.py:107 NotImplementedForSymbol):
+    __eq__ builds a graph node, so `if a == b:` / `sym in list` would silently
+    be truthy otherwise."""
+    import pytest
+    from mxtpu.base import NotImplementedForSymbol
+    a, b = sym.Variable("a"), sym.Variable("b")
+    with pytest.raises(NotImplementedForSymbol):
+        bool(a == b)
+    with pytest.raises(NotImplementedForSymbol):
+        if a:                                    # noqa: B015 — the point
+            pass
+    with pytest.raises(NotImplementedForSymbol):
+        a in [b]
