@@ -366,6 +366,110 @@ def _out_like(node, j):
     return outs
 
 
+def _run_backward_create_graph(heads, head_grads, collect_vars,
+                               retain_graph=True):
+    """Backward pass that RECORDS itself: each node's vjp replay is appended to
+    the tape as a pure node, and cotangent accumulation is a recorded add, so
+    ``grad``/``backward`` over the returned grads differentiates through this
+    pass (``create_graph=True``, reference autograd.py:270-307 — the docstring
+    example there is literally grad-of-grad).
+
+    The original tape is kept (reference: ``retain_graph`` defaults to
+    ``create_graph``); nodes with an explicit host-side ``backward_fn``
+    (custom ``Function``) raise, matching the reference's per-op "does not
+    support second order" errors for ops without a differentiable FGradient.
+    """
+    from .ndarray.ndarray import NDArray
+    st = _st()
+    tape_snapshot = list(st.tape)
+    cots: dict = {}                       # entry key -> NDArray (tracked)
+
+    def shim(raw, entry):
+        h = NDArray(raw)
+        h._grad_entry = entry
+        return h
+
+    def accum_nd(a: NDArray, b: NDArray) -> NDArray:
+        out = NDArray(a.data + b.data)
+        record_custom_node(lambda x, y: x + y, [a, b], [out])
+        return out
+
+    def as_nd(g, like):
+        if isinstance(g, NDArray):
+            return g
+        return NDArray(jnp.asarray(g, dtype=like.dtype))
+
+    for i, h in enumerate(heads):
+        entry = h._grad_entry
+        if entry is None:
+            continue
+        hg = None if head_grads is None else head_grads[i]
+        cot = NDArray(jnp.ones_like(h.data)) if hg is None else as_nd(hg, h.data)
+        k = _entry_key(entry)
+        cots[k] = accum_nd(cots[k], cot) if k in cots else cot
+
+    for node in reversed(tape_snapshot):
+        out_keys = [("out", id(node), j) for j in range(node.n_outputs)]
+        if not any(k in cots for k in out_keys):
+            continue
+        if node.backward_fn is not None:
+            raise NotImplementedError(
+                "create_graph=True through a custom Function / explicit "
+                "backward is not supported: its backward is host code the "
+                "tape cannot differentiate (the reference likewise raises "
+                "for ops without a second-order FGradient)")
+        n_in = len(node.raw_inputs)
+
+        def vjp_replay(*raw, _node=node, _n_in=n_in):
+            ins, cs = raw[:_n_in], raw[_n_in:]
+            outs, vjp_fn = jax.vjp(_node.pure_fn, *ins)
+            # tuple-ness resolved inside the trace — no extra eval_shape
+            return vjp_fn(tuple(cs) if isinstance(outs, (tuple, list))
+                          else cs[0])
+
+        in_handles = [shim(r, e) for r, e in
+                      zip(node.raw_inputs, node.parent_entries)]
+        out_struct = None                  # traced lazily, only for zero-fill
+        cot_handles = []
+        for j, k in enumerate(out_keys):
+            g = cots.get(k)
+            if g is None:
+                if out_struct is None:
+                    out_struct = jax.eval_shape(node.pure_fn, *node.raw_inputs)
+                s = out_struct[j] if isinstance(out_struct, (tuple, list)) \
+                    else out_struct
+                g = NDArray(jnp.zeros(s.shape, s.dtype))
+            cot_handles.append(g)
+        raw_grads = vjp_replay(*[h.data for h in in_handles],
+                               *[h.data for h in cot_handles])
+        grad_handles = [NDArray(g) for g in raw_grads]
+        record_custom_node(vjp_replay, in_handles + cot_handles, grad_handles)
+        for entry, gh in zip(node.parent_entries, grad_handles):
+            if entry is None:
+                continue
+            k = _entry_key(entry)
+            cots[k] = accum_nd(cots[k], gh) if k in cots else gh
+
+    for h in st.retained:
+        entry = h._grad_entry
+        if entry is not None and _entry_key(entry) in cots:
+            h._grad = cots[_entry_key(entry)]
+    results = []
+    for v in collect_vars:
+        entry = v._grad_entry
+        k = _entry_key(entry) if isinstance(entry, _VariableEntry) else None
+        g = cots.get(k) if k else None
+        results.append(g if g is not None else NDArray(jnp.zeros_like(v._data)))
+    if not retain_graph:
+        # explicit retain_graph=False overrides the create_graph default: the
+        # caller is done with this graph — free it (a later backward through
+        # the returned grads raises "graph has been freed" loudly, and a loop
+        # of create_graph calls doesn't grow the tape without bound)
+        st.tape = []
+        st.retained = []
+    return results
+
+
 def backward(heads, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True):
     """mx.autograd.backward parity: accumulate into attach_grad'ed ``.grad`` buffers."""
@@ -379,27 +483,78 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
          train_mode: bool = True):
     """mx.autograd.grad parity: return grads w.r.t. ``variables``.
 
-    ``create_graph=True`` (grad-of-grad through the imperative tape) is not supported in
-    this round — use the functional ``mxtpu.jit.grad`` transform for higher-order
-    differentiation (jax.grad composes arbitrarily).
+    ``create_graph=True`` records the backward pass itself on the tape, so the
+    returned grads are differentiable — grad-of-grad, gradient penalties, and
+    d²/dx² compose through the imperative API exactly as in the reference
+    (python/mxnet/autograd.py:270-307). ``retain_graph`` defaults to
+    ``create_graph``.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use mxtpu.jit.grad (functional transform) for "
-            "higher-order gradients")
     heads = heads if isinstance(heads, (list, tuple)) else [heads]
     variables = variables if isinstance(variables, (list, tuple)) else [variables]
     if head_grads is not None and not isinstance(head_grads, (list, tuple)):
         head_grads = [head_grads]
-    retain = bool(retain_graph) if retain_graph is not None else False
-    return _run_backward(list(heads), head_grads, retain, train_mode,
+    retain = retain_graph if retain_graph is not None else create_graph
+    if create_graph:
+        return _run_backward_create_graph(list(heads), head_grads,
+                                          list(variables), bool(retain))
+    return _run_backward(list(heads), head_grads, bool(retain), train_mode,
                          collect_vars=list(variables))
 
 
 def get_symbol(x):
-    raise NotImplementedError(
-        "autograd.get_symbol: the recorded graph is jaxpr-based; use "
-        "mxtpu.jit.trace to export StableHLO instead")
+    """Debug view of the recorded graph that produced ``x`` (reference
+    autograd.get_symbol, python/mxnet/autograd.py:466 — returns a Symbol of
+    the recorded ops). Here the recorded closures are jaxpr-traceable, so the
+    faithful artifact is the jaxpr of the FULL producing subgraph, composed
+    from the tape as a function of the marked leaf variables — printable,
+    inspectable (``.jaxpr``, ``.in_avals``), and convertible to StableHLO via
+    ``mxtpu.jit.trace``.
+    """
+    entry = getattr(x, "_grad_entry", None)
+    if entry is None or isinstance(entry, _VariableEntry):
+        raise ValueError("get_symbol: array is not an output of a recorded "
+                         "computation")
+    target_node, target_j = entry
+    tape = _st().tape
+    # reverse reachability: the subgraph of tape nodes feeding the target
+    deps = {id(target_node)}
+    keep = {}
+    for node in reversed(tape):
+        if id(node) not in deps:
+            continue
+        keep[id(node)] = node
+        for e in node.parent_entries:
+            if isinstance(e, tuple):
+                deps.add(id(e[0]))
+    ordered = [n for n in tape if id(n) in keep]
+    leaves: List[_VariableEntry] = []
+    for n in ordered:
+        if n.pure_fn is None:
+            raise ValueError("get_symbol: subgraph contains an opaque custom "
+                             "Function node")
+        for e in n.parent_entries:
+            if isinstance(e, _VariableEntry) and e not in leaves:
+                leaves.append(e)
+
+    def full_fn(*leaf_vals):
+        lv = {id(e): v for e, v in zip(leaves, leaf_vals)}
+        env = {}
+        for n in ordered:
+            ins = []
+            for raw, e in zip(n.raw_inputs, n.parent_entries):
+                if isinstance(e, _VariableEntry):
+                    ins.append(lv[id(e)])
+                elif isinstance(e, tuple):
+                    ins.append(env[(id(e[0]), e[1])])
+                else:
+                    ins.append(raw)
+            out = n.pure_fn(*ins)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for j, o in enumerate(outs):
+                env[(id(n), j)] = o
+        return env[(id(target_node), target_j)]
+
+    return jax.make_jaxpr(full_fn)(*[e.handle.data for e in leaves])
 
 
 # ---------------------------------------------------------------------------

@@ -1,6 +1,7 @@
 """Autograd tests — modeled on tests/python/unittest/test_autograd.py of the reference."""
 
 import numpy as np
+import pytest
 
 import mxtpu as mx
 from mxtpu import autograd, nd
@@ -172,3 +173,145 @@ def test_matmul_grad():
                                np.ones((2, 4)) @ b.asnumpy().T, rtol=1e-5)
     np.testing.assert_allclose(b.grad.asnumpy(),
                                a.asnumpy().T @ np.ones((2, 4)), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# create_graph=True — higher-order autograd through the imperative tape
+# (reference python/mxnet/autograd.py:270-307; the docstring example there is
+# grad-of-grad)
+# ---------------------------------------------------------------------------
+
+
+def test_create_graph_second_derivative_polynomial():
+    """d2/dx2 of x^3 + 2x^2 - 5x is 6x + 4."""
+    x = nd.array(np.array([1.0, -2.0, 0.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x + 2.0 * x * x - 5.0 * x
+        dy_dx = autograd.grad(y, x, create_graph=True)[0]
+        z = nd.sum(dy_dx)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy() + 4,
+                               rtol=1e-5)
+    # first derivative values were right too: 3x^2 + 4x - 5
+    np.testing.assert_allclose(dy_dx.asnumpy(),
+                               3 * x.asnumpy() ** 2 + 4 * x.asnumpy() - 5,
+                               rtol=1e-5)
+
+
+def test_create_graph_grad_of_grad():
+    """Triple-nested grad: d3/dx3 of x^4 = 24x, via two create_graph passes."""
+    x = nd.array(np.array([1.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x
+        g1 = autograd.grad(y, x, create_graph=True)[0]      # 4x^3
+        g2 = autograd.grad(g1, x, create_graph=True)[0]     # 12x^2
+        z = nd.sum(g2)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 24 * x.asnumpy(), rtol=1e-5)
+
+
+def test_create_graph_through_dense_net():
+    """grad-of-grad through a gluon Dense stack matches jax.grad composition."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxtpu import gluon
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="tanh"),
+            gluon.nn.Dense(1))
+    net.initialize()
+    xv = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(net(x))
+        gx = autograd.grad(y, x, create_graph=True)[0]
+        z = nd.sum(gx * gx)                 # gradient-norm^2 head
+    z.backward()
+
+    params = {p.name: p.data().data for p in net.collect_params().values()}
+    w1 = [v for k, v in params.items() if "dense0" in k and "weight" in k][0]
+    b1 = [v for k, v in params.items() if "dense0" in k and "bias" in k][0]
+    w2 = [v for k, v in params.items() if "dense1" in k and "weight" in k][0]
+    b2 = [v for k, v in params.items() if "dense1" in k and "bias" in k][0]
+
+    def f(xj):
+        h = jnp.tanh(xj @ w1.T + b1)
+        return jnp.sum(h @ w2.T + b2)
+
+    gx_ref = jax.grad(f)(jnp.asarray(xv))
+    z_ref_grad = jax.grad(lambda xj: jnp.sum(jax.grad(f)(xj) ** 2))(
+        jnp.asarray(xv))
+    np.testing.assert_allclose(gx.asnumpy(), np.asarray(gx_ref), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), np.asarray(z_ref_grad),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_create_graph_gradient_penalty_converges():
+    """A WGAN-GP-style objective: loss = (f(x) - y)^2 + |df/dx|^2 trained with
+    SGD must drive both the fit and the penalty down."""
+    from mxtpu import optimizer
+    rng = np.random.RandomState(3)
+    xv = rng.rand(16, 2).astype(np.float32)
+    yv = (xv @ np.array([[1.0], [-2.0]], np.float32)).astype(np.float32)
+    w = nd.array(rng.randn(2, 1).astype(np.float32) * 2.0)
+    w.attach_grad()
+    x = nd.array(xv)
+    opt = optimizer.SGD(learning_rate=0.5)
+    losses = []
+    for _ in range(80):
+        x.attach_grad()                      # fresh leaf each step
+        with autograd.record():
+            pred = nd.dot(x, w)
+            fit = nd.mean(nd.square(pred - nd.array(yv)))
+            gx = autograd.grad(nd.sum(pred), x, create_graph=True)[0]
+            penalty = nd.mean(nd.square(gx))
+            loss = fit + 0.001 * penalty
+        loss.backward()
+        opt.update(0, w, w.grad, opt.create_state(0, w))
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < 0.05 * losses[0], losses[::10]
+
+
+def test_create_graph_custom_function_raises():
+    class Square(autograd.Function):
+        def forward(self, x):
+            return x * x
+
+        def backward(self, dy):
+            return 2.0 * dy
+
+    x = nd.array(np.ones((3,), np.float32))
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+        with pytest.raises(NotImplementedError, match="custom Function"):
+            autograd.grad(nd.sum(y), x, create_graph=True)
+
+
+def test_get_symbol_returns_jaxpr():
+    x = nd.array(np.ones((3,), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * 2.0
+    rep = str(autograd.get_symbol(y))
+    assert "exp" in rep                       # a readable jaxpr of the producer
+    with pytest.raises(ValueError, match="not an output"):
+        autograd.get_symbol(x)
+
+
+def test_create_graph_explicit_no_retain_frees_tape():
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        g = autograd.grad(y, x, create_graph=True, retain_graph=False)[0]
+    np.testing.assert_allclose(g.asnumpy(), [4.0])
+    from mxtpu.autograd import _st
+    assert _st().tape == []                    # freed on explicit request
+    with pytest.raises(RuntimeError, match="freed"):
+        g.backward()
