@@ -58,3 +58,152 @@ def load_checkpoint(prefix: str, epoch: int):
         else:
             arg_params[k] = v
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy estimator over a Symbol (reference python/mxnet/model.py:452).
+
+    Deprecated there in favor of Module; kept for API parity. This
+    implementation delegates the training loop to ``mxtpu.module.Module`` —
+    the capability owner — while preserving the FeedForward surface:
+    numpy/NDArray ``X, y`` inputs auto-wrap in an ``NDArrayIter``
+    (model.py:629 ``_init_iter``), ``**kwargs`` flow to the optimizer, and
+    ``save``/``load``/``create`` use the prefix-epoch checkpoint layout.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        import warnings
+        warnings.warn("mxtpu.model.FeedForward is the deprecated reference "
+                      "surface; prefer mxtpu.module.Module",
+                      DeprecationWarning, stacklevel=2)
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    # -- data plumbing (model.py:629 _init_iter) ---------------------------
+    def _init_iter(self, X, y, is_train):
+        import numpy as np
+        from . import io as io_mod
+        if isinstance(X, NDArray):
+            X = X.asnumpy()
+        if isinstance(X, np.ndarray):
+            if y is None:
+                if is_train:
+                    raise ValueError("y must be specified when X is numpy")
+                y = np.zeros(X.shape[0])
+            if isinstance(y, NDArray):
+                y = y.asnumpy()
+            y = np.asarray(y)
+            if y.ndim == 2 and y.shape[1] == 1:
+                y = y.flatten()
+            batch = min(X.shape[0], self.numpy_batch_size)
+            return io_mod.NDArrayIter(X, y, batch, shuffle=is_train)
+        return X
+
+    def _get_module(self):
+        from .module import Module
+        if self._module is None:
+            self._module = Module(self.symbol)
+        return self._module
+
+    def _ensure_ready(self, data):
+        """Bind + load params for inference when the module hasn't been fit in
+        this process (reference model.py:602 ``_init_predictor``)."""
+        mod = self._get_module()
+        if not mod.binded:
+            mod.bind(data_shapes=data.provide_data,
+                     label_shapes=data.provide_label, for_training=False)
+        if not mod.params_initialized:
+            mod.init_params(initializer=self.initializer,
+                            arg_params=self.arg_params,
+                            aux_params=self.aux_params, allow_missing=False)
+        return mod
+
+    # -- estimator surface -------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        assert self.num_epoch is not None, "num_epoch required"
+        data = self._init_iter(X, y, is_train=True)
+        if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:
+            eval_data = self._init_iter(eval_data[0], eval_data[1],
+                                        is_train=False)
+        mod = self._get_module()
+        mod.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                eval_end_callback=eval_end_callback,
+                optimizer=self.optimizer,
+                optimizer_params=self.kwargs or None,
+                initializer=self.initializer, arg_params=self.arg_params,
+                aux_params=self.aux_params, allow_missing=True,
+                begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data = self._init_iter(X, None, is_train=False)
+        outs = self._ensure_ready(data).predict(data, num_batch=num_batch,
+                                                reset=reset)
+        if isinstance(outs, list):
+            # Module.predict returns one already-concatenated NDArray per
+            # graph output; multi-output nets return the list (reference
+            # model.py predict: outputs[0] if single else list)
+            if not outs:
+                return outs
+            arrs = [o.asnumpy() for o in outs]
+            return arrs[0] if len(arrs) == 1 else arrs
+        return outs.asnumpy()
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        data = self._init_iter(X, None, is_train=False)
+        res = self._ensure_ready(data).score(data, eval_metric,
+                                             num_batch=num_batch, reset=reset,
+                                             batch_end_callback=batch_end_callback)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        epoch = epoch if epoch is not None else self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Train a new model from scratch and return it (model.py:895)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

@@ -155,6 +155,9 @@ class BaseModule:
                     cb(epoch, getattr(self, "_symbol", None), arg, aux)
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric, epoch=epoch)
+                if eval_end_callback is not None:
+                    for cb in _as_list(eval_end_callback):
+                        cb(BatchEndParam(epoch, 0, validation_metric))
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
 

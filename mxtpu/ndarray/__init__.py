@@ -51,6 +51,11 @@ def _populate(namespace: str, module):
 
 _populate("", _this)
 
+# fused optimizer updates need the reference's in-place/mutable-state calling
+# convention — hand-written wrappers override the auto-generated pure ones
+from . import fused_optimizer as _fused_opt  # noqa: E402
+_fused_opt.install(_this)
+
 # one namespace list shared with mx.sym (registry.OP_NAMESPACES) so the two
 # frontends expose the same sub-surfaces
 for _ns in _reg.OP_NAMESPACES:

@@ -1,0 +1,99 @@
+"""``mx.nd`` fused optimizer updates — reference in-place calling convention.
+
+The reference exposes ``mx.nd.sgd_update(weight, grad, out=weight, lr=...)``
+with optimizer state tensors (mom/mean/var/z/n/d/delta/weight32) declared as
+MUTABLE inputs (``optimizer_op.cc:317`` ``FMutateInputs``): the op writes them
+in place and outputs only the weight. The pure kernels live in
+``ops/optimizer_ops.py``; this layer restores the mutation contract — states
+are written back through ``_set_data``, the weight result honors ``out=`` —
+and adds the reference's lazy row-sparse path (SGDDnsRspKernel /
+AdamDnsRspDnsKernel / FtrlDnsRspDnsKernel: only rows live in the row_sparse
+grad touch weight and state).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..ops import registry as _reg
+from .ndarray import NDArray
+
+# op name -> (state input names, supports lazy row-sparse grad)
+_FUSED = {
+    "sgd_update": ((), True),
+    "sgd_mom_update": (("mom",), True),
+    "mp_sgd_update": (("weight32",), False),
+    "mp_sgd_mom_update": (("mom", "weight32"), False),
+    "signsgd_update": ((), False),
+    "signum_update": (("mom",), False),
+    "adam_update": (("mean", "var"), True),
+    "ftml_update": (("d", "v", "z"), False),
+    "rmsprop_update": (("n",), False),
+    "rmspropalex_update": (("n", "g", "delta"), False),
+    "ftrl_update": (("z", "n"), True),
+}
+
+
+def _apply_dense(op, weight, grad, states: Sequence[NDArray], out, kwargs):
+    raw_states = [s.data for s in states]
+    res = op.fn(weight.data, grad.data, *raw_states, **kwargs)
+    res = res if isinstance(res, tuple) else (res,)
+    new_w, new_states = res[0], res[1:]
+    for s, ns in zip(states, new_states):
+        s._set_data(ns)
+    target = out if out is not None else weight
+    target._set_data(new_w.astype(target.dtype))
+    return target
+
+
+def _apply_lazy(op, weight, grad, states: Sequence[NDArray], out, kwargs):
+    """Row-slab update: gather live rows, run the dense kernel on the slab,
+    scatter back — weight and full-shape states only change on live rows
+    (reference *DnsRspDnsKernel semantics)."""
+    rows = grad._indices
+    vals = grad._values.astype(weight.dtype)
+    w = weight.data
+    row_like = [s.shape == weight.shape for s in states]
+    slab_states = [s.data[rows] if rl else s.data
+                   for s, rl in zip(states, row_like)]
+    res = op.fn(w[rows], vals, *slab_states, **kwargs)
+    res = res if isinstance(res, tuple) else (res,)
+    new_rows, new_states = res[0], res[1:]
+    for s, ns, rl in zip(states, new_states, row_like):
+        s._set_data(s.data.at[rows].set(ns) if rl else ns)
+    target = out if out is not None else weight
+    target._set_data(w.at[rows].set(new_rows.astype(w.dtype)))
+    return target
+
+
+def _make_fused(name: str, state_names, lazy_ok: bool):
+    import inspect
+    op = _reg.get_op(name)
+    kernel_takes_lazy = "lazy_update" in inspect.signature(op.fn).parameters
+
+    def fused(weight, grad, *states, out: Optional[NDArray] = None, **kwargs):
+        if len(states) != len(state_names):
+            raise TypeError(
+                f"{name} expects inputs (weight, grad"
+                + "".join(f", {s}" for s in state_names) + ")")
+        # lazy_update gates THIS wrapper's row-sparse path; only kernels that
+        # declare it (reference *Param structs) see it as an attr
+        lazy = (kwargs.pop("lazy_update", True) if not kernel_takes_lazy
+                else kwargs.get("lazy_update", True))
+        if getattr(grad, "stype", "default") == "row_sparse":
+            if not (lazy_ok and lazy):
+                grad = NDArray(grad._dense())
+            else:
+                return _apply_lazy(op, weight, grad, states, out, kwargs)
+        return _apply_dense(op, weight, grad, states, out, kwargs)
+
+    fused.__name__ = name
+    fused.__doc__ = op.doc
+    return fused
+
+
+def install(module):
+    """Bind the in-place wrappers into the ``mx.nd`` namespace (overriding the
+    auto-generated pure wrappers)."""
+    for name, (state_names, lazy_ok) in _FUSED.items():
+        setattr(module, name, _make_fused(name, state_names, lazy_ok))

@@ -20,3 +20,4 @@ from . import spatial  # noqa: F401
 from . import rnn  # noqa: F401
 from . import attention  # noqa: F401
 from . import image_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

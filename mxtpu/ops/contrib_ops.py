@@ -196,3 +196,57 @@ def _quadratic(data, a: float = 0.0, b: float = 0.0, c: float = 0.0):
     """contrib quadratic_op (the reference's custom-op tutorial op,
     src/operator/contrib/quadratic_op-inl.h): a*x^2 + b*x + c."""
     return a * data * data + b * data + c
+
+
+@register("bipartite_matching", namespace=NS, num_outputs=2,
+          differentiable=False, aliases=("_contrib_bipartite_matching",))
+def _bipartite_matching(data, threshold: float = 0.0, is_ascend: bool = False,
+                        topk: int = -1):
+    """Greedy bipartite matching on a score matrix (..., N, M)
+    (src/operator/contrib/bounding_box.cc:147 _contrib_bipartite_matching).
+
+    Walks (row, col) pairs in score order, assigning each pair whose row and
+    column are both unmatched; stops at the first below-threshold score with
+    free slots, or past ``topk`` matches (the reference kernel's exact stop
+    conditions, bounding_box-inl.h:721). Returns (row_match, col_match):
+    matched column index per row / row index per column, -1 when unmatched.
+    The sequential greedy scan runs as one ``lax.fori_loop`` per batch item
+    (vmapped) — static shapes, no host sync.
+    """
+    shape = data.shape
+    N, M = shape[-2], shape[-1]
+    flat = data.reshape((-1, N * M))
+
+    def one(scores):
+        order = jnp.argsort(jnp.where(is_ascend, scores, -scores),
+                            stable=True)
+        sorted_scores = scores[order]
+
+        def body(j, st):
+            rmark, cmark, count, active = st
+            idx = order[j]
+            r, c = idx // M, idx % M
+            sc = sorted_scores[j]
+            free = (rmark[r] < 0) & (cmark[c] < 0) & active
+            ok = jnp.where(is_ascend, sc < threshold, sc > threshold)
+            do = free & ok
+            rmark = rmark.at[r].set(jnp.where(do, c, rmark[r]))
+            cmark = cmark.at[c].set(jnp.where(do, r, cmark[c]))
+            count = count + do.astype(jnp.int32)
+            active = active & ~(free & ~ok)          # bad score on free pair
+            if topk > 0:
+                # strict topk (documented contract; the reference kernel's
+                # assign-then-check allows topk+1 — an upstream off-by-one we
+                # do not reproduce)
+                active = active & (count < topk)
+            return rmark, cmark, count, active
+
+        rmark = jnp.full((N,), -1, jnp.int32)
+        cmark = jnp.full((M,), -1, jnp.int32)
+        rmark, cmark, _, _ = jax.lax.fori_loop(
+            0, N * M, body, (rmark, cmark, jnp.int32(0), jnp.bool_(True)))
+        return rmark.astype(data.dtype), cmark.astype(data.dtype)
+
+    rows, cols = jax.vmap(one)(flat)
+    return (rows.reshape(shape[:-2] + (N,)),
+            cols.reshape(shape[:-2] + (M,)))

@@ -138,3 +138,29 @@ def _eigh(A):
 def _qr(A):
     q, r = jnp.linalg.qr(A)
     return q, r
+
+
+@register("gelqf", namespace=NS, num_outputs=2)
+def _gelqf(A):
+    """LQ factorization A = L·Q with row-orthonormal Q (x, y) and lower-
+    triangular L (x, x); outputs (Q, L) (reference la_op.cc:506 _linalg_gelqf).
+    Computed as the transpose of QR on Aᵀ — one MXU-friendly factorization."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+
+
+@register("syevd", namespace=NS, num_outputs=2)
+def _syevd(A):
+    """Symmetric eigendecomposition A = Uᵀ·diag(L)·U — ROWS of U are the
+    eigenvectors (reference la_op.cc _linalg_syevd convention; jnp.linalg.eigh
+    returns column eigenvectors, so U is its transpose). Outputs (U, L)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+# reference root-level names (la_op.cc add_alias "linalg_gelqf" etc.)
+from .registry import alias as _alias  # noqa: E402
+for _n in ("gelqf", "syevd", "gemm", "gemm2", "potrf", "potri", "trsm", "trmm",
+           "syrk", "sumlogdiag", "extractdiag", "makediag", "extracttrian",
+           "maketrian", "inverse", "det", "slogdet"):
+    _alias(f"linalg.{_n}", f"linalg_{_n}")

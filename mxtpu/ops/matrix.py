@@ -367,3 +367,11 @@ def _ravel_multi_index(data, shape):
 def _unravel_index(data, shape):
     out = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
     return jnp.stack(out).astype(jnp.float32)
+
+
+# reference contrib name for the sparse-grad embedding (indexing_op.cc
+# _contrib_SparseEmbedding): same forward gather; the row-sparse gradient
+# behavior lives in gluon.nn.Embedding(sparse_grad=True)'s recorded backward
+from .registry import alias as _alias  # noqa: E402
+_alias("Embedding", "SparseEmbedding", "_contrib_SparseEmbedding")
+_alias("Embedding", "SparseEmbedding", namespace="contrib")

@@ -492,3 +492,43 @@ def _softmax_cross_entropy(data, label):
 def _div_sqrt_dim(data):
     """contrib._contrib_div_sqrt_dim (transformer.cc:33): x / sqrt(d_last)."""
     return data / jnp.sqrt(jnp.asarray(data.shape[-1], dtype=data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg (src/operator/identity_attach_KL_sparse_reg.cc)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _kl_sparse_reg(data, sparseness_target, penalty):
+    return data
+
+
+def _kl_sparse_reg_fwd(data, sparseness_target, penalty):
+    return data, data
+
+
+def _kl_sparse_reg_bwd(sparseness_target, penalty, data, dy):
+    # reference backward (identity_attach_KL_sparse_reg-inl.h:91): the KL
+    # penalty gradient vs the mean activation rho_hat is ADDED to the incoming
+    # gradient. The reference keeps rho_hat as a momentum-smoothed aux buffer;
+    # stateless here, rho_hat is the current batch mean (declared deviation —
+    # the momentum kwarg is accepted and ignored at the op layer).
+    rho_hat = jnp.mean(data, axis=0, keepdims=True)
+    reg = penalty * (-sparseness_target / rho_hat
+                     + (1.0 - sparseness_target) / (1.0 - rho_hat))
+    return (dy + jnp.broadcast_to(reg, dy.shape),)
+
+
+_kl_sparse_reg.defvjp(_kl_sparse_reg_fwd, _kl_sparse_reg_bwd)
+
+
+@register("IdentityAttachKLSparseReg",
+          aliases=("identity_attach_kl_sparse_reg",))
+def _identity_attach_kl_sparse_reg(data, sparseness_target: float = 0.1,
+                                   penalty: float = 0.001,
+                                   momentum: float = 0.9):
+    """Identity forward; backward attaches the KL sparseness penalty gradient
+    for sigmoid activations (src/operator/identity_attach_KL_sparse_reg.cc;
+    Hinton's guideTR P11). Pair only with sigmoid outputs (rho in (0,1))."""
+    return _kl_sparse_reg(data, float(sparseness_target), float(penalty))

@@ -1,0 +1,162 @@
+"""Fused optimizer-update ops — the public ``mx.nd.sgd_update`` family.
+
+Reference: ``src/operator/optimizer_op.cc:317`` et seq. registers these as
+first-class ops (used by custom training loops and the kvstore server's
+updater); each is one fused elementwise kernel over (weight, grad, states).
+Here each op is a pure JAX function mirroring the reference kernel's exact
+math (``optimizer_op-inl.h``: SGDKernel :84, SGDMomKernel :305, MP_SGDKernel
+:361, FTMLKernel :752, AdamUpdate :850, RMSPropAlexUpdate :1130, RMSPropUpdate
+:1235, FtrlUpdate :1330, SignSGDKernel :1525, SignumKernel :1595) — XLA fuses
+the whole update into one HBM-bandwidth-bound pass, the TPU analogue of the
+reference's single CUDA kernel launch.
+
+Pure-function contract: every op returns ``(new_weight, *new_states)``; the
+``mx.nd`` layer (``ndarray/fused_optimizer.py``) restores the reference's
+in-place convention (states mutated, weight written through ``out=``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _rescaled(grad, rescale_grad, clip_gradient):
+    """grad * rescale, clipped iff clip_gradient >= 0 (reference convention:
+    negative clip disables)."""
+    g = rescale_grad * grad
+    if clip_gradient >= 0.0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update", num_outputs=1, differentiable=False)
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    """w = (1 - lr*wd)*w - lr*clip(rescale*g) (SGDKernel, optimizer_op-inl.h:84)."""
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    return (1.0 - lr * wd) * weight - lr * g
+
+
+@register("sgd_mom_update", num_outputs=2, differentiable=False)
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """mom = momentum*mom - lr*wd*w - lr*clip(rescale*g); w += mom
+    (SGDMomKernel, optimizer_op-inl.h:305)."""
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * wd * weight - lr * g
+    return weight + mom, mom
+
+
+@register("mp_sgd_update", num_outputs=2, differentiable=False)
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: the update runs on the fp32 master copy, the
+    low-precision weight output is a cast of it (MP_SGDKernel :361)."""
+    g = _rescaled(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = (1.0 - lr * wd) * weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3, differentiable=False)
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    """Multi-precision momentum SGD (MP_SGDMomKernel, optimizer_op-inl.h:409):
+    mom and master weight are fp32; output weight is the cast master."""
+    g = _rescaled(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * wd * weight32 - lr * g
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register("signsgd_update", num_outputs=1, differentiable=False)
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    """w = (1 - lr*wd)*w - lr*sign(g) — clip has no effect on a sign
+    (SignSGDKernel, optimizer_op-inl.h:1525)."""
+    return (1.0 - lr * wd) * weight - lr * jnp.sign(grad)
+
+
+@register("signum_update", num_outputs=2, differentiable=False)
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """mom = momentum*mom - (1-momentum)*(wd*w + clip(rescale*g));
+    w = (1 - lr*wd_lh)*w + lr*sign(mom) (SignumKernel, optimizer_op-inl.h:1595)."""
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    mom = momentum * mom - (1.0 - momentum) * wd * weight - (1.0 - momentum) * g
+    return (1.0 - lr * wd_lh) * weight + lr * jnp.sign(mom), mom
+
+
+@register("adam_update", num_outputs=3, differentiable=False)
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    """Fused Adam WITHOUT bias correction — the reference kernel leaves the
+    sqrt(1-b2^t)/(1-b1^t) factor to the caller's lr (AdamUpdate,
+    optimizer_op-inl.h:850; python optimizer.Adam folds it into lr)."""
+    g = rescale_grad * grad + wd * weight
+    if clip_gradient >= 0.0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean = beta1 * mean + (1.0 - beta1) * g
+    var = beta2 * var + (1.0 - beta2) * g * g
+    return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var
+
+
+@register("ftml_update", num_outputs=4, differentiable=False)
+def ftml_update(weight, grad, d, v, z, *, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    """Follow-the-Moving-Leader (FTMLKernel, optimizer_op-inl.h:752)."""
+    g = rescale_grad * grad + wd * weight
+    if clip_grad >= 0.0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    v = beta2 * v + (1.0 - beta2) * g * g
+    d_t = (1.0 - beta1 ** t) / lr * (jnp.sqrt(v / (1.0 - beta2 ** t)) + epsilon)
+    z = beta1 * z + (1.0 - beta1) * g - (d_t - beta1 * d) * weight
+    return -z / d_t, d_t, v, z
+
+
+@register("rmsprop_update", num_outputs=2, differentiable=False)
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    """Tieleman & Hinton RMSProp (RMSPropUpdate, optimizer_op-inl.h:1235)."""
+    g = rescale_grad * grad + wd * weight
+    if clip_gradient >= 0.0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    n = (1.0 - gamma1) * g * g + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(n + epsilon)
+    if clip_weights >= 0.0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n
+
+
+@register("rmspropalex_update", num_outputs=4, differentiable=False)
+def rmspropalex_update(weight, grad, n, g, delta, *, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Graves' centered RMSProp (RMSPropAlexUpdate, optimizer_op-inl.h:1130).
+    State ``g`` is the running mean gradient; ``delta`` the running step."""
+    gr = rescale_grad * grad + wd * weight
+    if clip_gradient >= 0.0:
+        gr = jnp.clip(gr, -clip_gradient, clip_gradient)
+    n = (1.0 - gamma1) * gr * gr + gamma1 * n
+    g = (1.0 - gamma1) * gr + gamma1 * g
+    delta = gamma2 * delta - lr * gr / jnp.sqrt(n - g * g + epsilon)
+    w = weight + delta
+    if clip_weights >= 0.0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n, g, delta
+
+
+@register("ftrl_update", num_outputs=3, differentiable=False)
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    """FTRL-proximal (FtrlUpdate, optimizer_op-inl.h:1330). Note the reference
+    does NOT fold wd into the gradient here — wd enters the denominator."""
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    z = z + g - (jnp.sqrt(n + g * g) - jnp.sqrt(n)) * weight / lr
+    n = n + g * g
+    w = ((jnp.sign(z) * lamda1 - z) / ((beta + jnp.sqrt(n)) / lr + wd)
+         * (jnp.abs(z) > lamda1))
+    return w.astype(weight.dtype), z, n
