@@ -124,16 +124,33 @@ class KVStore:
 
     def push(self, key, value, priority: int = 0):
         """Accumulate: list-of-values are reduced (Comm::Reduce parity, comm.h:103);
-        in dist mode the reduced grad is all-reduced across workers."""
+        in dist mode the reduced grad is all-reduced across workers.
+
+        SPMD contract (dist_sync): every rank must push the SAME storage type
+        for a given key — grad stype is a property of the parameter, as in the
+        reference (kvstore_dist.h dispatches DataHandleRowSparse vs Default by
+        the key's stype). The sparse path issues a different collective
+        sequence (row-union exchange) than the dense path; ranks disagreeing
+        on a key's stype would hang the job, exactly like mismatched NCCL
+        calls. A rank with no live rows pushes an EMPTY row_sparse grad, not
+        a dense zero."""
         from .ndarray import sparse as _sparse
         keys, values = self._normalize_push(key, value)
         if self._async:
             # async PS: locally reduce the pushed list, ship the grad; the
             # SERVER applies its updater immediately on arrival (no
-            # worker-sync). Row-sparse grads densify for transport here
-            # (flagged deviation, as in the dist_sync path below).
+            # worker-sync). Row-sparse grads ship ONLY their live rows
+            # (CMD_PUSH_ROWS — kvstore_dist_server.h row_sparse async parity).
             import numpy as np
             for k, vlist in zip(keys, values):
+                if all(getattr(v, "stype", "default") == "row_sparse"
+                       for v in vlist):
+                    red = vlist[0]
+                    for v in vlist[1:]:
+                        red = _sparse.add(red, v)
+                    self._ps.push_rows(str(k), np.asarray(red._indices),
+                                       np.asarray(red._values))
+                    continue
                 red = None
                 for v in vlist:
                     dense = v._dense() if getattr(
@@ -150,12 +167,7 @@ class KVStore:
                 for v in vlist[1:]:
                     red = _sparse.add(red, v)
                 if self._distributed and jax.process_count() > 1:
-                    # cross-worker row-sparse reduce (DataHandleRowSparse parity):
-                    # ranks may hold different rows — densify local, allreduce,
-                    # re-sparsify to the union of rows
-                    from .parallel import collectives
-                    dense = collectives.allreduce_processes(red._dense())
-                    red = _sparse.cast_storage(NDArray(dense), "row_sparse")
+                    red = self._transport_rowsparse(red)
                 if self._updater is not None:
                     self._updater(k, red, self._store[k])
                 else:
@@ -213,18 +225,19 @@ class KVStore:
         keys, outs = self._normalize_push(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(outs[0])
         for k, olist in zip(keys, outs):
-            if self._async:
-                # refresh from the server, then gather rows locally (the wire
-                # carries the full value; a row-subset server command would be
-                # the O(|rows|) upgrade)
-                self._store[k] = NDArray(jnp.asarray(self._ps.pull(str(k))))
-            src = self._store[k]
+            src = None if self._async else self._store[k]
             for i, (o, rid) in enumerate(zip(olist, rids)):
                 rid_host = np.unique(np.asarray(
                     rid.asnumpy() if hasattr(rid, "asnumpy") else rid).astype(
                         np.int64).reshape(-1))
                 rows = jnp.asarray(rid_host, jnp.int32)
-                gathered = src.data[rows]
+                if self._async:
+                    # O(|rows|) wire: the server ships only the requested rows
+                    # (CMD_PULL_ROWS; kvstore_dist.h:436-510 sparse pull parity)
+                    gathered = jnp.asarray(
+                        self._ps.pull_rows(str(k), rid_host))
+                else:
+                    gathered = src.data[rows]
                 if getattr(o, "stype", "default") == "row_sparse":
                     o._indices = rows
                     o._values = gathered.astype(o.dtype)
@@ -265,6 +278,17 @@ class KVStore:
             from .parallel import collectives
             return collectives.allreduce_processes(payload)
         return payload
+
+    def _transport_rowsparse(self, red):
+        """Cross-worker row-sparse reduce with O(rows) payload: allgather row
+        ids, sum values over the union slab — never the dense matrix
+        (kvstore_dist.h:436-510 DataHandleRowSparse parity; tests hook this
+        and the collectives beneath it to audit wire bytes)."""
+        from .ndarray import sparse as _sparse
+        from .parallel import collectives
+        rows, vals = collectives.allreduce_rowsparse_processes(
+            red._indices, red._values, red.shape[0])
+        return _sparse.RowSparseNDArray(rows, vals, red.shape)
 
     def _compress_encode(self, key, grad):
         """2-bit quantization with error-feedback residual

@@ -136,27 +136,97 @@ def _process_mesh() -> Mesh:
     return Mesh(np.array(picked[:nproc]), ("proc",))
 
 
-def allreduce_processes(x, op: str = "sum"):
-    """Reduce a per-process host value across ALL processes; returns a host-local
-    array every rank can read (dist_sync push semantics, kvstore_dist_server.h:283)."""
+def _process_exchange(x, body):
+    """Shared cross-process plumbing: stack each rank's host value on a 'proc'
+    axis, run `body` replicated, return the host-local result. Both
+    allreduce_processes and allgather_processes ride this one path so
+    transport fixes land once."""
     import numpy as np
-    nproc = jax.process_count()
-    xs = jnp.asarray(x)
-    if nproc == 1:
-        return xs
     mesh = _process_mesh()
     sh = NamedSharding(mesh, P("proc"))
     arr = jax.make_array_from_process_local_data(
-        sh, np.asarray(jax.device_get(xs))[None])
+        sh, np.asarray(jax.device_get(jnp.asarray(x)))[None])
+    fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
+    out = fn(arr)
+    jax.block_until_ready(out)
+    return jnp.asarray(jax.device_get(out))
 
-    @partial(jax.jit, out_shardings=NamedSharding(mesh, P()))
+
+def allreduce_processes(x, op: str = "sum"):
+    """Reduce a per-process host value across ALL processes; returns a host-local
+    array every rank can read (dist_sync push semantics, kvstore_dist_server.h:283)."""
+    nproc = jax.process_count()
+    if nproc == 1:
+        return jnp.asarray(x)
+
     def _sum(a):
         s = jnp.sum(a, axis=0)
         return s / nproc if op == "mean" else s
 
-    out = _sum(arr)
-    jax.block_until_ready(out)
-    return jnp.asarray(jax.device_get(out))
+    return _process_exchange(x, _sum)
+
+
+def allreduce_rowsparse_processes(indices, values, num_rows: int):
+    """Cross-process row-sparse sum WITHOUT densifying: returns
+    ``(union_rows, summed_values)`` where payload across the wire is
+    O(union rows), not O(dense size).
+
+    Reference: ``kvstore_dist.h:436-510`` DataHandleRowSparse /
+    EncodeRowSparseKey ship only live rows over ps-lite. Here the exchange is
+    three static-shape XLA collectives:
+
+    1. allgather each rank's (count-padded) row ids — O(max_rows × nproc) ints;
+    2. every rank deterministically computes the sorted union on host;
+    3. allreduce a (union_padded × row_width) value slab — O(union rows).
+
+    The union slab is padded to the next power of two so XLA recompiles
+    O(log num_rows) distinct programs, not one per distinct union size
+    (the reference's bucketing trick applied to comm shapes).
+    """
+    import numpy as np
+    idx = np.asarray(jax.device_get(jnp.asarray(indices))).astype(np.int64)
+    vals = np.asarray(jax.device_get(jnp.asarray(values)))
+    if jax.process_count() == 1:
+        return jnp.asarray(idx), jnp.asarray(vals)
+
+    # 1) agree on a common padded index length (gather per-rank counts — nproc
+    # scalars), then allgather the padded row ids. Pad marker is num_rows (an
+    # invalid row id). nmax is pow2-bucketed like the value slab so varying
+    # live-row counts reuse compiled programs.
+    counts = np.asarray(jax.device_get(allgather_processes(
+        jnp.asarray([np.int32(len(idx))]))))
+    nmax = 1
+    while nmax < max(1, int(counts.max())):
+        nmax *= 2
+    nmax = min(nmax, num_rows)
+    pad = np.full((nmax,), num_rows, np.int32)
+    pad[:len(idx)] = idx
+    all_idx = np.asarray(jax.device_get(allgather_processes(
+        jnp.asarray(pad)))).astype(np.int64)
+
+    # 2) deterministic union on every rank
+    union = np.unique(all_idx.reshape(-1))
+    union = union[union < num_rows]
+    # bucket the slab length: next power of two, so comm programs are reused
+    cap = 1
+    while cap < max(1, len(union)):
+        cap *= 2
+    cap = min(cap, num_rows)
+
+    # 3) scatter local rows into the union slab, allreduce the slab
+    slab = np.zeros((cap,) + vals.shape[1:], vals.dtype)
+    pos = np.searchsorted(union, idx)
+    np.add.at(slab, pos, vals)        # accumulate — local dup rows stay correct
+    summed = allreduce_processes(jnp.asarray(slab))
+    return jnp.asarray(union), jnp.asarray(summed)[:len(union)]
+
+
+def allgather_processes(x):
+    """Concatenate each process's host value along a new leading axis
+    (every rank receives all contributions)."""
+    if jax.process_count() == 1:
+        return jnp.asarray(x)[None]
+    return _process_exchange(x, lambda a: a)
 
 
 def broadcast_processes(x, root: int = 0):

@@ -45,7 +45,7 @@ import numpy as np
 __all__ = ["ParamServer", "PSClient", "start_server", "default_port"]
 
 (CMD_INIT, CMD_PUSH, CMD_PULL, CMD_SET_OPT, CMD_BARRIER, CMD_GET_STATES,
- CMD_SET_STATES) = range(7)
+ CMD_SET_STATES, CMD_PULL_ROWS, CMD_PUSH_ROWS) = range(9)
 STATUS_OK, STATUS_ERR = 0, 1
 
 
@@ -177,10 +177,25 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _dtype_token(dt: np.dtype) -> str:
+    """Wire token for a dtype: numpy's .str for standard dtypes, the NAME for
+    extension dtypes (bfloat16's .str is an opaque '<V2' that cannot
+    round-trip)."""
+    return dt.name if dt.kind == "V" else dt.str
+
+
+def _dtype_from_token(tok: str) -> np.dtype:
+    try:
+        return np.dtype(tok)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, tok))
+
+
 def _encode_array(arr: Optional[np.ndarray]) -> Tuple[bytes, bytes]:
     if arr is None:
         return b"", b""
-    meta = f"{arr.dtype.str}:{','.join(map(str, arr.shape))}".encode()
+    meta = f"{_dtype_token(arr.dtype)}:{','.join(map(str, arr.shape))}".encode()
     return meta, np.ascontiguousarray(arr).tobytes()
 
 
@@ -189,12 +204,29 @@ def _decode_array(meta: bytes, payload: bytes) -> Optional[np.ndarray]:
         return None
     dtype_s, shape_s = meta.decode().split(":")
     shape = tuple(int(d) for d in shape_s.split(",")) if shape_s else ()
-    return np.frombuffer(payload, dtype=np.dtype(dtype_s)).reshape(shape).copy()
+    return np.frombuffer(payload, dtype=_dtype_from_token(dtype_s)) \
+        .reshape(shape).copy()
 
 
 def _send_msg(sock: socket.socket, head: bytes, meta: bytes, payload: bytes):
     sock.sendall(head + struct.pack("<I", len(meta)) + meta +
                  struct.pack("<Q", len(payload)) + payload)
+
+
+def _encode_rows_vals(rows: np.ndarray, vals: np.ndarray) -> Tuple[bytes, bytes]:
+    """Rows + values in one frame: meta = '<vals meta>|<n rows>', payload =
+    int64 row ids then the value bytes — the O(rows) sparse wire format
+    (EncodeRowSparseKey parity, kvstore_dist.h:236)."""
+    vmeta, vbytes = _encode_array(vals)
+    rows = np.ascontiguousarray(rows, np.int64)
+    return vmeta + b"|" + str(rows.size).encode(), rows.tobytes() + vbytes
+
+
+def _decode_rows_vals(meta: bytes, payload: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    vmeta, n = meta.rsplit(b"|", 1)
+    n = int(n)
+    rows = np.frombuffer(payload[:8 * n], np.int64).copy()
+    return rows, _decode_array(vmeta, payload[8 * n:])
 
 
 class ParamServer:
@@ -242,6 +274,19 @@ class ParamServer:
             else:
                 stored += grad                        # default: accumulate
 
+    def _apply_push_rows(self, key: str, rows: np.ndarray, vals: np.ndarray):
+        """Row-subset push: only the shipped rows touch the stored value —
+        with an optimizer set, its lazy row-sparse path runs on the row slab
+        (kvstore_dist_server.h row_sparse async parity)."""
+        with self._lock:
+            stored = self._store.get(key)
+            if stored is None:
+                raise KeyError(f"push before init for key {key!r}")
+            if self._updater is not None:
+                self._updater(key, (rows, vals), stored)
+            else:
+                np.add.at(stored, rows, vals)
+
     def _serve(self, conn: socket.socket):
         try:
             while not self._stop.is_set():
@@ -260,6 +305,16 @@ class ParamServer:
                             self._store.setdefault(key, val)   # first wins
                     elif cmd == CMD_PUSH:
                         self._apply_push(key, _decode_array(meta, payload))
+                    elif cmd == CMD_PUSH_ROWS:
+                        self._apply_push_rows(
+                            key, *_decode_rows_vals(meta, payload))
+                    elif cmd == CMD_PULL_ROWS:
+                        rows = _decode_array(meta, payload).astype(np.int64)
+                        with self._lock:
+                            val = self._store.get(key)
+                            if val is None:
+                                raise KeyError(f"pull before init: {key!r}")
+                            rmeta, rpayload = _encode_array(val[rows])
                     elif cmd == CMD_PULL:
                         # encode UNDER the lock: concurrent pushes mutate the
                         # stored buffer in place; encoding outside would ship
@@ -313,9 +368,16 @@ class ParamServer:
 
         def apply(key, grad, stored):
             from .ndarray.ndarray import NDArray
+            from .ndarray import sparse as sp
             import jax.numpy as jnp
             w = NDArray(jnp.asarray(stored))
-            updater(key, NDArray(jnp.asarray(grad)), w)
+            if isinstance(grad, tuple):        # (rows, vals): lazy sparse path
+                rows, vals = grad
+                g = sp.RowSparseNDArray(np.asarray(rows), jnp.asarray(vals),
+                                        stored.shape)
+            else:
+                g = NDArray(jnp.asarray(grad))
+            updater(key, g, w)
             stored[...] = np.asarray(w.data)
 
         with self._lock:
@@ -361,9 +423,11 @@ class PSClient:
 
     def _request_raw(self, cmd: int, key: str = "",
                      arr: Optional[np.ndarray] = None,
-                     raw: bytes = b"") -> Tuple[bytes, bytes]:
+                     raw: bytes = b"",
+                     frame: Optional[Tuple[bytes, bytes]] = None
+                     ) -> Tuple[bytes, bytes]:
         kb = key.encode()
-        meta, payload = _encode_array(arr)
+        meta, payload = frame if frame is not None else _encode_array(arr)
         if raw:
             payload = raw
         with self._lock:
@@ -390,8 +454,18 @@ class PSClient:
     def push(self, key: str, grad: np.ndarray):
         self._request(CMD_PUSH, key, grad)
 
+    def push_rows(self, key: str, rows: np.ndarray, vals: np.ndarray):
+        """Ship ONLY the live rows (O(rows) wire payload)."""
+        self._request_raw(CMD_PUSH_ROWS, key, frame=_encode_rows_vals(
+            np.asarray(rows), np.asarray(vals)))
+
     def pull(self, key: str) -> np.ndarray:
         return self._request(CMD_PULL, key)
+
+    def pull_rows(self, key: str, rows: np.ndarray) -> np.ndarray:
+        """Fetch ONLY the requested rows (O(rows) wire payload)."""
+        return self._request(CMD_PULL_ROWS, key,
+                             np.ascontiguousarray(rows, np.int64))
 
     def set_optimizer(self, optimizer):
         self._request(CMD_SET_OPT, "", raw=serialize_optimizer(optimizer))

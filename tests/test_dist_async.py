@@ -279,3 +279,96 @@ def test_user_scheduler_requires_secret(monkeypatch):
     assert wire[:1] == b"P"
     back = ps.deserialize_optimizer(wire)
     assert type(back.lr_scheduler).__name__ == "MyLR"
+
+
+def test_async_sparse_rows_wire_is_o_rows(async_kv):
+    """Row-sparse push/pull over the async PS ships O(rows) payloads
+    (CMD_PUSH_ROWS / CMD_PULL_ROWS), never the dense value — and the server
+    touches only the live rows."""
+    from mxtpu import nd, ps
+    from mxtpu.ndarray import sparse
+
+    kv = async_kv
+    NROWS, NCOLS = 1024, 8
+    base = np.zeros((NROWS, NCOLS), np.float32)
+    kv.init("emb", nd.array(base))
+
+    sent, received = [], []
+    orig = ps.PSClient._request_raw
+
+    def spy(self, cmd, key="", arr=None, raw=b"", frame=None):
+        if frame is not None:
+            sent.append(len(frame[1]))
+        elif arr is not None:
+            sent.append(arr.nbytes)
+        rmeta, rpayload = orig(self, cmd, key, arr, raw, frame)
+        received.append(len(rpayload))
+        return rmeta, rpayload
+
+    ps.PSClient._request_raw = spy
+    try:
+        live = [3, 500]
+        g = sparse.row_sparse_array(
+            (np.full((2, NCOLS), 2.0, np.float32), live),
+            shape=(NROWS, NCOLS))
+        kv.push("emb", g)
+        # push payload: 2 rows * (8B id + NCOLS*4B values)
+        assert sent[-1] == 2 * 8 + 2 * NCOLS * 4, sent
+        assert sent[-1] < NROWS * NCOLS * 4 / 8
+
+        out = sparse.row_sparse_array(
+            (np.zeros((2, NCOLS), np.float32), live), shape=(NROWS, NCOLS))
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array(live))
+        assert received[-1] == 2 * NCOLS * 4, received   # only 2 rows back
+        np.testing.assert_allclose(out.data.asnumpy(), 2.0)
+    finally:
+        ps.PSClient._request_raw = orig
+
+    # server state: only live rows accumulated
+    full = nd.zeros((NROWS, NCOLS))
+    kv.pull("emb", out=full)
+    arr = full.asnumpy()
+    assert np.all(arr[[0, 1, 2, 4, 499, 501]] == 0)
+    np.testing.assert_allclose(arr[live], 2.0)
+
+
+def test_async_sparse_push_with_server_optimizer(async_kv):
+    """Sparse async push runs the server optimizer's LAZY path: untouched rows
+    keep their value even under weight decay-free SGD with momentum state."""
+    from mxtpu import nd, optimizer, ps
+    from mxtpu.ndarray import sparse
+
+    kv = async_kv
+    NROWS = 16
+    kv.init("w", nd.array(np.ones((NROWS, 4), np.float32)))
+    kv.set_optimizer(optimizer.SGD(learning_rate=0.5, momentum=0.9))
+    g = sparse.row_sparse_array(
+        (np.ones((2, 4), np.float32), [1, 7]), shape=(NROWS, 4))
+    kv.push("w", g)
+    kv.push("w", g)
+    out = nd.zeros((NROWS, 4))
+    kv.pull("w", out=out)
+    arr = out.asnumpy()
+    untouched = [r for r in range(NROWS) if r not in (1, 7)]
+    np.testing.assert_allclose(arr[untouched], 1.0)
+    # two momentum SGD steps: w=1-0.5=0.5; mom=-0.45-0.5=-0.95 -> w=-0.45
+    np.testing.assert_allclose(arr[[1, 7]], -0.45, rtol=1e-5)
+
+
+def test_async_sparse_rows_bf16_wire(async_kv):
+    """bf16 values survive the rows/vals wire codec (dtype NAME token — .str
+    is an opaque '<V2' for extension dtypes) and the server's row accumulate."""
+    import jax.numpy as jnp
+
+    from mxtpu import nd
+    from mxtpu.ndarray import sparse
+
+    kv = async_kv
+    kv.init("ebf", nd.zeros((8, 4)).astype(jnp.bfloat16))
+    g = sparse.row_sparse_array((np.ones((2, 4), np.float32), [1, 6]),
+                                shape=(8, 4))
+    g._values = g._values.astype(jnp.bfloat16)
+    kv.push("ebf", g)
+    got = np.asarray(kv._ps.pull_rows("ebf", np.array([1, 5, 6])),
+                     dtype=np.float32)
+    np.testing.assert_allclose(got, [[1] * 4, [0] * 4, [1] * 4])
