@@ -14,7 +14,7 @@ from jax import lax
 from .registry import register
 
 
-@register("sort", differentiable=False)
+@register("sort")
 def _sort(data, axis: Optional[int] = -1, is_ascend: bool = True):
     out = jnp.sort(data, axis=axis)
     if not is_ascend:
@@ -31,7 +31,9 @@ def _argsort(data, axis: Optional[int] = -1, is_ascend: bool = True, dtype="floa
     return out.astype(dtype_np(dtype))
 
 
-@register("topk", differentiable=False)
+@register("topk",
+          differentiable=lambda kw: kw.get("ret_typ", "indices")
+          in ("value", "both"))
 def _topk(data, axis: Optional[int] = -1, k: int = 1, ret_typ: str = "indices",
           is_ascend: bool = False, dtype="float32"):
     """Reference topk (ordering_op-inl.h): ret_typ ∈ {value, indices, mask, both}."""

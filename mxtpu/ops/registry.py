@@ -63,7 +63,11 @@ def register(name: Optional[str] = None, *, num_outputs: int = 1,
 
     The function receives raw ``jax.Array``/scalar positional inputs plus keyword attrs
     and must be jit-traceable (static attrs only in kwargs). ``num_outputs`` may be -1
-    for ops whose output count depends on attrs (e.g. ``split``).
+    for ops whose output count depends on attrs (e.g. ``split``). ``differentiable``
+    may be a callable ``kwargs -> bool`` for ops whose output kind depends on attrs
+    (topk's value/both outputs carry a gradient, its indices/mask outputs don't —
+    reference ``_backward_topk`` covers kReturnValue and kReturnBoth,
+    ordering_op.cc:74).
     """
 
     def _wrap(fn: Callable):
@@ -186,7 +190,9 @@ def invoke(op: OpDef, *args, out=None, **kwargs):
         outs = list(targets)
 
     from .. import autograd
-    if autograd.is_recording() and op.differentiable:
+    differentiable = (op.differentiable(kwargs) if callable(op.differentiable)
+                      else op.differentiable)
+    if autograd.is_recording() and differentiable:
         # positional NDArrays by index, kwarg NDArrays by name — both become tape
         # inputs so gradients flow to (e.g.) `length=` tensors as well
         nd_in = [(i, a) for i, a in enumerate(args) if isinstance(a, NDArray)]

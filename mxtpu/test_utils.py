@@ -54,11 +54,19 @@ def rand_shape_nd(ndim: int, dim: int = 10) -> tuple:
 
 def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
                            eps: float = 1e-3, rtol: float = 1e-2,
-                           atol: float = 1e-3):
-    """Finite-difference vs autograd gradients (test_utils.py:check_numeric_gradient).
+                           atol: float = 1e-3, loss_fn: Optional[Callable] = None):
+    """Finite-difference vs autograd gradients (test_utils.py:check_numeric_gradient
+    — SURVEY §4's "workhorse of operator tests").
 
-    ``fn(*inputs) -> scalar NDArray``. All inputs must be float32+.
+    ``fn(*inputs) -> NDArray`` is differentiated through the imperative tape
+    (non-scalar outputs get a ones cotangent). The numeric side differentiates
+    ``loss_fn`` (default: ``fn``, which must then be scalar). Pass a separate
+    ``loss_fn`` for the legacy loss heads whose custom backward injects the
+    gradient of an IMPLIED loss while their forward returns predictions
+    (SoftmaxOutput: forward=softmax, backward=d CE/d data — the numeric oracle
+    must difference the CE, not the softmax).
     """
+    numeric_fn = loss_fn if loss_fn is not None else fn
     for x in inputs:
         x.attach_grad()
     with autograd.record():
@@ -67,6 +75,7 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
     analytic = [x.grad.asnumpy().copy() for x in inputs]
 
     for xi, x in enumerate(inputs):
+        dt = x.asnumpy().dtype                       # preserve input dtype
         arr = x.asnumpy().astype(np.float64)
         numeric = np.zeros_like(arr)
         flat = arr.ravel()
@@ -74,13 +83,13 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
         for i in range(flat.size):
             orig = flat[i]
             flat[i] = orig + eps
-            x._set_data(np.asarray(arr, np.float32).reshape(x.shape))
-            f_plus = float(fn(*inputs).asscalar())
+            x._set_data(np.asarray(arr, dt).reshape(x.shape))
+            f_plus = float(numeric_fn(*inputs).asscalar())
             flat[i] = orig - eps
-            x._set_data(np.asarray(arr, np.float32).reshape(x.shape))
-            f_minus = float(fn(*inputs).asscalar())
+            x._set_data(np.asarray(arr, dt).reshape(x.shape))
+            f_minus = float(numeric_fn(*inputs).asscalar())
             flat[i] = orig
-            x._set_data(np.asarray(arr, np.float32).reshape(x.shape))
+            x._set_data(np.asarray(arr, dt).reshape(x.shape))
             num_flat[i] = (f_plus - f_minus) / (2 * eps)
         np.testing.assert_allclose(analytic[xi], numeric, rtol=rtol, atol=atol,
                                    err_msg=f"gradient mismatch on input {xi}")

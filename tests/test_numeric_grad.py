@@ -1,0 +1,317 @@
+"""Numeric-gradient sweep over every hand-written backward (round-3 verdict #5).
+
+SURVEY §4 calls ``check_numeric_gradient`` the workhorse of operator tests:
+auto-derived vjps get correctness from JAX, but every ``jax.custom_vjp`` /
+explicit-backward in the framework is hand-written math that only a finite-
+difference oracle audits. Sites covered: the legacy loss heads (ops/nn.py —
+their backward injects the gradient of an IMPLIED loss, so the oracle
+differences that loss), flash attention's Pallas/XLA bwd (ops/attention.py),
+CTC's scan recursion, CustomOp's pure_callback vjp (operator.py), the torch
+bridge, control-flow grad parity (ops/control_flow.py), the symbol executor's
+bind backward (symbol/executor.py), and a spread of structurally-tricky
+registry ops. A deliberate sign-flip canary proves the harness would catch a
+broken backward.
+"""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+from mxtpu.test_utils import check_numeric_gradient
+
+
+def _r(shape, seed=0, scale=1.0):
+    return nd.array((np.random.RandomState(seed).randn(*shape) * scale)
+                    .astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# loss heads: analytic (injected) grad vs numeric grad of the implied loss
+# ---------------------------------------------------------------------------
+
+
+def test_softmax_output_plain():
+    label = nd.array(np.array([0, 2, 1], np.float32))
+    check_numeric_gradient(
+        lambda d: nd.SoftmaxOutput(d, label),
+        [_r((3, 4), 1)],
+        loss_fn=lambda d: nd.softmax_cross_entropy(d, label))
+
+
+def test_softmax_output_grad_scale():
+    label = nd.array(np.array([1, 3], np.float32))
+    check_numeric_gradient(
+        lambda d: nd.SoftmaxOutput(d, label, grad_scale=2.0),
+        [_r((2, 5), 2)],
+        loss_fn=lambda d: 2.0 * nd.softmax_cross_entropy(d, label))
+
+
+def test_softmax_output_batch_normalization():
+    label = nd.array(np.array([0, 1, 2, 0], np.float32))
+    check_numeric_gradient(
+        lambda d: nd.SoftmaxOutput(d, label, normalization="batch"),
+        [_r((4, 3), 3)],
+        loss_fn=lambda d: nd.softmax_cross_entropy(d, label) / 4.0)
+
+
+def test_softmax_output_ignore_valid():
+    lv = np.array([0, -1, 2, 1], np.float32)          # one ignored row
+    label = nd.array(lv)
+    keep = nd.array((lv != -1).astype(np.float32))
+    valid = float((lv != -1).sum())
+
+    def implied(d):
+        logp = nd.log_softmax(d, axis=-1)
+        picked = nd.pick(logp, nd.clip(label, 0, 10), axis=-1)
+        return -nd.sum(picked * keep) / valid
+
+    check_numeric_gradient(
+        lambda d: nd.SoftmaxOutput(d, label, use_ignore=True,
+                                   ignore_label=-1.0, normalization="valid"),
+        [_r((4, 3), 4)], loss_fn=implied)
+
+
+def test_make_loss_grad_scale():
+    check_numeric_gradient(
+        lambda d: nd.make_loss(d, grad_scale=3.0),
+        [_r((2, 3), 5)],
+        loss_fn=lambda d: 3.0 * nd.sum(d))
+
+
+def test_linear_regression_output():
+    label = _r((3, 4), 6)
+    check_numeric_gradient(
+        lambda d: nd.LinearRegressionOutput(d, label, grad_scale=2.0),
+        [_r((3, 4), 7)],
+        loss_fn=lambda d: 2.0 / (2 * 4) * nd.sum(nd.square(d - label)))
+
+
+def test_logistic_regression_output():
+    label = nd.array(np.random.RandomState(8).randint(0, 2, (3, 2))
+                     .astype(np.float32))
+
+    def implied(d):
+        s = nd.sigmoid(d)
+        return -nd.sum(label * nd.log(s) + (1 - label) * nd.log(1 - s)) / 2
+
+    check_numeric_gradient(
+        lambda d: nd.LogisticRegressionOutput(d, label),
+        [_r((3, 2), 9)], loss_fn=implied)
+
+
+def test_mae_regression_output():
+    label = nd.array(np.zeros((3, 3), np.float32))
+    data = nd.array((np.random.RandomState(10).randn(3, 3) + 3.0)
+                    .astype(np.float32))      # keep |p-l| away from the kink
+    check_numeric_gradient(
+        lambda d: nd.MAERegressionOutput(d, label),
+        [data],
+        loss_fn=lambda d: nd.sum(nd.abs(d - label)) / 3)
+
+
+# ---------------------------------------------------------------------------
+# hand-written vjps with genuine vjp semantics
+# ---------------------------------------------------------------------------
+
+
+def test_flash_attention_bwd():
+    q, k, v = _r((1, 2, 8, 4), 11, 0.5), _r((1, 2, 8, 4), 12, 0.5), \
+        _r((1, 2, 8, 4), 13, 0.5)
+    check_numeric_gradient(
+        lambda q_, k_, v_: nd.sum(nd.contrib.flash_attention(q_, k_, v_)),
+        [q, k, v], eps=2e-2, rtol=3e-2, atol=3e-3)
+
+
+def test_flash_attention_causal_bwd():
+    q, k, v = _r((1, 1, 8, 4), 14, 0.5), _r((1, 1, 8, 4), 15, 0.5), \
+        _r((1, 1, 8, 4), 16, 0.5)
+    check_numeric_gradient(
+        lambda q_, k_, v_: nd.sum(nd.contrib.flash_attention(
+            q_, k_, v_, causal=True)),
+        [q, k, v], eps=2e-2, rtol=3e-2, atol=3e-3)
+
+
+def test_ctc_loss_bwd():
+    label = nd.array(np.array([[1, 2], [2, 0]], np.float32))
+    plen = nd.array(np.array([4, 4], np.float32))
+    llen = nd.array(np.array([2, 1], np.float32))
+    check_numeric_gradient(
+        lambda p: nd.sum(nd.contrib.ctc_loss(p, label, plen, llen)),
+        [_r((4, 2, 3), 17)], eps=5e-3)
+
+
+def test_custom_op_bwd():
+    import tests.test_custom_op  # noqa: F401 — registers scaled_sigmoid
+    check_numeric_gradient(
+        lambda x: nd.sum(nd.Custom(x, op_type="scaled_sigmoid", scale=2.0)),
+        [_r((5,), 18)])
+
+
+def test_torch_bridge_bwd():
+    import torch
+
+    from mxtpu.contrib.torch_bridge import register_torch_op
+
+    def _fn(a, b):
+        return torch.tanh(a) * b
+
+    register_torch_op("ng_tanh_mul", _fn)
+    check_numeric_gradient(
+        lambda a, b: nd.sum(nd.contrib.ng_tanh_mul(a, b)),
+        [_r((3, 2), 19), _r((3, 2), 20)])
+
+
+def test_foreach_bwd():
+    from mxtpu.ops import control_flow as cf
+
+    def run(x, s):
+        outs, fin = cf.foreach(
+            lambda xi, st: (xi * st[0], [st[0] + xi]), x, [s])
+        return nd.sum(outs) + nd.sum(fin[0])
+
+    check_numeric_gradient(run, [_r((4, 3), 21), _r((3,), 22)])
+
+
+def test_while_loop_bwd():
+    from mxtpu.ops import control_flow as cf
+
+    def run(s):
+        _, fin = cf.while_loop(
+            lambda st: nd.sum(st) < 100.0,
+            lambda st: (st * 0 + 1.0, [st * 1.5]),
+            [s], max_iterations=4)
+        return nd.sum(fin[0])
+
+    check_numeric_gradient(run, [nd.array(np.full((3,), 2.0, np.float32))])
+
+
+def test_cond_bwd():
+    from mxtpu.ops import control_flow as cf
+
+    def run(x):
+        return nd.sum(cf.cond(nd.sum(x) > 0,
+                              lambda: x * 3.0, lambda: x * x))
+
+    check_numeric_gradient(run, [nd.array(np.full((3,), 1.5, np.float32))])
+    check_numeric_gradient(run, [nd.array(np.full((3,), -1.5, np.float32))])
+
+
+def test_symbol_executor_bwd():
+    """The bind path's one jax.vjp over the DAG (symbol/executor.py)."""
+    from mxtpu import symbol as sym
+    from mxtpu.symbol.symbol import _reset_names
+    _reset_names()
+    a = sym.Variable("a")
+    out = sym.FullyConnected(a, num_hidden=3, name="nfc")
+    out = sym.Activation(out, act_type="tanh")
+    xv, wv, bv = _r((2, 4), 23), _r((3, 4), 24), _r((3,), 25)
+    exe = out.bind(mx.cpu(), {"a": xv, "nfc_weight": wv, "nfc_bias": bv},
+                   args_grad={"a": nd.zeros((2, 4)),
+                              "nfc_weight": nd.zeros((3, 4)),
+                              "nfc_bias": nd.zeros((3,))})
+    exe.forward(is_train=True)
+    exe.backward(nd.ones((2, 3)))
+    analytic = {k: v.asnumpy().copy() for k, v in exe.grad_dict.items()}
+
+    # numeric oracle through the IMPERATIVE path (independent implementation)
+    def f(x, w, b):
+        return nd.sum(nd.tanh(nd.FullyConnected(x, w, b, num_hidden=3)))
+
+    check_numeric_gradient(f, [xv, wv, bv])
+    for name, arr in (("a", xv), ("nfc_weight", wv), ("nfc_bias", bv)):
+        np.testing.assert_allclose(analytic[name], arr.grad.asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# structurally tricky registry ops (scatter/where/scan-backed grads)
+# ---------------------------------------------------------------------------
+
+
+def test_batchnorm_train_bwd():
+    g, b = nd.array(np.array([1.5, 0.5], np.float32)), \
+        nd.array(np.array([0.1, -0.2], np.float32))
+    check_numeric_gradient(
+        lambda x: nd.sum(nd.square(nd.BatchNorm(
+            x, g, b, nd.zeros((2,)), nd.ones((2,)), fix_gamma=False))),
+        [_r((4, 2), 26)], eps=5e-3, rtol=2e-2)
+
+
+def test_topk_pick_bwd():
+    """The sweep caught this one: topk was registered non-differentiable,
+    but the reference has _backward_topk for the value path
+    (ordering_op.cc:80) — now gated per ret_typ."""
+    check_numeric_gradient(
+        lambda x: nd.sum(nd.topk(x, k=2, axis=-1, ret_typ="value") ** 2),
+        [_r((3, 5), 27)])
+
+
+def test_topk_both_bwd():
+    """ret_typ='both' also carries a value gradient (reference _backward_topk
+    covers kReturnValue AND kReturnBoth, ordering_op.cc:74)."""
+    check_numeric_gradient(
+        lambda x: nd.sum(nd.topk(x, k=2, axis=-1, ret_typ="both")[0] ** 2),
+        [_r((2, 4), 35)])
+
+
+def test_sort_bwd():
+    check_numeric_gradient(
+        lambda x: nd.sum(nd.sort(x, axis=-1) * nd.array(
+            np.arange(5, dtype=np.float32))),
+        [_r((2, 5), 34)])
+
+
+def test_sequence_mask_bwd():
+    length = nd.array(np.array([2, 3], np.float32))
+    check_numeric_gradient(
+        lambda x: nd.sum(nd.square(nd.SequenceMask(
+            x, length, use_sequence_length=True))),
+        [_r((4, 2, 3), 28)])
+
+
+def test_roi_align_bwd():
+    rois = nd.array(np.array([[0, 0.5, 0.5, 3.5, 3.5]], np.float32))
+    check_numeric_gradient(
+        lambda x: nd.sum(nd.contrib.ROIAlign(
+            x, rois, pooled_size=(2, 2), spatial_scale=1.0)),
+        [_r((1, 2, 6, 6), 29)], eps=5e-3, rtol=2e-2, atol=5e-3)
+
+
+def test_where_gather_bwd():
+    cond_arr = nd.array(np.array([[1, 0, 1], [0, 1, 0]], np.float32))
+    check_numeric_gradient(
+        lambda a, b: nd.sum(nd.square(nd.where(cond_arr, a, b))),
+        [_r((2, 3), 30), _r((2, 3), 31)])
+
+
+def test_quantization_ste_bwd():
+    """Quantize-dequantize straight-through path used by QAT (quantization
+    STE: gradient passes through the rounding)."""
+    from mxtpu.contrib import quantization as q
+    if not hasattr(q, "fake_quant"):
+        pytest.skip("no fake_quant surface")
+    check_numeric_gradient(
+        lambda x: nd.sum(nd.square(q.fake_quant(x))), [_r((4,), 32)])
+
+
+# ---------------------------------------------------------------------------
+# the canary: a deliberately wrong backward MUST be caught
+# ---------------------------------------------------------------------------
+
+
+def test_sign_flip_is_caught():
+    class BadSquare(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return dy * (-2.0) * nd.NDArray(x)     # sign flipped
+
+    def run(x):
+        return nd.sum(BadSquare()(x))
+
+    with pytest.raises(AssertionError, match="gradient mismatch"):
+        check_numeric_gradient(run, [_r((3,), 33)])
