@@ -16,6 +16,8 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--calib-mode", default="entropy",
                    choices=["none", "naive", "entropy"])
+    p.add_argument("--quantized-dtype", default="auto",
+                   choices=["int8", "uint8", "auto"])
     args = p.parse_args()
 
     import numpy as np
@@ -45,13 +47,15 @@ def main():
     with autograd.predict_mode():
         fp32_pred = np.argmax(net(xa).asnumpy(), axis=1)
     calib = [nd.array(x[i * 128:(i + 1) * 128]) for i in range(4)]
-    qnet = qz.quantize_net(net, calib_mode=args.calib_mode,
+    qnet = qz.quantize_net(net, quantized_dtype=args.quantized_dtype,
+                           calib_mode=args.calib_mode,
                            calib_data=calib if args.calib_mode != "none"
                            else None)
     with autograd.predict_mode():
         q_pred = np.argmax(qnet(xa).asnumpy(), axis=1)
     print(f"fp32 acc:  {(fp32_pred == y).mean():.4f}")
-    print(f"int8 acc:  {(q_pred == y).mean():.4f}  (calib={args.calib_mode})")
+    print(f"quant acc: {(q_pred == y).mean():.4f}  "
+          f"(calib={args.calib_mode}, dtype={args.quantized_dtype})")
     print(f"agreement: {(q_pred == fp32_pred).mean():.4f}")
 
 

@@ -32,7 +32,8 @@ from .. import autograd
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ndarray.ndarray import NDArray
-from ..ops.quantization import int8_conv, int8_dense, quantize_weight
+from ..ops.quantization import (int8_conv, int8_dense, quantize_weight,
+                                zero_point_corr_conv, zero_point_corr_dense)
 
 __all__ = ["quantize_net", "QuantizedConv2D", "QuantizedDense",
            "_get_optimal_threshold"]
@@ -47,15 +48,23 @@ class _QuantizedLayer(HybridBlock):
     """Shared plumbing: holds int8 weight + scales; input scale is either a
     calibrated constant or computed dynamically per batch."""
 
-    def __init__(self, w_q, w_scale, bias, act, input_absmax, **kwargs):
+    def __init__(self, w_q, w_scale, bias, act, input_absmax, unsigned=False,
+                 **kwargs):
         super().__init__(**kwargs)
         self._w_q = w_q
         self._w_scale = w_scale
         self._bias = bias
         self._act = act
-        self._input_absmax = input_absmax  # None => dynamic
+        self._input_absmax = input_absmax  # None => dynamic; max(x) if unsigned
+        self._unsigned = unsigned          # uint8 activation range [0, max]
 
     def _x_scale(self, x):
+        if self._unsigned:
+            # unsigned range is [0, max(x)] — NOT max|x|, which would waste
+            # resolution whenever |min| > max (negatives clamp regardless)
+            if self._input_absmax is not None:
+                return jnp.float32(255.0 / max(self._input_absmax, 1e-30))
+            return 255.0 / jnp.maximum(jnp.max(x), 1e-30)
         if self._input_absmax is not None:
             return jnp.float32(127.0 / max(self._input_absmax, 1e-30))
         return 127.0 / jnp.maximum(jnp.max(jnp.abs(x)), 1e-30)
@@ -70,40 +79,59 @@ class _QuantizedLayer(HybridBlock):
 class QuantizedDense(_QuantizedLayer):
     """int8 twin of ``nn.Dense`` (quantized_fully_connected.cc parity)."""
 
-    def __init__(self, dense: nn.Dense, input_absmax=None, **kwargs):
+    def __init__(self, dense: nn.Dense, input_absmax=None, unsigned=False,
+                 **kwargs):
         w = dense.weight.data().data
         w_q, w_scale = quantize_weight(w, per_channel_axis=0)
         bias = dense.bias.data().data if dense._use_bias else None
-        super().__init__(w_q, w_scale, bias, dense._act, input_absmax, **kwargs)
+        super().__init__(w_q, w_scale, bias, dense._act, input_absmax,
+                         unsigned, **kwargs)
         self._flatten = dense._flatten
+        # zero-point correction is a per-layer constant — pay it once here,
+        # not per forward (matters in eager mode)
+        self._zp_corr = zero_point_corr_dense(w_q) if unsigned else None
 
     def forward(self, x):
         raw = x.data if isinstance(x, NDArray) else x
         if self._flatten and raw.ndim > 2:
             raw = raw.reshape(raw.shape[0], -1)
         out = int8_dense(raw, self._w_q, self._w_scale, self._x_scale(raw),
-                         self._bias)
+                         self._bias, x_unsigned=self._unsigned,
+                         zp_corr=self._zp_corr)
         return self._finish(out)
 
 
 class QuantizedConv2D(_QuantizedLayer):
     """int8 twin of ``nn.Conv2D`` (quantized_conv.cc parity)."""
 
-    def __init__(self, conv, input_absmax=None, **kwargs):
+    def __init__(self, conv, input_absmax=None, unsigned=False, **kwargs):
         w = conv.weight.data().data
         w_q, w_scale = quantize_weight(w, per_channel_axis=0)
         bias = conv.bias.data().data if conv._use_bias else None
-        super().__init__(w_q, w_scale, bias, conv._act, input_absmax, **kwargs)
+        super().__init__(w_q, w_scale, bias, conv._act, input_absmax,
+                         unsigned, **kwargs)
         self._stride = conv._strides
         self._pad = conv._padding
         self._dilate = conv._dilation
         self._groups = conv._groups
+        self._corr_cache: Dict[tuple, object] = {}   # input shape -> 128·conv(1,w)
+
+    def _zp_corr(self, shape):
+        if not self._unsigned:
+            return None
+        got = self._corr_cache.get(shape)
+        if got is None:
+            got = zero_point_corr_conv(shape, self._w_q, self._stride,
+                                       self._pad, self._dilate, self._groups)
+            self._corr_cache[shape] = got
+        return got
 
     def forward(self, x):
         raw = x.data if isinstance(x, NDArray) else x
         out = int8_conv(raw, self._w_q, self._w_scale, self._x_scale(raw),
                         self._bias, self._stride, self._pad, self._dilate,
-                        self._groups)
+                        self._groups, x_unsigned=self._unsigned,
+                        zp_corr=self._zp_corr(raw.shape))
         return self._finish(out)
 
 
@@ -215,18 +243,23 @@ def _collect_input_stats(net, sites, calib_data, num_calib_batches, mode,
     for child in handles:
         child._forward_pre_hooks.pop()
     absmax: Dict[str, float] = {}
+    minval: Dict[str, float] = {}
+    maxval: Dict[str, float] = {}
     for name, chunks in samples.items():
         if not chunks:
-            absmax[name] = None
+            absmax[name] = minval[name] = maxval[name] = None
             continue
         arr = np.concatenate([c.ravel() for c in chunks])
+        minval[name] = float(arr.min())
+        maxval[name] = float(arr.max())
         if mode == "naive":
             absmax[name] = float(np.abs(arr).max())
         else:
             absmax[name] = _get_optimal_threshold(arr)
         if logger:
-            logger.info("calib %s: absmax=%.5g (%s)", name, absmax[name], mode)
-    return absmax
+            logger.info("calib %s: absmax=%.5g min=%.5g max=%.5g (%s)", name,
+                        absmax[name], minval[name], maxval[name], mode)
+    return absmax, minval, maxval
 
 
 def quantize_net(net, quantized_dtype: str = "int8",
@@ -240,9 +273,9 @@ def quantize_net(net, quantized_dtype: str = "int8",
     of the layer's path (reference ``excluded_sym_names``). The first and last
     layers are commonly excluded by callers for accuracy.
     """
-    if quantized_dtype != "int8":
-        raise NotImplementedError("only int8 is implemented (uint8: use the "
-                                  "contrib.quantize op directly)")
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise ValueError(f"quantized_dtype {quantized_dtype!r} (int8 | uint8 "
+                         f"| auto)")
     if calib_mode not in ("none", "naive", "entropy"):
         raise ValueError(f"calib_mode {calib_mode!r}")
     sites = [(p, k, c, n) for p, k, c, n in _walk(net)
@@ -251,17 +284,38 @@ def quantize_net(net, quantized_dtype: str = "int8",
         if c.weight._data is None:
             raise ValueError(f"layer {n} has uninitialized weight; run a "
                              "forward pass before quantize_net")
+    if quantized_dtype == "auto" and calib_mode == "none":
+        raise ValueError(
+            "quantized_dtype='auto' needs calibration to decide signedness "
+            "per tensor — pass calib_mode='naive'/'entropy' with calib_data, "
+            "or choose 'int8'/'uint8' explicitly")
     absmax: Dict[str, Optional[float]] = {n: None for *_, n in sites}
+    minval: Dict[str, Optional[float]] = dict(absmax)
+    maxval: Dict[str, Optional[float]] = dict(absmax)
     if calib_mode in ("naive", "entropy"):
         if calib_data is None:
             raise ValueError(f"calib_mode={calib_mode!r} requires calib_data")
-        absmax = _collect_input_stats(net, sites, calib_data,
-                                      num_calib_batches, calib_mode, logger)
+        absmax, minval, maxval = _collect_input_stats(
+            net, sites, calib_data, num_calib_batches, calib_mode, logger)
     for parent, key, child, name in sites:
-        if isinstance(child, nn.Dense):
-            q = QuantizedDense(child, absmax[name])
+        # signedness per tensor (reference quantize_graph_pass 'auto': uint8
+        # where the calibrated activation is non-negative — post-ReLU layers —
+        # int8 elsewhere). Explicit 'uint8' forces the unsigned range (values
+        # below 0 clamp, as in the reference's uint8 kernels).
+        if quantized_dtype == "uint8":
+            unsigned = True
+        elif quantized_dtype == "auto":
+            unsigned = minval[name] is not None and minval[name] >= 0.0
         else:
-            q = QuantizedConv2D(child, absmax[name])
+            unsigned = False
+        if logger and unsigned:
+            logger.info("layer %s: uint8 activation range", name)
+        # unsigned layers calibrate over [0, max]; signed over ±absmax
+        rng = maxval[name] if unsigned else absmax[name]
+        if isinstance(child, nn.Dense):
+            q = QuantizedDense(child, rng, unsigned)
+        else:
+            q = QuantizedConv2D(child, rng, unsigned)
         parent._children[key] = q
         for attr, val in list(parent.__dict__.items()):
             if val is child:

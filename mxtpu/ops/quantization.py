@@ -13,7 +13,10 @@ int32 accumulator readout.
 
 Range convention matches the reference (quantization_utils.h): a float range
 [min, max] maps onto the signed int range symmetrically via
-``scale = q_max / max(|min|, |max|)``.
+``scale = q_max / max(|min|, |max|)``; uint8 (non-negative activations, the
+reference's post-ReLU dtype) maps [0, max] onto [0, 255] with
+``scale = 255 / max``. uint8 activations ride the SAME MXU int8 path via the
+standard zero-point-128 shift: u8·w = (u8-128)·w + 128·Σw, both terms int8/int32.
 """
 
 from __future__ import annotations
@@ -30,6 +33,10 @@ _QMAX = {"int8": 127.0, "uint8": 255.0}
 
 
 def _scale_of(min_range, max_range, out_type="int8"):
+    if out_type == "uint8":
+        # unsigned range [0, max] -> [0, 255] (quantization_utils.h
+        # FloatToQuantized<uint8_t>: post-ReLU activations are non-negative)
+        return 255.0 / jnp.maximum(max_range, 1e-30)
     absmax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
     return _QMAX[out_type] / jnp.maximum(absmax, 1e-30)
 
@@ -39,20 +46,25 @@ def _quantize(data, min_range, max_range, out_type: str = "int8"):
     """quantize.cc parity: float -> int8/uint8 given a calibrated range.
 
     Returns (quantized, out_min, out_max) like the reference (3 outputs so the
-    range travels with the tensor through a quantized graph)."""
+    range travels with the tensor through a quantized graph). int8 is
+    symmetric over ±max(|min|,|max|); uint8 maps [0, max] affinely (values
+    below 0 clamp — the reference reserves uint8 for non-negative tensors)."""
     scale = _scale_of(min_range, max_range, out_type)
+    if out_type == "uint8":
+        q = jnp.clip(jnp.round(data * scale), 0.0, 255.0)
+        return q.astype(jnp.uint8), jnp.zeros_like(scale), 255.0 / scale
     q = jnp.clip(jnp.round(data * scale), -_QMAX[out_type], _QMAX[out_type])
-    dt = jnp.int8 if out_type == "int8" else jnp.uint8
     absmax = _QMAX[out_type] / scale
-    return q.astype(dt), -absmax, absmax
+    return q.astype(jnp.int8), -absmax, absmax
 
 
 @register("dequantize", namespace=NS, differentiable=False)
 def _dequantize(data, min_range, max_range, out_type: str = "float32"):
     """dequantize.cc parity: int8/uint8 -> float given the tensor's range."""
-    qmax = _QMAX["uint8" if data.dtype == jnp.uint8 else "int8"]
+    if data.dtype == jnp.uint8:
+        return data.astype(out_type) * (jnp.maximum(max_range, 1e-30) / 255.0)
     absmax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
-    return data.astype(out_type) * (absmax / qmax)
+    return data.astype(out_type) * (absmax / _QMAX["int8"])
 
 
 @register("requantize", namespace=NS, num_outputs=3, differentiable=False)
@@ -70,16 +82,53 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
     return q, min_calib_range, max_calib_range
 
 
-def int8_dense(x, w_q, w_scale, x_scale, bias=None):
-    """int8 x int8 -> int32 matmul on the MXU, rescaled to float.
+def _quantize_act(x, x_scale, unsigned: bool):
+    """Quantize a float activation at the layer boundary. Signed: int8 in
+    [-127, 127]. Unsigned: uint8 in [0, 255], returned zero-point-shifted to
+    int8 (q - 128) so the MXU's int8 path applies; the caller adds the
+    128·Σw correction to the accumulator."""
+    if unsigned:
+        q = jnp.clip(jnp.round(x * x_scale), 0.0, 255.0)
+        return (q - 128.0).astype(jnp.int8)
+    return jnp.clip(jnp.round(x * x_scale), -127, 127).astype(jnp.int8)
+
+
+def zero_point_corr_dense(w_q):
+    """Per-output-channel zero-point correction 128·Σᵢ W[:, i] (int32) — a
+    per-layer constant; compute once at quantization time."""
+    return 128 * jnp.sum(w_q.astype(jnp.int32), axis=1)
+
+
+def zero_point_corr_conv(x_shape, w_q, stride=(1, 1), pad=(0, 0),
+                         dilate=(1, 1), groups: int = 1):
+    """Zero-point correction for a uint8 conv: 128·conv(1, w). Depends only on
+    (input shape, weights, geometry) — compute once per input shape and cache
+    on the layer; XLA constant-folds it under jit."""
+    dn = lax.conv_dimension_numbers(x_shape, w_q.shape, ("NCHW", "OIHW", "NCHW"))
+    ones = jnp.ones(x_shape, jnp.int8)
+    return 128 * lax.conv_general_dilated(
+        ones, w_q, window_strides=tuple(stride),
+        padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+
+
+def int8_dense(x, w_q, w_scale, x_scale, bias=None, x_unsigned: bool = False,
+               zp_corr=None):
+    """int8/uint8 x int8 -> int32 matmul on the MXU, rescaled to float.
 
     ``x`` is float; it is quantized with the calibrated ``x_scale`` on the way
     in (fake-quant boundary). ``w_q`` is pre-quantized int8 [out, in];
-    ``w_scale`` is per-output-channel [out]. Parity target:
-    quantized_fully_connected.cc."""
-    x_q = jnp.clip(jnp.round(x * x_scale), -127, 127).astype(jnp.int8)
+    ``w_scale`` is per-output-channel [out]. With ``x_unsigned`` the
+    activation uses the uint8 range via a zero-point-128 shift:
+    u8·Wᵀ = (u8-128)·Wᵀ + 128·Σᵢ W[:, i]. Parity target:
+    quantized_fully_connected.cc (uint8 is its primary dtype)."""
+    x_q = _quantize_act(x, x_scale, x_unsigned)
     acc = lax.dot_general(x_q, w_q, (((x_q.ndim - 1,), (1,)), ((), ())),
                           preferred_element_type=jnp.int32)
+    if x_unsigned:
+        acc = acc + (zp_corr if zp_corr is not None
+                     else zero_point_corr_dense(w_q))
     out = acc.astype(jnp.float32) / (x_scale * w_scale)
     if bias is not None:
         out = out + bias
@@ -87,17 +136,28 @@ def int8_dense(x, w_q, w_scale, x_scale, bias=None):
 
 
 def int8_conv(x, w_q, w_scale, x_scale, bias=None, stride=(1, 1), pad=(0, 0),
-              dilate=(1, 1), groups: int = 1):
-    """int8 x int8 -> int32 NCHW convolution on the MXU, rescaled to float.
+              dilate=(1, 1), groups: int = 1, x_unsigned: bool = False,
+              zp_corr=None):
+    """int8/uint8 x int8 -> int32 NCHW convolution on the MXU, rescaled to float.
 
-    ``w_q`` int8 [O, I/g, KH, KW]; ``w_scale`` per-output-channel [O]. Parity
-    target: quantized_conv.cc."""
-    x_q = jnp.clip(jnp.round(x * x_scale), -127, 127).astype(jnp.int8)
+    ``w_q`` int8 [O, I/g, KH, KW]; ``w_scale`` per-output-channel [O]. The
+    uint8 activation path shifts by zero-point 128; the correction term
+    128·conv(1, w) is a per-(shape, layer) constant — callers should pass the
+    cached ``zp_corr`` (``zero_point_corr_conv``) so eager forwards don't pay
+    a second conv. Parity target: quantized_conv.cc."""
+    x_q = _quantize_act(x, x_scale, x_unsigned)
     dn = lax.conv_dimension_numbers(x.shape, w_q.shape, ("NCHW", "OIHW", "NCHW"))
-    acc = lax.conv_general_dilated(
-        x_q, w_q, window_strides=tuple(stride), padding=[(p, p) for p in pad],
-        rhs_dilation=tuple(dilate), dimension_numbers=dn,
-        feature_group_count=groups, preferred_element_type=jnp.int32)
+    conv_kw = dict(window_strides=tuple(stride),
+                   padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+                   dimension_numbers=dn, feature_group_count=groups)
+    acc = lax.conv_general_dilated(x_q, w_q,
+                                   preferred_element_type=jnp.int32, **conv_kw)
+    if x_unsigned:
+        # 128·conv(1, w): a per-(shape, layer) constant — pass the cached
+        # zp_corr from the layer to avoid paying a second conv per forward
+        # in eager mode (under jit XLA constant-folds it either way)
+        acc = acc + (zp_corr if zp_corr is not None else zero_point_corr_conv(
+            x.shape, w_q, stride, pad, dilate, groups))
     out = acc.astype(jnp.float32) / (x_scale * w_scale.reshape(1, -1, 1, 1))
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)

@@ -93,7 +93,7 @@ def test_quantize_inference_example():
     out = _run_example("quantize_inference.py", [])
     lines = {l.split(":")[0].strip(): l for l in out.strip().splitlines()
              if ":" in l}
-    assert "fp32 acc" in lines and "int8 acc" in lines \
+    assert "fp32 acc" in lines and "quant acc" in lines \
         and "agreement" in lines, out[-1500:]
     agree = float(lines["agreement"].split()[-1])
     assert agree > 0.9, out[-1500:]
