@@ -73,17 +73,34 @@ class DataParallelTrainer:
     """
 
     def __init__(self, block, loss_fn, optimizer, mesh: Optional[Mesh] = None,
-                 param_shardings=None):
+                 param_shardings=None, remat: bool = False,
+                 micro_batches: int = 1):
         """``param_shardings`` is the gluon-integrated model-parallel hook (the
         TPU-native replacement for the reference's ``ctx_group``/``group2ctx`` layer
         placement, graph_executor.cc:408): a dict mapping parameter-name suffixes to
         ``PartitionSpec``s, or a callable ``name -> PartitionSpec | None``. Unlisted
-        params are replicated. XLA/GSPMD inserts the tp collectives automatically."""
+        params are replicated. XLA/GSPMD inserts the tp collectives automatically.
+
+        ``remat=True`` wraps the loss in ``jax.checkpoint`` (rematerialization:
+        trade one extra forward's FLOPs for not keeping activations alive
+        across fwd→bwd — the reference's mirror/memonger capability). Use when
+        activation memory approaches HBM capacity (large batch/sequence);
+        benchmark/python/mfu_probe.py quantifies the tradeoff.
+
+        ``micro_batches=k`` accumulates gradients over k micro-batches inside
+        ONE jitted step (a ``lax.scan``): activation memory is that of
+        batch/k while the optimizer sees the full-batch gradient — the
+        measured cure for the large-batch HBM-capacity cliff (mfu_probe:
+        b512 peaks at 15.3/16 GB HBM and loses 8% throughput to scheduling
+        pressure; k=4 keeps the b128 working set). Micro-batches take every
+        k-th row so each stays evenly dp-sharded."""
         self.block = block
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh or get_default_mesh()
         self.param_shardings = param_shardings
+        self.remat = remat
+        self.micro_batches = int(micro_batches)
         self._step_fn = None
         self._params: List = []
         self._states: List = []
@@ -140,21 +157,61 @@ class DataParallelTrainer:
             saved = [p._data._data for p in param_handles]
             saved_aux = [p._data._data for p in aux_handles]
             try:
-                def loss_of(ps):
+                def loss_on(ps, auxs_in, xb, yb):
                     for p, v in zip(param_handles, ps):
                         p._data._data = v
                         p._data._version += 1
-                    for p, v in zip(aux_handles, auxs):
+                    for p, v in zip(aux_handles, auxs_in):
                         p._data._data = v
                         p._data._version += 1
                     with autograd.pause(train_mode=True):
-                        out = block(nd_mod.NDArray(x))
-                        loss = loss_fn(out, nd_mod.NDArray(y))
+                        out = block(nd_mod.NDArray(xb))
+                        loss = loss_fn(out, nd_mod.NDArray(yb))
                     new_auxs = [p._data._data for p in aux_handles]
                     return jnp.mean(loss.data), new_auxs
 
-                (loss_val, new_auxs), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(list(params))
+                k = self.micro_batches
+                if k > 1:
+                    # gradient accumulation: scan over k micro-batches, each
+                    # taking every k-th row (stays evenly dp-sharded);
+                    # activation working set shrinks k-fold, the optimizer
+                    # sees the mean full-batch gradient
+                    def loss_of(ps, auxs_in, xb, yb):
+                        f = (jax.checkpoint(loss_on) if self.remat
+                             else loss_on)
+                        return f(ps, auxs_in, xb, yb)
+
+                    xs = jnp.swapaxes(
+                        x.reshape((-1, k) + x.shape[1:]), 0, 1)
+                    ys = jnp.swapaxes(
+                        y.reshape((-1, k) + y.shape[1:]), 0, 1)
+
+                    def body(carry, xy):
+                        gacc, lacc, auxs_c = carry
+                        xb, yb = xy
+                        (lv, new_aux), g = jax.value_and_grad(
+                            loss_of, has_aux=True)(list(params), auxs_c,
+                                                   xb, yb)
+                        # accumulate in f32: summing k similar-magnitude bf16
+                        # grads in bf16 would compound rounding vs the k=1 step
+                        gacc = [a + gi.astype(jnp.float32)
+                                for a, gi in zip(gacc, g)]
+                        return (gacc, lacc + lv, new_aux), None
+
+                    init = ([jnp.zeros(p.shape, jnp.float32) for p in params],
+                            jnp.zeros((), jnp.float32), list(auxs))
+                    (gsum, lsum, new_auxs), _ = jax.lax.scan(
+                        body, init, (xs, ys))
+                    grads = [g / k for g in gsum]   # f32; caller casts per param
+                    loss_val = lsum / k
+                else:
+                    def loss_of(ps):
+                        f = (jax.checkpoint(loss_on) if self.remat
+                             else loss_on)
+                        return f(ps, list(auxs), x, y)
+
+                    (loss_val, new_auxs), grads = jax.value_and_grad(
+                        loss_of, has_aux=True)(list(params))
                 new_params, new_states = [], []
                 for i, (p, g, st) in enumerate(zip(params, grads, states)):
                     g = g.astype(p.dtype)
@@ -196,6 +253,11 @@ class DataParallelTrainer:
             self._collect(x)
             self._build()
             self._t = 0
+        if self.micro_batches > 1 and x.shape[0] % self.micro_batches:
+            raise ValueError(
+                f"batch size {x.shape[0]} is not divisible by "
+                f"micro_batches={self.micro_batches}; pad or drop the tail "
+                f"batch (ImageRecordIter marks it with .pad)")
         xs = shard_batch(x, self.mesh).data
         ys = shard_batch(y, self.mesh).data
         self._t += 1

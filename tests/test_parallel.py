@@ -165,3 +165,64 @@ def test_dp_tp_trainer_matches_serial():
     for k in pa:
         np.testing.assert_allclose(pa[k].data().asnumpy(), pb[k].data().asnumpy(),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_micro_batch_accumulation_matches_full_batch():
+    """micro_batches=k: the optimizer sees the mean full-batch gradient, so a
+    BN-free net must train identically (up to fp tolerance) to the k=1 step;
+    activation memory shrinks k-fold (the large-batch HBM-capacity cure,
+    benchmark/python/mfu_probe.py)."""
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import gluon, nd, optimizer, parallel
+    from mxtpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(16, 6).astype(np.float32)
+    y = rs.randint(0, 3, 16).astype(np.float32)
+
+    def make():
+        mx.rng.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh", in_units=6),
+                nn.Dense(3, in_units=8))
+        net.initialize(init=mx.initializer.Xavier())
+        return net
+
+    mesh = parallel.make_mesh((1,), ("dp",))
+    losses = {}
+    params = {}
+    for k in (1, 4):
+        net = make()
+        dpt = parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            optimizer.SGD(learning_rate=0.5), mesh, micro_batches=k)
+        ls = [dpt.step(nd.array(X), nd.array(y)) for _ in range(3)]
+        losses[k] = ls
+        # auto-naming differs between the two nets — compare in layer order
+        params[k] = [p.data().asnumpy()
+                     for _, p in sorted(net.collect_params().items())]
+    np.testing.assert_allclose(losses[1], losses[4], rtol=1e-5)
+    for a, b in zip(params[1], params[4]):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_micro_batch_with_remat_compiles():
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import gluon, nd, optimizer, parallel
+    from mxtpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=5))
+    net.initialize()
+    mesh = parallel.make_mesh((1,), ("dp",))
+    dpt = parallel.DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer.SGD(learning_rate=0.1), mesh, micro_batches=2, remat=True)
+    rs = np.random.RandomState(1)
+    l1 = dpt.step(nd.array(rs.randn(8, 5).astype(np.float32)),
+                  nd.array(rs.randint(0, 4, 8).astype(np.float32)))
+    assert np.isfinite(l1)
