@@ -116,3 +116,98 @@ def create_predictor(symbol_json: str, param_bytes: bytes,
     """Factory the C side calls (keeps the C code to one attribute lookup)."""
     return Predictor(symbol_json, param_bytes, input_names, input_shapes,
                      dev_type, dev_id)
+
+
+# ---------------------------------------------------------------------------
+# training ABI support (native/mxtpu_capi.cc MXNDArray* / MXImperativeInvoke /
+# MXAutograd* — the imperative slice of the reference's c_api.h:
+# MXNDArrayCreateEx :119, MXImperativeInvokeEx (c_api_ndarray.cc:81),
+# MXAutogradMarkVariables / MXAutogradBackwardEx (c_api_ndarray.cc:319-396)).
+# Handles crossing the C boundary ARE the NDArray PyObjects (the C side owns
+# a reference); this layer stays flat-buffers-in/objects-out.
+# ---------------------------------------------------------------------------
+
+def nd_create(shape, dtype_code: int):
+    import jax.numpy as jnp
+
+    from .base import dtype_from_id, dtype_np
+    from .ndarray.ndarray import NDArray
+    # the one framework-wide mshadow dtype enum (base.py:_DTYPE_ID — covers
+    # bool and bfloat16 too)
+    dt = dtype_np(dtype_from_id(int(dtype_code)))
+    return NDArray(jnp.zeros(tuple(int(d) for d in shape), dt))
+
+
+def nd_shape(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+def nd_dtype_code(arr) -> int:
+    from .base import dtype_id
+    return dtype_id(np.dtype(arr.dtype).name)
+
+
+def nd_copy_from(arr, data: bytes) -> None:
+    import jax.numpy as jnp
+    host = np.frombuffer(data, dtype=np.dtype(arr.dtype)).reshape(arr.shape)
+    arr._set_data(jnp.asarray(host))
+
+
+def nd_copy_to(arr) -> bytes:
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def _parse_param(v: str):
+    """Reference convention: op attrs cross the C boundary as STRINGS
+    (MXImperativeInvokeEx param_vals); parse python-literal-looking ones."""
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def invoke_op(name: str, inputs, param_keys, param_vals):
+    """Run a registry op imperatively; returns a LIST of NDArray outputs."""
+    from .ops import registry as reg
+    op = reg.get_op(name)
+    kwargs = {k: _parse_param(v) for k, v in zip(param_keys, param_vals)}
+    out = reg.invoke(op, *inputs, **kwargs)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def list_op_names():
+    from .ops import registry as reg
+    return reg.list_ops()
+
+
+def autograd_set_recording(flag: int) -> int:
+    from . import autograd
+    return int(autograd.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag: int) -> int:
+    from . import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+def autograd_mark_variables(arrs, grad_reqs) -> None:
+    from . import autograd
+    req_names = {0: "null", 1: "write", 2: "add"}
+    autograd.mark_variables(
+        list(arrs), grad_reqs=[req_names[int(r)] for r in grad_reqs])
+
+
+def autograd_backward(heads, head_grads, retain_graph: int) -> None:
+    from . import autograd
+    hg = None if not head_grads else list(head_grads)
+    autograd.backward(list(heads), head_grads=hg,
+                      retain_graph=bool(retain_graph))
+
+
+def nd_get_grad(arr):
+    g = arr.grad
+    if g is None:
+        raise ValueError("array has no gradient (not marked, or no backward "
+                         "has run)")
+    return g

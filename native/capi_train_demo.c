@@ -1,0 +1,152 @@
+/* Pure-C TRAINING client of the mxtpu C ABI (libmxtpu_capi.so).
+ *
+ * The reference's c_api.h training surface (MXNDArrayCreateEx,
+ * MXImperativeInvokeEx, MXAutogradMarkVariables, MXAutogradBackwardEx) lets
+ * any C FFI host run a training loop; this program proves the same
+ * capability here: it fits w for y = x·wᵀ by gradient descent using ONLY the
+ * C ABI — create arrays, mark the weight, record, FullyConnected forward,
+ * LinearRegressionOutput loss head, backward, read the grad, sgd_update.
+ *
+ * Prints one JSON line: {"ok":1,"loss_first":...,"loss_last":...}
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* NDArrayHandle;
+extern const char* MXGetLastError(void);
+extern int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                           int dev_id, int delay_alloc, int dtype,
+                           NDArrayHandle* out);
+extern int MXNDArrayFree(NDArrayHandle h);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
+                                    size_t size_bytes);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data,
+                                  size_t size_bytes);
+extern int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
+                             uint32_t* out_shape, uint32_t max_ndim);
+extern int MXNDArrayGetGrad(NDArrayHandle h, NDArrayHandle* out);
+extern int MXImperativeInvokeByName(const char* op, int num_in,
+                                    NDArrayHandle* in, int* num_out,
+                                    NDArrayHandle* out, int max_out,
+                                    int num_params, const char** keys,
+                                    const char** vals);
+extern int MXAutogradSetIsRecording(int flag, int* prev);
+extern int MXAutogradSetIsTraining(int flag, int* prev);
+extern int MXAutogradMarkVariables(uint32_t n, NDArrayHandle* vars,
+                                   uint32_t* reqs);
+extern int MXAutogradBackward(uint32_t n, NDArrayHandle* heads,
+                              NDArrayHandle* head_grads, int retain);
+extern int MXListAllOpNames(uint32_t* out_size, const char*** out_names);
+
+#define CHECK(expr)                                                    \
+  do {                                                                 \
+    if ((expr) != 0) {                                                 \
+      fprintf(stderr, "FAIL %s: %s\n", #expr, MXGetLastError());       \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+#define N 16
+#define D 4
+#define H 3
+
+int main(void) {
+  /* synthetic data: y = x * true_wᵀ */
+  float x_host[N * D], w_true[H * D], y_host[N * H], w_host[H * D];
+  for (int i = 0; i < N * D; ++i) x_host[i] = 0.05f * (float)((i * 7) % 40) - 1.0f;
+  for (int i = 0; i < H * D; ++i) w_true[i] = 0.1f * (float)((i * 3) % 11) - 0.5f;
+  for (int n = 0; n < N; ++n)
+    for (int h = 0; h < H; ++h) {
+      float acc = 0.f;
+      for (int d = 0; d < D; ++d) acc += x_host[n * D + d] * w_true[h * D + d];
+      y_host[n * H + h] = acc;
+    }
+  for (int i = 0; i < H * D; ++i) w_host[i] = 0.f;
+
+  uint32_t xs[2] = {N, D}, ws[2] = {H, D}, ys_[2] = {N, H};
+  NDArrayHandle x, w, y;
+  CHECK(MXNDArrayCreate(xs, 2, 1, 0, 0, 0, &x));
+  CHECK(MXNDArrayCreate(ws, 2, 1, 0, 0, 0, &w));
+  CHECK(MXNDArrayCreate(ys_, 2, 1, 0, 0, 0, &y));
+  CHECK(MXNDArraySyncCopyFromCPU(x, x_host, sizeof(x_host)));
+  CHECK(MXNDArraySyncCopyFromCPU(w, w_host, sizeof(w_host)));
+  CHECK(MXNDArraySyncCopyFromCPU(y, y_host, sizeof(y_host)));
+
+  /* registry sanity: the fused optimizer op we rely on must be listed */
+  uint32_t n_ops = 0;
+  const char** op_names = NULL;
+  CHECK(MXListAllOpNames(&n_ops, &op_names));
+  int have_sgd = 0;
+  for (uint32_t i = 0; i < n_ops; ++i)
+    if (strcmp(op_names[i], "sgd_update") == 0) have_sgd = 1;
+  if (!have_sgd) {
+    fprintf(stderr, "sgd_update missing from op registry\n");
+    return 1;
+  }
+
+  uint32_t req = 1; /* write */
+  CHECK(MXAutogradMarkVariables(1, &w, &req));
+
+  const char* fc_keys[2] = {"num_hidden", "no_bias"};
+  const char* fc_vals[2] = {"3", "True"};
+  const char* sgd_keys[1] = {"lr"};
+  const char* sgd_vals[1] = {"0.2"};
+
+  float loss_first = -1.f, loss_last = -1.f;
+  for (int it = 0; it < 30; ++it) {
+    int prev;
+    CHECK(MXAutogradSetIsRecording(1, &prev));
+    CHECK(MXAutogradSetIsTraining(1, &prev));
+
+    NDArrayHandle fc_in[2] = {x, w};
+    NDArrayHandle fc_out[1];
+    int n_out = 0;
+    CHECK(MXImperativeInvokeByName("FullyConnected", 2, fc_in, &n_out,
+                                   fc_out, 1, 2, fc_keys, fc_vals));
+    NDArrayHandle reg_in[2] = {fc_out[0], y};
+    NDArrayHandle reg_out[1];
+    CHECK(MXImperativeInvokeByName("LinearRegressionOutput", 2, reg_in,
+                                   &n_out, reg_out, 1, 0, NULL, NULL));
+    CHECK(MXAutogradBackward(1, reg_out, NULL, 0));
+    CHECK(MXAutogradSetIsRecording(0, &prev));
+
+    /* mean squared error of the prediction, on the host */
+    float pred[N * H];
+    CHECK(MXNDArraySyncCopyToCPU(fc_out[0], pred, sizeof(pred)));
+    float mse = 0.f;
+    for (int i = 0; i < N * H; ++i) {
+      float d = pred[i] - y_host[i];
+      mse += d * d;
+    }
+    mse /= (float)(N * H);
+    if (it == 0) loss_first = mse;
+    loss_last = mse;
+
+    NDArrayHandle g;
+    CHECK(MXNDArrayGetGrad(w, &g));
+    NDArrayHandle upd_in[2] = {w, g};
+    NDArrayHandle upd_out[1];
+    CHECK(MXImperativeInvokeByName("sgd_update", 2, upd_in, &n_out, upd_out,
+                                   1, 1, sgd_keys, sgd_vals));
+    /* write the updated weight back into w's buffer via host copy (the C
+     * surface is functional: ops return new arrays) */
+    float w_new[H * D];
+    CHECK(MXNDArraySyncCopyToCPU(upd_out[0], w_new, sizeof(w_new)));
+    CHECK(MXNDArraySyncCopyFromCPU(w, w_new, sizeof(w_new)));
+    MXNDArrayFree(upd_out[0]);
+    MXNDArrayFree(g);
+    MXNDArrayFree(fc_out[0]);
+    MXNDArrayFree(reg_out[0]);
+  }
+
+  MXNDArrayFree(x);
+  MXNDArrayFree(w);
+  MXNDArrayFree(y);
+
+  int ok = loss_last < 0.05f * loss_first;
+  printf("{\"ok\":%d,\"loss_first\":%.6f,\"loss_last\":%.6f}\n", ok,
+         loss_first, loss_last);
+  return ok ? 0 : 1;
+}

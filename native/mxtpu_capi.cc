@@ -19,6 +19,7 @@
 
 #include <Python.h>
 
+#include <cstdarg>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -328,6 +329,438 @@ int MXPredFree(PredictorHandle handle) {
   PyGILState_Release(gil);
   delete p;
   return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// training ABI — the imperative slice of the reference's c_api.h:
+// MXNDArrayCreateEx (:119), MXImperativeInvokeEx (c_api_ndarray.cc:81),
+// MXAutogradMarkVariables / MXAutogradBackwardEx (c_api_ndarray.cc:319-396),
+// MXListAllOpNames. An NDArrayHandle IS the owned PyObject* of the framework
+// NDArray; ops are addressed BY NAME (the registry replaces the reference's
+// AtomicSymbolCreator handles — declared deviation, same capability). With
+// the fused optimizer ops (sgd_update et al.) in the registry, a pure C
+// client can run a full train loop: create/copy arrays, mark variables,
+// record, invoke ops, backward, read grads, apply updates.
+// ---------------------------------------------------------------------------
+
+typedef void* NDArrayHandle;
+
+namespace {
+
+// shared result plumbing: call an impl-module function, return the PyObject*
+PyObject* call_impl(const char* fn, const char* fmt, ...) {
+  // caller must hold the GIL and have run ensure_ready()
+  PyObject* callable = PyObject_GetAttrString(g_impl_module, fn);
+  if (callable == nullptr) return nullptr;
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  if (args == nullptr) {
+    Py_DECREF(callable);
+    return nullptr;
+  }
+  if (!PyTuple_Check(args)) {  // single-arg format strings build a bare value
+    PyObject* t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+    if (args == nullptr) {
+      Py_DECREF(callable);
+      return nullptr;
+    }
+  }
+  PyObject* out = PyObject_CallObject(callable, args);
+  Py_DECREF(args);
+  Py_DECREF(callable);
+  return out;
+}
+
+// MXListAllOpNames backing store (stable for the process lifetime, like the
+// reference's per-process registries)
+std::vector<std::string> g_op_names;
+std::vector<const char*> g_op_name_ptrs;
+std::mutex g_op_names_mu;
+
+}  // namespace
+
+extern "C" {
+
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                    int dev_id, int delay_alloc, int dtype,
+                    NDArrayHandle* out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc;  // placement is XLA's
+  if (out == nullptr || (ndim > 0 && shape == nullptr)) {
+    g_last_error = "MXNDArrayCreate: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* shp = PyTuple_New(ndim);
+  if (shp != nullptr) {
+    for (uint32_t i = 0; i < ndim; ++i)
+      PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+    PyObject* arr = call_impl("nd_create", "(Oi)", shp, dtype);
+    Py_DECREF(shp);
+    if (arr == nullptr) {
+      set_error_from_python();
+    } else {
+      *out = arr;  // ownership transfers to the handle
+      rc = 0;
+    }
+  } else {
+    set_error_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_DECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, uint32_t* out_ndim,
+                      uint32_t* out_shape, uint32_t max_ndim) {
+  if (handle == nullptr || out_ndim == nullptr) {
+    g_last_error = "MXNDArrayGetShape: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* shp = call_impl("nd_shape", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (shp == nullptr) {
+    set_error_from_python();
+  } else {
+    Py_ssize_t nd = PyTuple_Size(shp);
+    *out_ndim = static_cast<uint32_t>(nd);
+    if (out_shape == nullptr) {
+      rc = 0;                              // ndim-only query
+    } else if (static_cast<uint32_t>(nd) > max_ndim) {
+      g_last_error = "MXNDArrayGetShape: shape buffer too small (array has " +
+                     std::to_string(nd) + " dims, caller provided " +
+                     std::to_string(max_ndim) + ")";
+    } else {
+      for (Py_ssize_t i = 0; i < nd; ++i)
+        out_shape[i] = static_cast<uint32_t>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i)));
+      rc = 0;
+    }
+    Py_DECREF(shp);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int* out) {
+  if (handle == nullptr || out == nullptr) {
+    g_last_error = "MXNDArrayGetDType: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("nd_dtype_code", "(O)",
+                          static_cast<PyObject*>(handle));
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    *out = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size_bytes) {
+  if (handle == nullptr || data == nullptr) {
+    g_last_error = "MXNDArraySyncCopyFromCPU: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(size_bytes));
+  if (buf != nullptr) {
+    PyObject* r = call_impl("nd_copy_from", "(OO)",
+                            static_cast<PyObject*>(handle), buf);
+    Py_DECREF(buf);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      Py_DECREF(r);
+      rc = 0;
+    }
+  } else {
+    set_error_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data,
+                           size_t size_bytes) {
+  if (handle == nullptr || data == nullptr) {
+    g_last_error = "MXNDArraySyncCopyToCPU: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("nd_copy_to", "(O)",
+                          static_cast<PyObject*>(handle));
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    char* raw = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(r, &raw, &len) == 0) {
+      if (static_cast<size_t>(len) != size_bytes) {
+        g_last_error = "MXNDArraySyncCopyToCPU: size mismatch (array has " +
+                       std::to_string(len) + " bytes, caller asked " +
+                       std::to_string(size_bytes) + ")";
+      } else {
+        std::memcpy(data, raw, static_cast<size_t>(len));
+        rc = 0;
+      }
+    } else {
+      set_error_from_python();
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXImperativeInvokeByName(const char* op_name, int num_inputs,
+                             NDArrayHandle* inputs, int* num_outputs,
+                             NDArrayHandle* outputs, int max_outputs,
+                             int num_params, const char** param_keys,
+                             const char** param_vals) {
+  if (op_name == nullptr || num_outputs == nullptr ||
+      (num_inputs > 0 && inputs == nullptr) ||
+      (num_params > 0 && (param_keys == nullptr || param_vals == nullptr))) {
+    g_last_error = "MXImperativeInvokeByName: null argument";
+    return -1;
+  }
+  if (outputs == nullptr) {
+    // count-only queries would run the op and destroy its results (double
+    // compute for the two-call pattern) — single-call convention here: pass
+    // a buffer sized by the op's num_outputs (MXListAllOpNames +
+    // ops.registry.describe expose it; few ops exceed 4)
+    g_last_error = "MXImperativeInvokeByName: outputs buffer required "
+                   "(single-call convention; size it from the op's "
+                   "num_outputs)";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* ins = PyList_New(num_inputs);
+  PyObject* keys = PyList_New(num_params);
+  PyObject* vals = PyList_New(num_params);
+  if (ins != nullptr && keys != nullptr && vals != nullptr) {
+    bool fail = false;
+    for (int i = 0; i < num_inputs && !fail; ++i) {
+      PyObject* o = static_cast<PyObject*>(inputs[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(ins, i, o);
+    }
+    for (int i = 0; i < num_params && !fail; ++i) {
+      PyObject* k = PyUnicode_FromString(param_keys[i]);
+      PyObject* v = PyUnicode_FromString(param_vals[i]);
+      if (k == nullptr || v == nullptr) { Py_XDECREF(k); Py_XDECREF(v);
+        fail = true; break; }
+      PyList_SET_ITEM(keys, i, k);
+      PyList_SET_ITEM(vals, i, v);
+    }
+    if (!fail) {
+      PyObject* outs = call_impl("invoke_op", "(sOOO)", op_name, ins, keys,
+                                 vals);
+      if (outs == nullptr) {
+        set_error_from_python();
+      } else {
+        Py_ssize_t n = PyList_Size(outs);
+        *num_outputs = static_cast<int>(n);
+        if (n <= max_outputs) {
+          for (Py_ssize_t i = 0; i < n; ++i) {
+            PyObject* o = PyList_GET_ITEM(outs, i);
+            Py_INCREF(o);          // handle ownership for the caller
+            outputs[i] = o;
+          }
+          rc = 0;
+        } else {
+          g_last_error = "MXImperativeInvokeByName: output buffer too small";
+        }
+        Py_DECREF(outs);
+      }
+    }
+  }
+  if (PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(ins);
+  Py_XDECREF(keys);
+  Py_XDECREF(vals);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  if (out_size == nullptr || out_array == nullptr) {
+    g_last_error = "MXListAllOpNames: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  std::lock_guard<std::mutex> lock(g_op_names_mu);
+  if (g_op_names.empty()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* names = call_impl("list_op_names", "()");
+    if (names == nullptr) {
+      set_error_from_python();
+      PyGILState_Release(gil);
+      return -1;
+    }
+    Py_ssize_t n = PyList_Size(names);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char* s = PyUnicode_AsUTF8(PyList_GET_ITEM(names, i));
+      if (s != nullptr) g_op_names.emplace_back(s);
+    }
+    Py_DECREF(names);
+    PyGILState_Release(gil);
+    g_op_name_ptrs.reserve(g_op_names.size());
+    for (const auto& s : g_op_names) g_op_name_ptrs.push_back(s.c_str());
+  }
+  *out_size = static_cast<uint32_t>(g_op_name_ptrs.size());
+  *out_array = g_op_name_ptrs.data();
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("autograd_set_recording", "(i)", is_recording);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("autograd_set_training", "(i)", is_training);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
+                            uint32_t* reqs_array) {
+  if (num_var > 0 && (var_handles == nullptr || reqs_array == nullptr)) {
+    g_last_error = "MXAutogradMarkVariables: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* vars = PyList_New(num_var);
+  PyObject* reqs = PyList_New(num_var);
+  if (vars != nullptr && reqs != nullptr) {
+    for (uint32_t i = 0; i < num_var; ++i) {
+      PyObject* o = static_cast<PyObject*>(var_handles[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(vars, i, o);
+      PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+    }
+    PyObject* r = call_impl("autograd_mark_variables", "(OO)", vars, reqs);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      Py_DECREF(r);
+      rc = 0;
+    }
+  }
+  if (PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(vars);
+  Py_XDECREF(reqs);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* head_grad_handles, int retain_graph) {
+  if (num_output > 0 && output_handles == nullptr) {
+    g_last_error = "MXAutogradBackward: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* heads = PyList_New(num_output);
+  PyObject* hgrads = head_grad_handles == nullptr
+      ? PyList_New(0) : PyList_New(num_output);
+  if (heads != nullptr && hgrads != nullptr) {
+    for (uint32_t i = 0; i < num_output; ++i) {
+      PyObject* o = static_cast<PyObject*>(output_handles[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(heads, i, o);
+      if (head_grad_handles != nullptr) {
+        PyObject* g = static_cast<PyObject*>(head_grad_handles[i]);
+        Py_INCREF(g);
+        PyList_SET_ITEM(hgrads, i, g);
+      }
+    }
+    PyObject* r = call_impl("autograd_backward", "(OOi)", heads, hgrads,
+                            retain_graph);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      Py_DECREF(r);
+      rc = 0;
+    }
+  }
+  if (PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(heads);
+  Py_XDECREF(hgrads);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
+  if (handle == nullptr || out == nullptr) {
+    g_last_error = "MXNDArrayGetGrad: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* g = call_impl("nd_get_grad", "(O)",
+                          static_cast<PyObject*>(handle));
+  if (g == nullptr) {
+    set_error_from_python();
+  } else {
+    *out = g;  // ownership to caller
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
 }
 
 }  // extern "C"

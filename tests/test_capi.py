@@ -174,3 +174,31 @@ def test_cpp_header_binding(tmp_path):
          "wrong_input", ",".join(str(d) for d in in_shape)],
         capture_output=True, text=True, timeout=300, env=env)
     assert r2.returncode == 1 and "not an argument of the symbol" in r2.stderr
+
+
+def test_pure_c_training_client(tmp_path):
+    """The TRAINING slice of the C ABI (reference c_api.h MXNDArrayCreateEx /
+    MXImperativeInvokeEx / MXAutogradMarkVariables / MXAutogradBackwardEx):
+    a pure-C program fits a linear model end-to-end — create arrays, record,
+    FullyConnected + LinearRegressionOutput, backward, sgd_update — and its
+    loss must collapse."""
+    demo_src = os.path.join(REPO, "native", "capi_train_demo.c")
+    demo_bin = str(tmp_path / "capi_train_demo")
+    libdir = os.path.dirname(capi.lib_path())
+    try:
+        subprocess.run(
+            ["gcc", "-O2", demo_src, "-o", demo_bin,
+             f"-L{libdir}", "-lmxtpu_capi", f"-Wl,-rpath,{libdir}"],
+            check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"cannot compile C training demo: {e}")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([demo_bin], capture_output=True, text=True,
+                       timeout=300, env=env)
+    assert r.returncode == 0, f"train demo failed: {r.stderr[-2000:]}"
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["ok"] == 1
+    assert payload["loss_last"] < 0.05 * payload["loss_first"], payload

@@ -352,3 +352,13 @@ def test_split_and_load():
     data = nd.arange(0, 12).reshape((6, 2))
     parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(0)])
     assert len(parts) == 2 and parts[0].shape == (3, 2)
+
+
+def test_bidirectional_step_raises_reference_message():
+    """Single-stepping a BidirectionalCell raises exactly as the reference
+    does (gluon/rnn/rnn_cell.py:1007) — stepping can't see the future half."""
+    from mxtpu.gluon import rnn as grnn
+    cell = grnn.BidirectionalCell(grnn.GRUCell(4, input_size=3),
+                                  grnn.GRUCell(4, input_size=3))
+    with pytest.raises(NotImplementedError, match="cannot be stepped"):
+        cell(nd.zeros((2, 3)), cell.begin_state(2))
