@@ -103,3 +103,19 @@ def __getattr__(name):
     fn = _make_wrapper(name)
     setattr(_this, name, fn)
     return fn
+
+
+def cast_storage(arr, stype: str):
+    """Reference op-name parity (cast_storage, src/operator/tensor/
+    cast_storage.cc): convert default/row_sparse/csr storage. Lives at the nd
+    level, not the raw registry — sparse handles don't cross the raw-array
+    op boundary."""
+    from . import sparse as _sparse
+    return _sparse.cast_storage(arr, stype)
+
+
+def sparse_retain(data, indices):
+    """Reference op-name parity (_sparse_retain, sparse_retain-inl.h): keep
+    only the requested rows of a row_sparse array."""
+    from . import sparse as _sparse
+    return _sparse.retain(data, indices)

@@ -31,6 +31,7 @@ _FUSED = {
     "rmsprop_update": (("n",), False),
     "rmspropalex_update": (("n", "g", "delta"), False),
     "ftrl_update": (("z", "n"), True),
+    "_sparse_adagrad_update": (("history",), True),
 }
 
 

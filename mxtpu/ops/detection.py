@@ -423,7 +423,7 @@ def _psroi_pooling(data, rois, spatial_scale: float = 1.0, output_dim: int = 0,
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_h, bin_w = rh / k, rw / k
-        img = data[b].reshape(output_dim, k * k, H, W)
+        img = data[b].reshape(output_dim, group * group, H, W)
 
         def bin_val(iy, ix):
             hs = jnp.floor(y1 + iy * bin_h)
@@ -533,3 +533,73 @@ def _register_aliases():
 
 
 _register_aliases()
+
+
+@register("DeformablePSROIPooling", namespace=NS,
+          aliases=("deformable_psroi_pooling",), num_outputs=1)
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale: float = 1.0,
+                              output_dim: int = 0, group_size: int = 1,
+                              pooled_size: int = 7, part_size: int = 0,
+                              sample_per_part: int = 4,
+                              trans_std: float = 0.0, no_trans: bool = False):
+    """contrib/deformable_psroi_pooling.cc (Deformable ConvNets): PSROI
+    pooling whose bins shift by learned normalized offsets ``trans``
+    (R, 2*cls, part, part), sampled bilinearly ``sample_per_part``² per bin.
+
+    TPU shape: one vmapped roi program of static (k, k, s, s) gathers — no
+    data-dependent loops; `no_trans=True` degrades to offset-free sampling
+    (the op's own fallback when trans is absent)."""
+    k = pooled_size
+    part = part_size if part_size > 0 else k
+    group = group_size if group_size > 0 else k
+    N, Ck, H, W = data.shape
+    s = sample_per_part
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / k, rw / k
+        sub_h, sub_w = bin_h / s, bin_w / s
+        img = data[b].reshape(output_dim, group * group, H, W)
+
+        def bin_val(iy, ix):
+            py = jnp.minimum(iy * part // k, part - 1)
+            px = jnp.minimum(ix * part // k, part - 1)
+            if no_trans or tr is None:
+                dy = dx = 0.0
+            else:
+                # class 0 offsets (the detection head's shared-offset mode)
+                dy = tr[0, py, px] * trans_std * rh
+                dx = tr[1, py, px] * trans_std * rw
+            oy = jnp.arange(s, dtype=jnp.float32)
+            ox = jnp.arange(s, dtype=jnp.float32)
+            yy = y1 + iy * bin_h + (oy + 0.5) * sub_h + dy
+            xx = x1 + ix * bin_w + (ox + 0.5) * sub_w + dx
+            gidx = (iy * group // k) * group + (ix * group // k)
+            chan = img[:, gidx]                         # (output_dim, H, W)
+            yg, xg = jnp.meshgrid(yy, xx, indexing="ij")
+            yf, xf = yg.reshape(-1), xg.reshape(-1)
+            # reference kernel (deformable_psroi_pooling.cu:84): samples more
+            # than 0.5px outside are SKIPPED (count divides only in-bounds),
+            # the rest clamp to the border
+            valid = ((yf >= -0.5) & (yf <= H - 0.5) &
+                     (xf >= -0.5) & (xf <= W - 0.5))
+            yc = jnp.clip(yf, 0.0, H - 1.0)
+            xc = jnp.clip(xf, 0.0, W - 1.0)
+            vals = _bilinear_gather(chan, yc, xc)
+            cnt = jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(vals * valid[None, :], axis=-1) / cnt
+
+        iy = jnp.arange(k)
+        ix = jnp.arange(k)
+        vals = jax.vmap(lambda y: jax.vmap(lambda x: bin_val(y, x))(ix))(iy)
+        return vals.transpose(2, 0, 1)                  # (output_dim, k, k)
+
+    if trans is None or no_trans:
+        return jax.vmap(lambda r: one_roi(r, None))(rois)
+    return jax.vmap(one_roi)(rois, trans)

@@ -246,3 +246,69 @@ _greater_scalar = _cmp_scalar("_greater_scalar", jnp.greater)
 _greater_equal_scalar = _cmp_scalar("_greater_equal_scalar", jnp.greater_equal)
 _lesser_scalar = _cmp_scalar("_lesser_scalar", jnp.less)
 _lesser_equal_scalar = _cmp_scalar("_lesser_equal_scalar", jnp.less_equal)
+
+
+# scalar-overload variants the reference registers as internal ops
+# (elemwise_binary_scalar_op*.cc; the nd frontend lowers `x % 2` etc. here)
+@register("_maximum_scalar", aliases=("_MaximumScalar",))
+def _maximum_scalar(data, scalar: float = 0.0):
+    return jnp.maximum(data, scalar)
+
+
+@register("_minimum_scalar", aliases=("_MinimumScalar",))
+def _minimum_scalar(data, scalar: float = 0.0):
+    return jnp.minimum(data, scalar)
+
+
+@register("_mod_scalar", aliases=("_ModScalar",))
+def _mod_scalar(data, scalar: float = 1.0):
+    return jnp.mod(data, scalar)
+
+
+@register("_rmod_scalar", aliases=("_RModScalar",))
+def _rmod_scalar(data, scalar: float = 1.0):
+    return jnp.mod(scalar, data)
+
+
+@register("_hypot_scalar", aliases=("_HypotScalar",))
+def _hypot_scalar(data, scalar: float = 0.0):
+    return jnp.hypot(data, scalar)
+
+
+@register("_logical_and_scalar", differentiable=False)
+def _logical_and_scalar(data, scalar: float = 0.0):
+    return jnp.logical_and(data != 0, bool(scalar)).astype(data.dtype)
+
+
+@register("_logical_or_scalar", differentiable=False)
+def _logical_or_scalar(data, scalar: float = 0.0):
+    return jnp.logical_or(data != 0, bool(scalar)).astype(data.dtype)
+
+
+@register("_logical_xor_scalar", differentiable=False)
+def _logical_xor_scalar(data, scalar: float = 0.0):
+    return jnp.logical_xor(data != 0, bool(scalar)).astype(data.dtype)
+
+
+@register("_grad_add")
+def _grad_add(lhs, rhs):
+    """Gradient-accumulation add (elemwise_op_common; identical math to
+    elemwise_add — a separate name so grad_req='add' graphs serialize)."""
+    return lhs + rhs
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def _add_n_op(*args):
+    """Sum of N arrays in one op (src/operator/tensor/elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("_square_sum", differentiable=True)
+def _square_sum(data, axis=None, keepdims: bool = False):
+    """Fused square+sum (src/operator/tensor/square_sum.cc — the rsp-grad
+    norm helper); one fusion either way under XLA."""
+    ax = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.sum(data * data, axis=ax, keepdims=keepdims)

@@ -116,3 +116,12 @@ def _crop(data, x: int = 0, y: int = 0, width: int = 1, height: int = 1):
     if data.ndim == 3:
         return data[y:y + height, x:x + width, :]
     return data[:, y:y + height, x:x + width, :]
+
+
+# the reference registers image ops under BOTH mx.nd.image.* and internal
+# root names (_image_normalize etc., src/operator/image/image_random.cc)
+from .registry import alias as _alias  # noqa: E402
+for _n in ("normalize", "to_tensor", "resize", "crop", "flip_left_right",
+           "flip_top_bottom", "random_flip_left_right",
+           "random_flip_top_bottom"):
+    _alias(f"image.{_n}", f"_image_{_n}")

@@ -375,3 +375,44 @@ def _unravel_index(data, shape):
 from .registry import alias as _alias  # noqa: E402
 _alias("Embedding", "SparseEmbedding", "_contrib_SparseEmbedding")
 _alias("Embedding", "SparseEmbedding", namespace="contrib")
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs; rhs only donates graph attrs/storage kind (the
+    reference's sparse-grad plumbing helper, elemwise_unary_op_basic.cc)."""
+    return lhs
+
+
+@register("_slice_assign", aliases=("_crop_assign",))
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """lhs with lhs[begin:end:step] = rhs (matrix_op.cc _slice_assign — the
+    graph form of __setitem__; imperative setitem uses .at[] directly)."""
+    idx = tuple(slice(b, e, s if s else None)
+                for b, e, s in zip(begin, end, step or (None,) * len(begin)))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(lhs, scalar: float = 0.0, begin=(), end=(), step=()):
+    idx = tuple(slice(b, e, s if s else None)
+                for b, e, s in zip(begin, end, step or (None,) * len(begin)))
+    return lhs.at[idx].set(scalar)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=None):
+    """lhs with lhs[indices] = rhs (indexing_op.cc _scatter_set_nd; the
+    scatter-write twin of gather_nd)."""
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+# the _scatter_*_scalar / _scatter_elemwise_div family exists in the
+# reference solely to keep SPARSE storage sparse under scalar/broadcast math
+# (elemwise_binary_scalar_op_extended.cc); dense math is identical, and the
+# sparse path here applies ops to stored values via the sparse module
+from .registry import get_op as _get_op  # noqa: E402
+_alias("_plus_scalar", "_scatter_plus_scalar")
+_alias("_minus_scalar", "_scatter_minus_scalar")
+_alias("elemwise_div", "_scatter_elemwise_div")

@@ -532,3 +532,54 @@ def _identity_attach_kl_sparse_reg(data, sparseness_target: float = 0.1,
     for sigmoid activations (src/operator/identity_attach_KL_sparse_reg.cc;
     Hinton's guideTR P11). Pair only with sigmoid outputs (rho in (0,1))."""
     return _kl_sparse_reg(data, float(sparseness_target), float(penalty))
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput (src/operator/svm_output.cc — hinge-loss head)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output_core(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_output_fwd(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_output_bwd(margin, reg_coef, use_linear, res, g):
+    # svm_output.cc L1_SVM :31 / L2_SVM :50 — the injected hinge gradient
+    # (incoming cotangent ignored, like every legacy loss head)
+    out, label = res
+    k = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1],
+                       dtype=out.dtype)
+    if use_linear:   # L1-SVM: ±reg_coef where the margin is violated
+        grad_k = -(margin > out).astype(out.dtype) * reg_coef
+        grad_o = (margin > -out).astype(out.dtype) * reg_coef
+    else:            # L2-SVM: linear in the violation
+        grad_k = -jnp.where(margin > out, 2.0 * (margin - out), 0.0) * reg_coef
+        grad_o = jnp.where(margin > -out, 2.0 * (margin + out), 0.0) * reg_coef
+    grad = k * grad_k + (1.0 - k) * grad_o
+    return grad, jnp.zeros_like(label)
+
+
+_svm_output_core.defvjp(_svm_output_fwd, _svm_output_bwd)
+
+
+@register("SVMOutput", aliases=("svm_output",))
+def _svm_output(data, label, margin: float = 1.0,
+                regularization_coefficient: float = 1.0,
+                use_linear: bool = False):
+    """Hinge-loss head (svm_output-inl.h): forward identity, backward the
+    L1/L2-SVM margin gradient per class."""
+    return _svm_output_core(data, label, float(margin),
+                            float(regularization_coefficient),
+                            bool(use_linear))
+
+
+# v1-legacy / cuDNN op-name aliases (reference registers *_v1 and
+# CuDNNBatchNorm as distinct legacy entry points over the same math)
+alias("BatchNorm", "BatchNorm_v1", "CuDNNBatchNorm")
+alias("Convolution", "Convolution_v1")
+alias("Pooling", "Pooling_v1")

@@ -160,3 +160,15 @@ def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
     w = ((jnp.sign(z) * lamda1 - z) / ((beta + jnp.sqrt(n)) / lr + wd)
          * (jnp.abs(z) > lamda1))
     return w.astype(weight.dtype), z, n
+
+
+@register("_sparse_adagrad_update", num_outputs=2, differentiable=False,
+          aliases=("adagrad_update",))
+def sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad update (optimizer_op-inl.h:1635 AdagradParam / AdagradUpdate;
+    the reference registers only the row-sparse form — the nd wrapper's lazy
+    path delivers that, this kernel is the row-slab math)."""
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    history = history + g * g
+    return weight - lr * g / (jnp.sqrt(history) + epsilon), history

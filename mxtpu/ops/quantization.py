@@ -176,3 +176,111 @@ def quantize_weight(w, per_channel_axis=0):
     scale = 127.0 / jnp.maximum(absmax, 1e-30)
     w_q = jnp.clip(jnp.round(w * scale), -127, 127).astype(jnp.int8)
     return w_q, scale.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# quantized graph ops (src/operator/quantization/quantized_conv.cc,
+# quantized_fully_connected.cc, quantized_pooling.cc, quantized_flatten.cc):
+# int8/uint8 in, int32 accumulator + its float range out — composes with
+# contrib.requantize exactly like the reference's quantize->op->requantize
+# chains. quantize_net's fused path remains the production route; these ops
+# exist for graph-level parity and manual pipelines.
+# ---------------------------------------------------------------------------
+
+
+def _in_scale(q, min_r, max_r):
+    """quantization scale implied by a tensor's dtype + travelling range."""
+    if q.dtype == jnp.uint8:
+        return 255.0 / jnp.maximum(max_r, 1e-30)
+    return 127.0 / jnp.maximum(jnp.maximum(jnp.abs(min_r), jnp.abs(max_r)),
+                               1e-30)
+
+
+def _acc_range(scale_d, scale_w):
+    """Range descriptor for the int32 accumulator: real = acc * absmax/2^31-1
+    (the contract contrib.requantize consumes)."""
+    absmax = 2147483647.0 / (scale_d * scale_w)
+    return -absmax, absmax
+
+
+@register("quantized_flatten", namespace=NS, num_outputs=3,
+          differentiable=False)
+def _quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("quantized_pooling", namespace=NS, num_outputs=3,
+          differentiable=False)
+def _quantized_pooling(data, min_data, max_data, kernel=(2, 2),
+                       pool_type: str = "max", stride=(2, 2), pad=(0, 0)):
+    """Pooling straight on the quantized ints; the range travels unchanged
+    (max pool) / exactly (avg divides the int32 sum)."""
+    kh, kw = kernel
+    sh, sw = stride
+    x = data.astype(jnp.int32)
+    pads = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    if pool_type == "max":
+        init = jnp.iinfo(jnp.int32).min
+        out = lax.reduce_window(jnp.pad(x, pads, constant_values=init), init,
+                                lax.max, (1, 1, kh, kw), (1, 1, sh, sw),
+                                "VALID")
+        return out.astype(data.dtype), min_data, max_data
+    summed = lax.reduce_window(jnp.pad(x, pads), 0, lax.add,
+                               (1, 1, kh, kw), (1, 1, sh, sw), "VALID")
+    out = (summed / (kh * kw)).astype(data.dtype)
+    return out, min_data, max_data
+
+
+@register("quantized_fully_connected", namespace=NS, num_outputs=3,
+          differentiable=False)
+def _quantized_fully_connected(data, weight, min_data, max_data, min_weight,
+                               max_weight, num_hidden: int = 0,
+                               no_bias: bool = True):
+    if not no_bias:
+        raise NotImplementedError(
+            "quantized_fully_connected: bias inputs are not bound — fold the "
+            "bias after requantize/dequantize (quantize_net's fused path "
+            "does this), or call with no_bias=True")
+    sd = _in_scale(data, min_data, max_data)
+    sw = _in_scale(weight, min_weight, max_weight)
+    x = data.astype(jnp.int32)
+    if data.dtype == jnp.uint8:
+        x = x - 128
+    acc = lax.dot_general(x.astype(jnp.int8), weight,
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    if data.dtype == jnp.uint8:
+        acc = acc + zero_point_corr_dense(weight)
+    lo, hi = _acc_range(sd, sw)
+    return acc, lo, hi
+
+
+@register("quantized_conv", namespace=NS, num_outputs=3, differentiable=False)
+def _quantized_conv(data, weight, min_data, max_data, min_weight, max_weight,
+                    kernel=(1, 1), stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                    num_filter: int = 0, num_group: int = 1,
+                    no_bias: bool = True, layout: str = "NCHW"):
+    if not no_bias:
+        raise NotImplementedError(
+            "quantized_conv: bias inputs are not bound — fold the bias after "
+            "requantize/dequantize, or call with no_bias=True")
+    if layout != "NCHW":
+        raise NotImplementedError(f"quantized_conv: layout {layout!r} "
+                                  f"(NCHW only)")
+    sd = _in_scale(data, min_data, max_data)
+    sw = _in_scale(weight, min_weight, max_weight)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    conv_kw = dict(window_strides=tuple(stride),
+                   padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+                   dimension_numbers=dn, feature_group_count=num_group)
+    x = data
+    if data.dtype == jnp.uint8:
+        x = (data.astype(jnp.int32) - 128).astype(jnp.int8)
+    acc = lax.conv_general_dilated(x, weight,
+                                   preferred_element_type=jnp.int32, **conv_kw)
+    if data.dtype == jnp.uint8:
+        acc = acc + zero_point_corr_conv(x.shape, weight, stride, pad, dilate,
+                                         num_group)
+    lo, hi = _acc_range(sd, sw)
+    return acc, lo, hi

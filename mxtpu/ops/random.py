@@ -149,3 +149,47 @@ def _sample_gamma(alpha, beta, shape=None, dtype="float32", key=None):
     g = jax.random.gamma(k, alpha.reshape(alpha.shape + (1,) * len(s)),
                          jnp.shape(alpha) + s, dtype_np(dtype))
     return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register("sample_exponential", namespace=NS, differentiable=False)
+def _sample_exponential(lam, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    s = _shape(shape)
+    e = jax.random.exponential(k, jnp.shape(lam) + s, dtype_np(dtype))
+    return e / lam.reshape(lam.shape + (1,) * len(s))
+
+
+@register("sample_poisson", namespace=NS, differentiable=False)
+def _sample_poisson(lam, shape=None, dtype="float32", key=None):
+    k = key if key is not None else rng.next_key()
+    s = _shape(shape)
+    return jax.random.poisson(
+        k, lam.reshape(lam.shape + (1,) * len(s)),
+        jnp.shape(lam) + s).astype(dtype_np(dtype))
+
+
+@register("sample_negative_binomial", namespace=NS, differentiable=False)
+def _sample_negative_binomial(k, p, shape=None, dtype="float32", key=None):
+    kk = key if key is not None else rng.next_key()
+    k1, k2 = jax.random.split(kk)
+    s = _shape(shape)
+    kr = k.reshape(k.shape + (1,) * len(s))
+    pr = p.reshape(p.shape + (1,) * len(s))
+    lam = jax.random.gamma(k1, kr, jnp.shape(k) + s) * ((1 - pr) / pr)
+    return jax.random.poisson(k2, lam, jnp.shape(k) + s).astype(dtype_np(dtype))
+
+
+@register("sample_generalized_negative_binomial", namespace=NS,
+          differentiable=False)
+def _sample_gen_negative_binomial(mu, alpha, shape=None, dtype="float32",
+                                  key=None):
+    kk = key if key is not None else rng.next_key()
+    k1, k2 = jax.random.split(kk)
+    s = _shape(shape)
+    mur = mu.reshape(mu.shape + (1,) * len(s))
+    ar = alpha.reshape(alpha.shape + (1,) * len(s))
+    r = 1.0 / jnp.maximum(ar, 1e-12)
+    p = r / (r + mur)
+    lam = jax.random.gamma(k1, r, jnp.shape(mu) + s) * ((1 - p) / p)
+    lam = jnp.where(ar == 0, jnp.broadcast_to(mur, lam.shape), lam)
+    return jax.random.poisson(k2, lam, jnp.shape(mu) + s).astype(dtype_np(dtype))

@@ -9,6 +9,8 @@ Gate orders match the reference: LSTM [i, f, c, o]; GRU [r, z, n].
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -82,3 +84,105 @@ def _rnn_scan(data, h0, c0_or_w, *rest, mode: str = "lstm", reverse: bool = Fals
     if mode == "lstm":
         return outs, carry[0], carry[1]
     return outs, carry[0]
+
+
+# ---------------------------------------------------------------------------
+# fused RNN op (src/operator/rnn.cc "RNN": cuDNN-packed parameter vector)
+# ---------------------------------------------------------------------------
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _rnn_resolve(kwargs):
+    from .. import autograd
+    from .. import rng as rng_mod
+    if kwargs.get("_training") is None:
+        kwargs["_training"] = autograd.is_training()
+    if kwargs.get("key") is None and kwargs.get("p", 0.0) > 0 \
+            and kwargs["_training"]:
+        kwargs["key"] = rng_mod.next_key()
+    return kwargs
+
+
+def _slice_packed(params, num_layers, input_size, h, gates, dirs):
+    """Walk the reference's packed layout (python/mxnet/rnn/rnn_cell.py:600
+    FusedRNNCell._slice_weights): per layer, per direction — G i2h gate
+    weights then G h2h gate weights; then all biases in the same order.
+    Returns weights[layer][dir] = (i2h_w (G*h, in_l), i2h_b, h2h_w, h2h_b)."""
+    out = []
+    p = 0
+
+    def take(n, shape):
+        nonlocal p
+        seg = lax.dynamic_slice_in_dim(params, p, n).reshape(shape)
+        p += n
+        return seg
+
+    for layer in range(num_layers):
+        in_l = input_size if layer == 0 else dirs * h
+        row = []
+        for _ in range(dirs):
+            i2h = take(gates * h * in_l, (gates * h, in_l))
+            h2h = take(gates * h * h, (gates * h, h))
+            row.append([i2h, None, h2h, None])
+        out.append(row)
+    for layer in range(num_layers):
+        for d in range(dirs):
+            out[layer][d][1] = take(gates * h, (gates * h,))
+            out[layer][d][3] = take(gates * h, (gates * h,))
+    return out
+
+
+@register("RNN", num_outputs=-1, resolve_kwargs=_rnn_resolve)
+def _rnn_fused(data, parameters, state, state_cell=None, *,
+               state_size: int, num_layers: int, mode: str = "lstm",
+               bidirectional: bool = False, p: float = 0.0,
+               state_outputs: bool = False, key=None,
+               _training: Optional[bool] = None):
+    """The reference's fused multi-layer RNN op (rnn-inl.h; parameter vector
+    packed in the FusedRNNCell/cuDNN layout, rnn_cell.py:600). data (T,N,I);
+    state (layers*dirs, N, H); lstm also takes state_cell. Dropout ``p``
+    applies BETWEEN layers in training, like cuDNN. Returns output
+    (T, N, H*dirs) (+ hT[, cT] when state_outputs).
+
+    TPU formulation: the packed vector is sliced into per-layer/direction
+    gate blocks once at trace time, then each layer runs the same lax.scan
+    kernel as ``rnn_scan`` — no workspace management, no cuDNN descriptor
+    zoo (GetRNNWorkspaceSize et al. collapse)."""
+    h = state_size
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    T, N, input_size = data.shape
+    weights = _slice_packed(parameters, num_layers, input_size, h, gates, dirs)
+
+    x = data
+    h_outs, c_outs = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            i2h_w, i2h_b, h2h_w, h2h_b = weights[layer][d]
+            # one layer+direction = one rnn_scan call (the registered
+            # single-layer kernel owns the scan/flip/carry logic)
+            if mode == "lstm":
+                outs, hT, cT = _rnn_scan(x, state[idx], state_cell[idx],
+                                         i2h_w, i2h_b, h2h_w, h2h_b,
+                                         mode=mode, reverse=d == 1)
+                c_outs.append(cT)
+            else:
+                outs, hT = _rnn_scan(x, state[idx], i2h_w, i2h_b, h2h_w,
+                                     h2h_b, mode=mode, reverse=d == 1)
+            dir_outs.append(outs)
+            h_outs.append(hT)
+        x = dir_outs[0] if dirs == 1 else jnp.concatenate(dir_outs, axis=-1)
+        if p > 0.0 and _training and key is not None and \
+                layer < num_layers - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(mask, x / (1.0 - p), 0.0).astype(x.dtype)
+    if not state_outputs:
+        return x
+    hT = jnp.stack(h_outs)
+    if mode == "lstm":
+        return x, hT, jnp.stack(c_outs)
+    return x, hT
