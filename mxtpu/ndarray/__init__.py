@@ -71,15 +71,9 @@ def moveaxis(a, source, destination):
     return NDArray(jnp.moveaxis(a._data, source, destination))
 
 
-def add_n(*args):
-    """mx.nd.add_n / ElementWiseSum."""
-    out = args[0]
-    for a in args[1:]:
-        out = out + a
-    return out
-
-
-ElementWiseSum = add_n
+# add_n / ElementWiseSum / _sum resolve to the registered fused op
+# (ops/elementwise.py) via the auto-generated wrappers — one tape node, not
+# N-1 recorded binary adds
 
 
 # sparse sub-namespace (mx.nd.sparse parity)

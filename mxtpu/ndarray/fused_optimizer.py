@@ -32,6 +32,7 @@ _FUSED = {
     "rmspropalex_update": (("n", "g", "delta"), False),
     "ftrl_update": (("z", "n"), True),
     "_sparse_adagrad_update": (("history",), True),
+    "adagrad_update": (("history",), True),
 }
 
 

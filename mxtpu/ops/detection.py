@@ -602,4 +602,10 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale: float = 1.0
 
     if trans is None or no_trans:
         return jax.vmap(lambda r: one_roi(r, None))(rois)
+    if trans.shape[1] != 2:
+        raise NotImplementedError(
+            "DeformablePSROIPooling: class-aware offsets (trans second dim "
+            f"{trans.shape[1]} = 2*num_classes > 2) are not bound — pass the "
+            "shared (R, 2, part, part) offsets (reference class_id indexing "
+            "is per-channel)")
     return jax.vmap(one_roi)(rois, trans)

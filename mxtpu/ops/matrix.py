@@ -412,7 +412,6 @@ def _scatter_set_nd(lhs, rhs, indices, shape=None):
 # reference solely to keep SPARSE storage sparse under scalar/broadcast math
 # (elemwise_binary_scalar_op_extended.cc); dense math is identical, and the
 # sparse path here applies ops to stored values via the sparse module
-from .registry import get_op as _get_op  # noqa: E402
 _alias("_plus_scalar", "_scatter_plus_scalar")
 _alias("_minus_scalar", "_scatter_minus_scalar")
 _alias("elemwise_div", "_scatter_elemwise_div")

@@ -189,11 +189,10 @@ def quantize_weight(w, per_channel_axis=0):
 
 
 def _in_scale(q, min_r, max_r):
-    """quantization scale implied by a tensor's dtype + travelling range."""
-    if q.dtype == jnp.uint8:
-        return 255.0 / jnp.maximum(max_r, 1e-30)
-    return 127.0 / jnp.maximum(jnp.maximum(jnp.abs(min_r), jnp.abs(max_r)),
-                               1e-30)
+    """quantization scale implied by a tensor's dtype + travelling range
+    (one formula — _scale_of — keyed on the carried dtype)."""
+    return _scale_of(min_r, max_r,
+                     "uint8" if q.dtype == jnp.uint8 else "int8")
 
 
 def _acc_range(scale_d, scale_w):
@@ -227,7 +226,8 @@ def _quantized_pooling(data, min_data, max_data, kernel=(2, 2),
         return out.astype(data.dtype), min_data, max_data
     summed = lax.reduce_window(jnp.pad(x, pads), 0, lax.add,
                                (1, 1, kh, kw), (1, 1, sh, sw), "VALID")
-    out = (summed / (kh * kw)).astype(data.dtype)
+    # round, don't truncate: floor() would bias every avg activation -0.5 LSB
+    out = jnp.round(summed / (kh * kw)).astype(data.dtype)
     return out, min_data, max_data
 
 

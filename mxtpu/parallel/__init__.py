@@ -3,7 +3,8 @@
 This package is the re-imagining of the reference's distributed stack (SURVEY.md §2.3):
 Comm/NCCL/ps-lite → XLA collectives over ICI/DCN; DataParallelExecutorGroup → sharded
 SPMD steps; ``ctx_group`` model parallelism → pjit shardings. Long-context sequence
-parallelism (ring attention) lives in ``ring_attention``.
+parallelism lives in ``ring_attention`` (K/V rotation, O(T/n) memory) and
+``ulysses`` (all-to-all head/sequence reshuffle, 2 collectives).
 """
 
 from . import collectives
@@ -18,6 +19,8 @@ from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, get_default_mesh,
                    make_mesh, set_default_mesh)
 from . import ring_attention
 from .ring_attention import ring_attention_inner, ring_self_attention
+from . import ulysses
+from .ulysses import ulysses_attention_inner, ulysses_self_attention
 from . import pipeline
 from .pipeline import gpipe
 from . import moe

@@ -1,0 +1,93 @@
+"""All-to-all sequence parallelism (DeepSpeed-Ulysses style) — the second
+long-context scaling mode next to ring attention (SURVEY.md §5).
+
+Where ring attention keeps Q resident and ROTATES K/V around the ICI ring
+(n-1 neighbor hops, per-device memory O(T/n)), the all-to-all formulation
+RESHUFFLES the parallel axis: sequence-sharded activations (B, H, T/n, D)
+become head-sharded (B, H/n, T, D) through one ``lax.all_to_all``, every
+device then runs ordinary full-sequence attention over its head group (any
+kernel — the Pallas flash kernel here), and a second all_to_all restores
+sequence sharding. Two collectives total regardless of sequence length, at
+the cost of each device briefly holding the FULL sequence for H/n heads —
+the right trade when heads ≥ devices and T is long but fits (the Ulysses
+paper's regime); ring wins when even one head's full T doesn't fit.
+
+Composable with dp/tp over other mesh axes exactly like ring attention.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import Mesh, get_default_mesh
+
+__all__ = ["ulysses_attention_inner", "ulysses_self_attention"]
+
+
+def ulysses_attention_inner(q, k, v, axis_name: str, causal: bool = False,
+                            scale: Optional[float] = None):
+    """Call INSIDE shard_map: q,k,v are sequence-sharded chunks (B, H, t, D)
+    with H divisible by the axis size. all_to_all swaps seq-sharding for
+    head-sharding, a single full-attention kernel runs per head group, and
+    the inverse all_to_all restores (B, H, t, D)."""
+    from ..ops.attention import flash_chunk
+
+    n = lax.psum(1, axis_name)
+    B, H, t, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"ulysses: num_heads {H} must be divisible by the "
+                         f"{axis_name!r} axis size {n} (use ring attention "
+                         f"for head-scarce models)")
+
+    def seq_to_heads(x):
+        # (B, H, t, D) -> (B, H/n, n*t, D): split heads across the axis,
+        # concatenate the sequence chunks
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+    out, _lse = flash_chunk(qh, kh, vh, causal, s)
+    return heads_to_seq(out)
+
+
+def ulysses_self_attention(q, k, v, mesh: Optional[Mesh] = None,
+                           axis_name: str = "sp", causal: bool = False,
+                           scale: Optional[float] = None):
+    """User-level entry mirroring ``ring_self_attention``: full (B,H,T,D)
+    arrays, sequence sharded over ``axis_name``; returns the output sharded
+    the same way. Records one tape node when autograd is live."""
+    from ..ndarray.ndarray import NDArray
+    wrap = isinstance(q, NDArray)
+    handles = (q, k, v) if wrap else ()
+    if wrap:
+        q, k, v = q.data, k.data, v.data
+    mesh = mesh or get_default_mesh()
+    if axis_name not in mesh.axis_names:
+        axis_name = mesh.axis_names[0]
+    spec = P(None, None, axis_name, None)
+
+    fn = jax.shard_map(
+        partial(ulysses_attention_inner, axis_name=axis_name, causal=causal,
+                scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    out = fn(q, k, v)
+    if not wrap:
+        return out
+    result = NDArray(out)
+    from .. import autograd
+    if autograd.is_recording():
+        autograd.record_custom_node(lambda q_, k_, v_: fn(q_, k_, v_),
+                                    list(handles), [result])
+    return result
