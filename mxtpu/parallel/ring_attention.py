@@ -113,14 +113,13 @@ def ring_attention_inner(q, k, v, axis_name: str, causal: bool = False,
     return o_acc.astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
-                        axis_name: str = "sp", causal: bool = False,
-                        scale: Optional[float] = None):
-    """User-level entry: full (B,H,T,D) arrays, sequence sharded over ``axis_name``.
-
-    Shards T over the mesh axis, runs the ring, returns the full output (sharded the
-    same way — composable with dp over another axis).
-    """
+def sharded_attention_entry(inner, q, k, v, mesh: Optional[Mesh],
+                            axis_name: str, causal: bool,
+                            scale: Optional[float]):
+    """Shared user-level plumbing for every sequence-parallel attention mode
+    (ring here, all-to-all in ``parallel.ulysses``): NDArray unwrap, mesh /
+    axis-name fallback, the T-sharded shard_map, and the one tape node that
+    lets gradients flow to the q/k/v handles."""
     from ..ndarray.ndarray import NDArray
     wrap = isinstance(q, NDArray)
     handles = (q, k, v) if wrap else ()
@@ -132,9 +131,9 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     spec = P(None, None, axis_name, None)
 
     fn = jax.shard_map(
-        partial(ring_attention_inner, axis_name=axis_name, causal=causal,
-                scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+        partial(inner, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
     out = fn(q, k, v)
     if not wrap:
         return out
@@ -145,3 +144,15 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
         autograd.record_custom_node(lambda q_, k_, v_: fn(q_, k_, v_),
                                     list(handles), [result])
     return result
+
+
+def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
+                        axis_name: str = "sp", causal: bool = False,
+                        scale: Optional[float] = None):
+    """User-level entry: full (B,H,T,D) arrays, sequence sharded over ``axis_name``.
+
+    Shards T over the mesh axis, runs the ring, returns the full output (sharded the
+    same way — composable with dp over another axis).
+    """
+    return sharded_attention_entry(ring_attention_inner, q, k, v, mesh,
+                                   axis_name, causal, scale)

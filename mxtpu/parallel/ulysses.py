@@ -67,27 +67,6 @@ def ulysses_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     """User-level entry mirroring ``ring_self_attention``: full (B,H,T,D)
     arrays, sequence sharded over ``axis_name``; returns the output sharded
     the same way. Records one tape node when autograd is live."""
-    from ..ndarray.ndarray import NDArray
-    wrap = isinstance(q, NDArray)
-    handles = (q, k, v) if wrap else ()
-    if wrap:
-        q, k, v = q.data, k.data, v.data
-    mesh = mesh or get_default_mesh()
-    if axis_name not in mesh.axis_names:
-        axis_name = mesh.axis_names[0]
-    spec = P(None, None, axis_name, None)
-
-    fn = jax.shard_map(
-        partial(ulysses_attention_inner, axis_name=axis_name, causal=causal,
-                scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    out = fn(q, k, v)
-    if not wrap:
-        return out
-    result = NDArray(out)
-    from .. import autograd
-    if autograd.is_recording():
-        autograd.record_custom_node(lambda q_, k_, v_: fn(q_, k_, v_),
-                                    list(handles), [result])
-    return result
+    from .ring_attention import sharded_attention_entry
+    return sharded_attention_entry(ulysses_attention_inner, q, k, v, mesh,
+                                   axis_name, causal, scale)
