@@ -247,6 +247,78 @@ def bench_inference():
     return results
 
 
+def bench_word_lm(steps: int = 30):
+    """Word-language-model training throughput (BASELINE config #3:
+    example/gluon/word_language_model LSTM + the cuDNN RNN path — here the
+    fused lax.scan RNN). 2-layer LSTM 650/650 (the reference's --large
+    config), T=35 BPTT, batch 128, synthetic token stream; reports tokens/s
+    through DataParallelTrainer (fwd+bwd+update in one program)."""
+    from mxtpu import nd, optimizer as opt_mod
+    from mxtpu.gluon import nn, rnn
+    from mxtpu.gluon.block import HybridBlock
+    from mxtpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxtpu.parallel import DataParallelTrainer, shard_batch
+    from mxtpu.parallel.mesh import data_parallel_mesh
+
+    vocab, embed, hidden, layers, T, B = 10000, 650, 650, 2, 35, 128
+
+    class LMBlock(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.embedding = nn.Embedding(vocab, embed)
+            self.lstm = rnn.LSTM(hidden, num_layers=layers, layout="TNC",
+                                 input_size=embed)
+            self.decoder = nn.Dense(vocab, in_units=hidden, flatten=False)
+
+        def forward(self, x):
+            out = self.lstm(self.embedding(x))   # states=None -> out only
+            return self.decoder(out)
+
+    net = LMBlock()
+    net.initialize()
+    mesh = data_parallel_mesh()
+    # dp shards the BATCH axis, which is axis 1 under TNC — transpose in/out
+    # at the bench level instead: feed (N, T) and let the block transpose
+    rs = np.random.RandomState(0)
+    x_tokens = rs.randint(0, vocab, (T, B)).astype(np.int32)
+    y_tokens = np.roll(x_tokens, -1, axis=0).astype(np.int32)
+
+    class LMWrap(HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner          # attribute assignment auto-registers
+
+        def forward(self, x):                  # x (N, T) -> logits (N*T, V)
+            from mxtpu.ndarray.ndarray import NDArray
+            logits = self.inner(NDArray(x.data.T))       # (T, N, V)
+            return NDArray(logits.data.reshape(-1, vocab))
+
+    wrap = LMWrap(net)
+    dpt = DataParallelTrainer(
+        wrap, SoftmaxCrossEntropyLoss(),
+        opt_mod.SGD(learning_rate=1.0, momentum=0.9), mesh)
+    # pre-shard once like bench_train — per-step placement would change the
+    # methodology vs the train legs
+    x = shard_batch(nd.array(x_tokens.T), mesh)   # (N, T): dp shards axis 0
+    # labels flatten T-major to pair with logits.reshape(-1, V) from (T,N,V)
+    y = shard_batch(nd.array(y_tokens.reshape(-1).astype(np.float32)), mesh)
+
+    loss = dpt.step_async(x, y)
+    float(loss.data)                            # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = dpt.step_async(x, y)
+    final = float(loss.data)
+    dt = time.perf_counter() - t0
+    tok_s = steps * T * B / dt
+    out = {"tokens_s": round(tok_s, 1), "step_ms": round(1e3 * dt / steps, 2),
+           "config": f"lstm{layers}x{hidden}_T{T}_b{B}",
+           "final_loss": round(final, 3)}
+    log(f"[word_lm] {out['config']}: {tok_s:.0f} tokens/s "
+        f"({out['step_ms']} ms/step)")
+    return out
+
+
 def bench_attention():
     """Flash-attention microbench: Pallas kernel vs XLA reference, fwd+bwd,
     at a production shape (B=4, H=16, T=2048, D=64 — the head dim that used to
@@ -551,6 +623,7 @@ def main():
     for cfg in TRAIN_CONFIGS:
         train[cfg[0]] = bench_train(*cfg)
     e2e = bench_train_e2e(train.get("bf16_b128", {}).get("step_ms"))
+    lm = bench_word_lm()
     score = bench_inference()
     attn = bench_attention()
     pipe = bench_pipeline()
@@ -568,6 +641,7 @@ def main():
         "mfu": best["mfu"],
         "train": train,
         "train_e2e": e2e,
+        "word_lm": lm,
         "inference_img_s": score,
         "attention_ms": attn,
         "pipeline_img_s": pipe,
