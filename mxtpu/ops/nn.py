@@ -583,3 +583,23 @@ def _svm_output(data, label, margin: float = 1.0,
 alias("BatchNorm", "BatchNorm_v1", "CuDNNBatchNorm")
 alias("Convolution", "Convolution_v1")
 alias("Pooling", "Pooling_v1")
+
+
+@register("SyncBatchNorm", namespace="contrib",
+          aliases=("_contrib_SyncBatchNorm",))
+def _sync_batch_norm_op(data, gamma, beta, moving_mean, moving_var,
+                        eps: float = 1e-3, momentum: float = 0.9,
+                        fix_gamma: bool = True, use_global_stats: bool = False,
+                        ndev: int = 1, key: str = "", axis: int = 1,
+                        cudnn_off: bool = False):
+    """contrib SyncBatchNorm op name (src/operator/contrib/sync_batch_norm.cc).
+
+    Inference form = plain BatchNorm over running stats; the cross-device
+    TRAINING sync lives in ``gluon.contrib.nn.SyncBatchNorm`` (under a
+    dp-sharded input XLA computes global-batch statistics, which IS the sync
+    semantic — pmean only matters inside explicit shard_map regions). ndev/
+    key are the reference's comm-handshake knobs — accepted, nothing to
+    coordinate here."""
+    return _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                       momentum=momentum, fix_gamma=fix_gamma,
+                       use_global_stats=use_global_stats, axis=axis)

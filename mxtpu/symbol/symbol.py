@@ -220,7 +220,11 @@ def eval_graph(heads, feed: Dict[str, Any], is_train: bool = False,
             else:
                 kw[pname] = val
         attrs = _op_attrs(node)
-        if node.op_key == "BatchNorm" and is_train \
+        # the whole BatchNorm FAMILY takes the batch-stats path in training
+        # (SyncBatchNorm's cross-device sync = global-batch stats under a
+        # dp-sharded input; the v1/cuDNN names alias the same op)
+        if node.op_key in ("BatchNorm", "BatchNorm_v1", "CuDNNBatchNorm",
+                           "contrib.SyncBatchNorm") and is_train \
                 and not attrs.get("use_global_stats", False):
             res, mean, v = _reg.get_op("batch_norm_train").fn(
                 kw["data"], kw["gamma"], kw["beta"],

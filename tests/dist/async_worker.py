@@ -59,3 +59,30 @@ np.testing.assert_allclose(final.asnumpy(), 1.0 - 0.5 * world * steps,
                            rtol=1e-6)
 
 print("ASYNC_WORKER_OK", flush=True)
+
+# --- O(rows) sparse push/pull across REAL processes ------------------------
+# (CMD_PUSH_ROWS / CMD_PULL_ROWS over the wire; round-4 sparse transport)
+from mxtpu.ndarray import sparse
+
+kv3 = mx.kvstore.create("dist_async")
+NROWS, NCOLS = 64, 4
+kv3.init("emb", nd.array(np.zeros((NROWS, NCOLS), np.float32)))
+kv3.barrier()
+mine = [rank * 2, rank * 2 + 1]           # disjoint rows per rank
+g = sparse.row_sparse_array((np.ones((2, NCOLS), np.float32), mine),
+                            shape=(NROWS, NCOLS))
+kv3.push("emb", g)
+kv3.barrier()                             # all sparse pushes applied
+out_sp = sparse.row_sparse_array((np.zeros((2, NCOLS), np.float32), mine),
+                                 shape=(NROWS, NCOLS))
+kv3.row_sparse_pull("emb", out=out_sp, row_ids=nd.array(mine))
+# kv2 installed a server-wide SGD(lr=0.5) above — the server optimizer is
+# GLOBAL (reference kvstore_dist_server semantics), so each touched row took
+# one lazy SGD step: 0 - 0.5*1 = -0.5; untouched rows never moved
+np.testing.assert_allclose(out_sp.data.asnumpy(), -0.5)
+full = nd.zeros((NROWS, NCOLS))
+kv3.pull("emb", out=full)
+np.testing.assert_allclose(full.asnumpy()[2 * world:], 0.0)
+np.testing.assert_allclose(full.asnumpy()[:2 * world], -0.5)
+
+print("ASYNC_SPARSE_OK", flush=True)

@@ -89,6 +89,7 @@ def test_dist_async_two_processes():
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
     assert out.count("ASYNC_WORKER_OK") == 2, out[-4000:]
+    assert out.count("ASYNC_SPARSE_OK") == 2, out[-4000:]
 
 
 def test_async_optimizer_state_roundtrip(async_kv, tmp_path):

@@ -97,3 +97,9 @@ def test_quantize_inference_example():
         and "agreement" in lines, out[-1500:]
     agree = float(lines["agreement"].split()[-1])
     assert agree > 0.9, out[-1500:]
+
+
+def test_long_context_attention_example():
+    out = _run_example("long_context_attention.py",
+                       ["--seq-len", "1024"], virtual_devices=8)
+    assert "LONG_CONTEXT_OK" in out, out[-1500:]

@@ -254,3 +254,42 @@ def test_quantized_ops_reject_bias_and_layout():
     wc = nd.zeros((1, 1, 3, 3)).astype("int8")
     with pytest.raises(NotImplementedError, match="NCHW"):
         nd.contrib.quantized_conv(xc, wc, r, r, r, r, layout="NHWC")
+
+
+def test_sync_batch_norm_op_name():
+    """The registered contrib.SyncBatchNorm op (inference form) matches
+    BatchNorm over running stats, and the SYMBOLIC training path takes the
+    batch-stats branch (the BN-family special case in eval_graph), updating
+    the moving aux states."""
+    from mxtpu import symbol as sym
+    from mxtpu.symbol.symbol import _reset_names
+    rs = np.random.RandomState(0)
+    xv = rs.randn(4, 3, 5, 5).astype(np.float32)
+    g = np.ones((3,), np.float32)
+    b = np.zeros((3,), np.float32)
+    mm = rs.randn(3).astype(np.float32) * 0.1
+    mv = np.abs(rs.randn(3)).astype(np.float32) + 0.5
+
+    out = nd.contrib.SyncBatchNorm(nd.array(xv), nd.array(g), nd.array(b),
+                                   nd.array(mm), nd.array(mv), ndev=2,
+                                   key="bn0")
+    ref = nd.BatchNorm(nd.array(xv), nd.array(g), nd.array(b), nd.array(mm),
+                       nd.array(mv))
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5)
+
+    _reset_names()
+    data = sym.Variable("data")
+    net = sym.contrib.SyncBatchNorm(data, name="sbn", fix_gamma=False)
+    exe = net.bind(mx.cpu(), {"data": nd.array(xv),
+                              "sbn_gamma": nd.array(g),
+                              "sbn_beta": nd.array(b)},
+                   aux_states={"sbn_moving_mean": nd.array(np.zeros(3, np.float32)),
+                               "sbn_moving_var": nd.array(np.ones(3, np.float32))})
+    out_train = exe.forward(is_train=True)[0].asnumpy()
+    # training path normalizes by BATCH stats: per-channel mean ~0, var ~1
+    ch = out_train.transpose(1, 0, 2, 3).reshape(3, -1)
+    np.testing.assert_allclose(ch.mean(axis=1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(ch.var(axis=1), 1.0, atol=1e-2)
+    # moving stats moved off their init toward the batch stats
+    new_mm = exe.aux_dict["sbn_moving_mean"].asnumpy()
+    assert np.abs(new_mm).max() > 0, new_mm
