@@ -211,3 +211,50 @@ def nd_get_grad(arr):
         raise ValueError("array has no gradient (not marked, or no backward "
                          "has run)")
     return g
+
+
+# ---------------------------------------------------------------------------
+# KVStore C surface (reference c_api.h MXKVStoreCreate :1359 / Init / PushEx /
+# PullEx / GetRank / GetGroupSize / Barrier / Free). Handles are KVStore
+# PyObjects; values are the same NDArray handles the training ABI uses. The
+# reference's MXKVStoreSetUpdater C-callback is replaced by the restricted
+# optimizer spec (name + JSON kwargs — the PS wire format of mxtpu/ps.py),
+# which also works for the dist_async server role.
+# ---------------------------------------------------------------------------
+
+
+def kv_create(kv_type: str):
+    from . import kvstore
+    return kvstore.create(kv_type)
+
+
+def kv_init(kv, keys, vals) -> None:
+    kv.init(list(keys), list(vals))
+
+
+def kv_push(kv, keys, vals) -> None:
+    kv.push(list(keys), list(vals))
+
+
+def kv_pull(kv, keys, outs) -> None:
+    kv.pull(list(keys), out=list(outs))
+
+
+def kv_rank(kv) -> int:
+    return int(kv.rank)
+
+
+def kv_size(kv) -> int:
+    return int(kv.num_workers)
+
+
+def kv_barrier(kv) -> None:
+    kv.barrier()
+
+
+def kv_set_optimizer(kv, spec_json: str) -> None:
+    import json as _json
+
+    from . import optimizer as opt_mod
+    spec = _json.loads(spec_json)
+    kv.set_optimizer(opt_mod.create(spec["name"], **spec.get("kwargs", {})))

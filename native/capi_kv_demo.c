@@ -1,0 +1,97 @@
+/* Pure-C KVStore client of the mxtpu C ABI.
+ *
+ * The reference's MXKVStore* c_api.h surface from plain C: create a local
+ * kvstore, init keys, install an optimizer from the restricted JSON spec,
+ * push gradients, pull updated weights — the data-parallel worker loop's
+ * communication half with no Python in the host program.
+ *
+ * Prints one JSON line: {"ok":1,"w0":...,"rank":...,"size":...}
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* NDArrayHandle;
+typedef void* KVStoreHandle;
+extern const char* MXGetLastError(void);
+extern int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                           int dev_id, int delay_alloc, int dtype,
+                           NDArrayHandle* out);
+extern int MXNDArrayFree(NDArrayHandle h);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
+                                    size_t size_bytes);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data,
+                                  size_t size_bytes);
+extern int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+extern int MXKVStoreFree(KVStoreHandle h);
+extern int MXKVStoreInitEx(KVStoreHandle h, uint32_t num, const char** keys,
+                           NDArrayHandle* vals);
+extern int MXKVStorePushEx(KVStoreHandle h, uint32_t num, const char** keys,
+                           NDArrayHandle* vals, int priority);
+extern int MXKVStorePullEx(KVStoreHandle h, uint32_t num, const char** keys,
+                           NDArrayHandle* outs, int priority);
+extern int MXKVStoreGetRank(KVStoreHandle h, int* out);
+extern int MXKVStoreGetGroupSize(KVStoreHandle h, int* out);
+extern int MXKVStoreBarrier(KVStoreHandle h);
+extern int MXKVStoreSetOptimizer(KVStoreHandle h, const char* spec_json);
+
+#define CHECK(expr)                                                    \
+  do {                                                                 \
+    if ((expr) != 0) {                                                 \
+      fprintf(stderr, "FAIL %s: %s\n", #expr, MXGetLastError());       \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+#define N 8
+
+int main(void) {
+  uint32_t shape[1] = {N};
+  float host[N];
+
+  KVStoreHandle kv;
+  CHECK(MXKVStoreCreate("local", &kv));
+  int rank = -1, size = -1;
+  CHECK(MXKVStoreGetRank(kv, &rank));
+  CHECK(MXKVStoreGetGroupSize(kv, &size));
+  CHECK(MXKVStoreBarrier(kv));
+
+  NDArrayHandle w, g, out;
+  CHECK(MXNDArrayCreate(shape, 1, 1, 0, 0, 0, &w));
+  CHECK(MXNDArrayCreate(shape, 1, 1, 0, 0, 0, &g));
+  CHECK(MXNDArrayCreate(shape, 1, 1, 0, 0, 0, &out));
+  for (int i = 0; i < N; ++i) host[i] = 2.0f;
+  CHECK(MXNDArraySyncCopyFromCPU(w, host, sizeof(host)));
+  for (int i = 0; i < N; ++i) host[i] = 1.0f;
+  CHECK(MXNDArraySyncCopyFromCPU(g, host, sizeof(host)));
+
+  const char* keys[1] = {"w"};
+  NDArrayHandle vals[1] = {w};
+  CHECK(MXKVStoreInitEx(kv, 1, keys, vals));
+  CHECK(MXKVStoreSetOptimizer(
+      kv, "{\"name\": \"sgd\", \"kwargs\": {\"learning_rate\": 0.25}}"));
+
+  for (int it = 0; it < 4; ++it) {
+    NDArrayHandle gv[1] = {g};
+    CHECK(MXKVStorePushEx(kv, 1, keys, gv, 0));
+  }
+  NDArrayHandle outs[1] = {out};
+  CHECK(MXKVStorePullEx(kv, 1, keys, outs, 0));
+  CHECK(MXNDArraySyncCopyToCPU(out, host, sizeof(host)));
+
+  /* 4 SGD steps of lr 0.25 on grad 1: w = 2 - 4*0.25 = 1 */
+  int ok = 1;
+  for (int i = 0; i < N; ++i)
+    if (fabsf(host[i] - 1.0f) > 1e-5f) ok = 0;
+  if (rank != 0 || size != 1) ok = 0;
+
+  MXNDArrayFree(w);
+  MXNDArrayFree(g);
+  MXNDArrayFree(out);
+  MXKVStoreFree(kv);
+  printf("{\"ok\":%d,\"w0\":%.6f,\"rank\":%d,\"size\":%d}\n", ok, host[0],
+         rank, size);
+  return ok ? 0 : 1;
+}

@@ -763,4 +763,173 @@ int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
   return rc;
 }
 
+// ---------------------------------------------------------------------------
+// KVStore surface — c_api.h MXKVStoreCreate (:1359) / Init / PushEx / PullEx /
+// GetRank / GetGroupSize / Barrier / Free. A KVStoreHandle is the owned
+// PyObject* of the framework KVStore; values are the SAME NDArray handles as
+// the training ABI. MXKVStoreSetUpdater's C-callback is replaced by
+// MXKVStoreSetOptimizer taking the restricted JSON spec
+// {"name": ..., "kwargs": {...}} — the same format the dist_async parameter
+// server accepts on its wire, so one spec drives local and server roles.
+// ---------------------------------------------------------------------------
+
+typedef void* KVStoreHandle;
+
+namespace {
+
+// shared helper: run impl fn(kv, [keys], [handles]) for init/push/pull
+int kv_keys_vals(const char* fn, KVStoreHandle handle, uint32_t num,
+                 const char** keys, NDArrayHandle* vals) {
+  if (handle == nullptr || (num > 0 && (keys == nullptr || vals == nullptr))) {
+    g_last_error = std::string(fn) + ": null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* ks = PyList_New(num);
+  PyObject* vs = PyList_New(num);
+  if (ks != nullptr && vs != nullptr) {
+    bool fail = false;
+    for (uint32_t i = 0; i < num && !fail; ++i) {
+      PyObject* k = PyUnicode_FromString(keys[i]);
+      if (k == nullptr) { fail = true; break; }
+      PyList_SET_ITEM(ks, i, k);
+      PyObject* v = static_cast<PyObject*>(vals[i]);
+      Py_INCREF(v);
+      PyList_SET_ITEM(vs, i, v);
+    }
+    if (!fail) {
+      PyObject* r = call_impl(fn, "(OOO)",
+                              static_cast<PyObject*>(handle), ks, vs);
+      if (r == nullptr) {
+        set_error_from_python();
+      } else {
+        Py_DECREF(r);
+        rc = 0;
+      }
+    }
+  }
+  if (PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int kv_get_int(const char* fn, KVStoreHandle handle, int* out) {
+  if (handle == nullptr || out == nullptr) {
+    g_last_error = std::string(fn) + ": null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl(fn, "(O)", static_cast<PyObject*>(handle));
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    long v = PyLong_AsLong(r);
+    Py_DECREF(r);
+    if (PyErr_Occurred()) {          // non-int return: report, don't leak
+      set_error_from_python();
+    } else {
+      *out = static_cast<int>(v);
+      rc = 0;
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+}  // namespace
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  if (type == nullptr || out == nullptr) {
+    g_last_error = "MXKVStoreCreate: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* kv = call_impl("kv_create", "(s)", type);
+  if (kv == nullptr) {
+    set_error_from_python();
+  } else {
+    *out = kv;
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  if (handle == nullptr) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_DECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals) {
+  return kv_keys_vals("kv_init", handle, num, keys, vals);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  (void)priority;  // XLA owns scheduling
+  return kv_keys_vals("kv_push", handle, num, keys, vals);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* outs, int priority) {
+  (void)priority;
+  return kv_keys_vals("kv_pull", handle, num, keys, outs);
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int* out) {
+  return kv_get_int("kv_rank", handle, out);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int* out) {
+  return kv_get_int("kv_size", handle, out);
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  if (handle == nullptr) {
+    g_last_error = "MXKVStoreBarrier: null handle";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("kv_barrier", "(O)",
+                          static_cast<PyObject*>(handle));
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXKVStoreSetOptimizer(KVStoreHandle handle, const char* spec_json) {
+  if (handle == nullptr || spec_json == nullptr) {
+    g_last_error = "MXKVStoreSetOptimizer: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("kv_set_optimizer", "(Os)",
+                          static_cast<PyObject*>(handle), spec_json);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
 }  // extern "C"

@@ -202,3 +202,29 @@ def test_pure_c_training_client(tmp_path):
     payload = json.loads(r.stdout.strip().splitlines()[-1])
     assert payload["ok"] == 1
     assert payload["loss_last"] < 0.05 * payload["loss_first"], payload
+
+
+def test_pure_c_kvstore_client(tmp_path):
+    """The KVStore slice of the C ABI (c_api.h MXKVStore*): a pure-C program
+    creates a local store, installs an optimizer from the restricted JSON
+    spec, pushes gradients and pulls the updated weight."""
+    demo_src = os.path.join(REPO, "native", "capi_kv_demo.c")
+    demo_bin = str(tmp_path / "capi_kv_demo")
+    libdir = os.path.dirname(capi.lib_path())
+    try:
+        subprocess.run(
+            ["gcc", "-O2", demo_src, "-o", demo_bin,
+             f"-L{libdir}", "-lmxtpu_capi", f"-Wl,-rpath,{libdir}", "-lm"],
+            check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"cannot compile C kvstore demo: {e}")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([demo_bin], capture_output=True, text=True,
+                       timeout=300, env=env)
+    assert r.returncode == 0, f"kv demo failed: {r.stderr[-2000:]}"
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["ok"] == 1 and abs(payload["w0"] - 1.0) < 1e-5
+    assert payload["rank"] == 0 and payload["size"] == 1
