@@ -64,6 +64,7 @@ SCORE_MODELS = [
     ("alexnet", 224),
     ("resnet50_v1", 224),
     ("mobilenet1.0", 224),
+    ("inceptionv3", 299),
 ]
 SCORE_BATCHES = [1, 32]
 

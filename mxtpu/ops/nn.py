@@ -15,6 +15,8 @@ Design notes vs the reference:
 
 from __future__ import annotations
 
+import math
+
 from functools import partial
 from typing import Optional, Sequence, Tuple
 
@@ -154,7 +156,9 @@ def _pooling(data, kernel=(), pool_type: str = "max", global_pool: bool = False,
         if pool_type == "sum":
             return s
         if count_include_pad:
-            return s / float(jnp.prod(jnp.asarray(kernel)))
+            # static python product: a jnp op here would stage a tracer
+            # under an outer jit, breaking float()
+            return s / float(math.prod(kernel))
         ones = jnp.ones_like(data)
         cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
         return s / cnt

@@ -8,6 +8,8 @@ also exposed as ``nd.random_*`` aliases for reference-name parity.
 
 from __future__ import annotations
 
+import math
+
 from typing import Optional
 
 import jax
@@ -93,7 +95,8 @@ def _randint(low: int = 0, high: int = 1, shape=None, dtype="int32", key=None):
 def _multinomial(data, shape=None, get_prob: bool = False, dtype="int32", key=None):
     """Sample indices from (batched) probability rows (sample_multinomial_op.h)."""
     k = key if key is not None else rng.next_key()
-    n = 1 if shape is None else int(jnp.prod(jnp.asarray(_shape(shape))))
+    # static python product (a jnp op would stage a tracer under an outer jit)
+    n = math.prod(map(int, _shape(shape)))
     logits = jnp.log(jnp.maximum(data, 1e-37))
     if data.ndim == 1:
         out = jax.random.categorical(k, logits, shape=(n,))

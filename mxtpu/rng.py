@@ -34,7 +34,9 @@ def _global():
 
 def seed(seed_state: int):
     """Parity with ``mx.random.seed`` (python/mxnet/random.py)."""
-    _global().key = jax.random.key(int(seed_state))
+    st = _global()
+    st.key = jax.random.key(int(seed_state))
+    st.trace_counter = 0     # seeded runs replay the foreign-jit stream too
 
 
 class _TraceProvider:
@@ -73,5 +75,16 @@ def next_key():
     providers: List[_TraceProvider] = getattr(st, "providers", [])
     if providers:
         return providers[-1].next()
-    st.key, sub = jax.random.split(st.key)
+    new_key, sub = jax.random.split(st.key)
+    if isinstance(sub, jax.core.Tracer):
+        # an eager stochastic op is being traced by a FOREIGN jit (user code
+        # wrapped framework calls in jax.jit without a trace provider).
+        # Storing the traced split would poison the global key for every
+        # later eager call — keep the global concrete and derive this trace's
+        # keys by folding a counter instead (each such call gets a distinct,
+        # deterministic stream; the compiled fn replays it, like the
+        # reference replaying a seeded resource).
+        st.trace_counter = getattr(st, "trace_counter", 0) + 1
+        return jax.random.fold_in(st.key, st.trace_counter)
+    st.key = new_key
     return sub

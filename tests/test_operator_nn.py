@@ -294,3 +294,31 @@ def test_linalg_ops():
     np.testing.assert_allclose(inv.asnumpy(), np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
     g = nd.linalg.gemm2(nd.array(a), nd.array(spd), alpha=2.0)
     np.testing.assert_allclose(g.asnumpy(), 2 * a @ spd, rtol=1e-4)
+
+
+def test_avg_pool_traceable_under_outer_jit():
+    """Non-global avg pooling (count_include_pad) must trace inside an OUTER
+    jit — float(jnp.prod(...)) on the static kernel staged a tracer and broke
+    inception-v3 under the chained-inference scan (round-4 regression)."""
+    import jax
+
+    from mxtpu.ndarray.ndarray import NDArray
+
+    x = nd.array(np.random.RandomState(0).rand(1, 2, 6, 6).astype(np.float32))
+
+    def f(c):
+        return nd.Pooling(NDArray(c), kernel=(3, 3), pool_type="avg",
+                          stride=(1, 1), pad=(1, 1),
+                          count_include_pad=True).data.sum()
+
+    out = float(jax.jit(f)(x.data))
+    assert np.isfinite(out)
+
+    # multinomial shape product had the same hazard
+    def g(p):
+        from mxtpu.ops.registry import get_op, invoke
+        return invoke(get_op("random.multinomial"), NDArray(p),
+                      shape=(4,)).data.sum()
+
+    out2 = float(jax.jit(g)(nd.array(np.array([0.2, 0.8], np.float32)).data))
+    assert np.isfinite(out2)
