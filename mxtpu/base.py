@@ -155,12 +155,43 @@ def capture_init_spec(cls):
 
     @functools.wraps(init)
     def wrapped(self, *args, **kwargs):
-        if not hasattr(self, "_init_spec"):
+        outermost = not hasattr(self, "_init_spec")
+        if outermost:
             self._init_spec = (args, dict(kwargs))
         init(self, *args, **kwargs)
+        if outermost:
+            # value snapshot of public attrs as __init__ left them — the wire
+            # serializer diffs against this to detect post-construction
+            # mutations its restricted format can't carry (shallow-copied so
+            # later in-place dict/list edits are visible; spec-captured
+            # sub-objects like lr_scheduler get a one-level vars snapshot,
+            # since the wire re-creates them from their ctor spec and would
+            # miss in-place edits)
+            self._post_init_attrs = {
+                k: _snap_value(v)
+                for k, v in vars(self).items() if not k.startswith("_")}
 
     wrapped._captures_spec = True
     cls.__init__ = wrapped
+
+
+class ObjSnap:
+    """One-level value snapshot of a spec-captured sub-object (see
+    ``capture_init_spec``): holds the object identity plus a copy of its
+    public attrs at ``__init__`` time."""
+    __slots__ = ("obj", "attrs")
+
+    def __init__(self, obj, attrs):
+        self.obj, self.attrs = obj, attrs
+
+
+def _snap_value(v):
+    if isinstance(v, (dict, list, set)):
+        return v.copy()
+    if hasattr(v, "_init_spec"):
+        return ObjSnap(v, {k: (w.copy() if isinstance(w, (dict, list, set)) else w)
+                           for k, w in vars(v).items() if not k.startswith("_")})
+    return v
 
 
 # ---------------------------------------------------------------------------

@@ -114,7 +114,12 @@ class QuantizedConv2D(_QuantizedLayer):
         self._pad = conv._padding
         self._dilate = conv._dilation
         self._groups = conv._groups
-        self._corr_cache: Dict[tuple, object] = {}   # input shape -> 128·conv(1,w)
+        # input shape -> 128·conv(1,w); bounded LRU so variable-shape
+        # inference (batch/resolution sweeps) can't grow device residency
+        # without limit
+        from collections import OrderedDict
+        self._corr_cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._corr_cache_cap = 8
 
     def _zp_corr(self, shape):
         if not self._unsigned:
@@ -124,6 +129,10 @@ class QuantizedConv2D(_QuantizedLayer):
             got = zero_point_corr_conv(shape, self._w_q, self._stride,
                                        self._pad, self._dilate, self._groups)
             self._corr_cache[shape] = got
+            if len(self._corr_cache) > self._corr_cache_cap:
+                self._corr_cache.popitem(last=False)
+        else:
+            self._corr_cache.move_to_end(shape)
         return got
 
     def forward(self, x):

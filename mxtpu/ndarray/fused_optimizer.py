@@ -15,6 +15,9 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
+import jax.numpy as jnp
+
 from ..ops import registry as _reg
 from .ndarray import NDArray
 
@@ -54,6 +57,19 @@ def _apply_lazy(op, weight, grad, states: Sequence[NDArray], out, kwargs):
     (reference *DnsRspDnsKernel semantics)."""
     rows = grad._indices
     vals = grad._values.astype(weight.dtype)
+    if rows.shape[0] > 1 and not getattr(grad, "_rows_trusted_unique", False):
+        # Reference *DnsRspDnsKernel assumes deduped row ids; our scatter is
+        # last-write-wins, so duplicate rows would drop updates. Merge them
+        # shape-statically (no host sync, jit-safe): sort, sum runs of equal
+        # ids into the leading segments, point the padding segments past the
+        # last weight row so the gather clamps and the scatter drops them.
+        n, nrows = rows.shape[0], weight.shape[0]
+        order = jnp.argsort(rows)
+        r_s, v_s = rows[order], vals[order]
+        seg = jnp.cumsum(jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), (r_s[1:] != r_s[:-1]).astype(jnp.int32)]))
+        vals = jax.ops.segment_sum(v_s, seg, num_segments=n)
+        rows = jnp.full((n,), nrows, rows.dtype).at[seg].set(r_s)
     w = weight.data
     row_like = [s.shape == weight.shape for s in states]
     slab_states = [s.data[rows] if rl else s.data

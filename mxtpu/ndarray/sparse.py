@@ -149,10 +149,23 @@ class RowSparseNDArray(BaseSparseNDArray):
         self._values = jnp.asarray(
             values.data if isinstance(values, NDArray) else values)
         self._shape = tuple(int(s) for s in shape)
+        # producers that GUARANTEE sorted-unique ids (dedup outputs, wire
+        # ingest) construct via _trusted(); consumers like the fused lazy
+        # optimizer path then skip their defensive duplicate-row merge
+        self._rows_trusted_unique = False
         if self._values.ndim != len(self._shape):
             raise ValueError(
                 f"row_sparse values ndim {self._values.ndim} != shape ndim "
                 f"{len(self._shape)} (values carry the full row shape)")
+
+    @classmethod
+    def _trusted(cls, indices, values, shape) -> "RowSparseNDArray":
+        """Construct from indices the CALLER guarantees are sorted-unique
+        (dedup output, host-deduped wire rows) — marks the invariant so the
+        lazy optimizer path can skip its defensive merge."""
+        out = cls(indices, values, shape)
+        out._rows_trusted_unique = True
+        return out
 
     @property
     def indices(self) -> NDArray:
@@ -401,7 +414,8 @@ def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
         raw = RawRowSparse(lhs._indices, contrib,
                            (lhs._shape[1],) + tuple(rhs_raw.shape[1:]))
         uniq, vals = raw.dedup()
-        return RowSparseNDArray(uniq, vals.astype(rhs_raw.dtype), raw.shape)
+        return RowSparseNDArray._trusted(uniq, vals.astype(rhs_raw.dtype),
+                                         raw.shape)
     if isinstance(lhs, RowSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
         raise NotImplementedError(
             "sparse dot supports csr×dense (optionally transpose_a) — "
@@ -430,7 +444,7 @@ def add(lhs, rhs):
         raw = RawRowSparse(jnp.concatenate([lhs._indices, rhs._indices]),
                            jnp.concatenate([lhs._values, rhs._values]), lhs._shape)
         uniq, vals = raw.dedup()
-        return RowSparseNDArray(uniq, vals, lhs._shape)
+        return RowSparseNDArray._trusted(uniq, vals, lhs._shape)
     l = lhs._dense() if isinstance(lhs, BaseSparseNDArray) else (
         lhs.data if isinstance(lhs, NDArray) else jnp.asarray(lhs))
     r = rhs._dense() if isinstance(rhs, BaseSparseNDArray) else (

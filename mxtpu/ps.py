@@ -100,19 +100,81 @@ def _spec_resolve(v):
     return v
 
 
+#: post-construction attributes the JSON wire format carries (everything else
+#: must come from the ctor spec — see serialize_optimizer)
+_CARRIED_STATE = ("lr", "wd", "rescale_grad", "clip_gradient", "num_update",
+                  "lr_mult", "wd_mult")
+
+#: deliberately NOT carried and not an error: client-side bookkeeping the
+#: server-side updater never consults (gluon Trainer sets param_dict on every
+#: dist run; the server applies updates by key, not Parameter object)
+_UNCARRIED_OK = ("param_dict", "idx2name", "sym_info")
+
+#: sub-object attrs the state dict carries explicitly, so their in-place
+#: mutation is fine (see "sched_base_lr" in serialize/deserialize)
+_CARRIED_SUBATTRS = {"lr_scheduler": ("base_lr",)}
+
+
+def _attr_equal(a, b, exclude=()) -> bool:
+    from .base import ObjSnap
+    if isinstance(b, ObjSnap):
+        # spec-captured sub-object (e.g. lr_scheduler): same object AND its
+        # public attrs unchanged since __init__ — the wire re-creates it from
+        # its ctor spec, so in-place edits would silently diverge
+        if a is not b.obj:
+            return False
+        live = {k: v for k, v in vars(a).items()
+                if not k.startswith("_") and k not in exclude}
+        attrs = {k: v for k, v in b.attrs.items() if k not in exclude}
+        return (live.keys() == attrs.keys()
+                and all(_attr_equal(live[k], v) for k, v in attrs.items()))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray) \
+            or hasattr(a, "__jax_array__") or type(a).__module__.startswith("jax") \
+            or type(b).__module__.startswith("jax"):
+        try:
+            return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        except Exception:
+            return a is b
+    try:
+        return bool(a == b)
+    except Exception:
+        return a is b
+
+
 def serialize_optimizer(opt) -> bytes:
     """Optimizer → wire bytes: restricted JSON spec, or HMAC-signed pickle when
-    MXTPU_PS_SECRET is shared (for ctor args the JSON form can't carry)."""
+    MXTPU_PS_SECRET is shared (for ctor args the JSON form can't carry).
+
+    Carried-state contract: the JSON form ships the ctor ``(args, kwargs)``
+    plus the ``_CARRIED_STATE`` attributes only (``_UNCARRIED_OK`` names are
+    client-side bookkeeping and intentionally dropped). Any OTHER
+    post-construction attribute mutation (e.g. ``opt.momentum = x`` after
+    ``__init__``) is detected by diffing against the post-``__init__``
+    snapshot (``base.capture_init_spec``) and raises — set MXTPU_PS_SECRET
+    for pickle transport of such optimizers."""
     from . import optimizer as opt_mod
     try:
         name = next(k for k, c in opt_mod.registry._registry.items()
                     if c is type(opt))
         args, kwargs = opt._init_spec   # always set (base __init__ captures)
+        snap = getattr(opt, "_post_init_attrs", None)
+        for attr, val in vars(opt).items():
+            if (snap is None or attr.startswith("_")
+                    or attr in _CARRIED_STATE or attr in _UNCARRIED_OK):
+                continue
+            if attr not in snap or not _attr_equal(
+                    val, snap[attr], _CARRIED_SUBATTRS.get(attr, ())):
+                raise TypeError(
+                    f"post-construction mutation of {attr!r} is not carried "
+                    f"by the JSON wire format")
+        sched = opt.lr_scheduler
         spec = {"name": name, "args": [_spec_value(a) for a in args],
                 "kwargs": {k: _spec_value(v) for k, v in kwargs.items()},
                 # post-construction mutations the ctor spec can't carry
                 # (reference pickle transport shipped the whole object)
                 "state": {"lr": opt.lr, "wd": opt.wd,
+                          "sched_base_lr":
+                              None if sched is None else sched.base_lr,
                           "rescale_grad": opt.rescale_grad,
                           "clip_gradient": opt.clip_gradient,
                           "num_update": opt.num_update,
@@ -144,6 +206,9 @@ def deserialize_optimizer(payload: bytes):
         st = spec.get("state")
         if st:
             opt.set_learning_rate(st["lr"])
+            if st.get("sched_base_lr") is not None \
+                    and opt.lr_scheduler is not None:
+                opt.lr_scheduler.base_lr = st["sched_base_lr"]
             opt.wd = st["wd"]
             opt.rescale_grad = st["rescale_grad"]
             opt.clip_gradient = st["clip_gradient"]
@@ -274,6 +339,15 @@ class ParamServer:
             else:
                 stored += grad                        # default: accumulate
 
+    @staticmethod
+    def _check_rows(rows: np.ndarray, nrows: int, key: str):
+        """Wire row ids are untrusted: negative int64 ids would wrap through
+        numpy indexing and silently touch the wrong rows."""
+        if rows.size and (rows.min() < 0 or rows.max() >= nrows):
+            raise ValueError(
+                f"row ids out of range for key {key!r}: "
+                f"[{rows.min()}, {rows.max()}] vs {nrows} stored rows")
+
     def _apply_push_rows(self, key: str, rows: np.ndarray, vals: np.ndarray):
         """Row-subset push: only the shipped rows touch the stored value —
         with an optimizer set, its lazy row-sparse path runs on the row slab
@@ -282,6 +356,7 @@ class ParamServer:
             stored = self._store.get(key)
             if stored is None:
                 raise KeyError(f"push before init for key {key!r}")
+            self._check_rows(rows, stored.shape[0], key)
             if self._updater is not None:
                 self._updater(key, (rows, vals), stored)
             else:
@@ -314,6 +389,7 @@ class ParamServer:
                             val = self._store.get(key)
                             if val is None:
                                 raise KeyError(f"pull before init: {key!r}")
+                            self._check_rows(rows, val.shape[0], key)
                             rmeta, rpayload = _encode_array(val[rows])
                     elif cmd == CMD_PULL:
                         # encode UNDER the lock: concurrent pushes mutate the
@@ -373,8 +449,22 @@ class ParamServer:
             w = NDArray(jnp.asarray(stored))
             if isinstance(grad, tuple):        # (rows, vals): lazy sparse path
                 rows, vals = grad
-                g = sp.RowSparseNDArray(np.asarray(rows), jnp.asarray(vals),
-                                        stored.shape)
+                # wire rows are untrusted: merge duplicates host-side (cheap,
+                # already numpy) so device consumers can skip their defensive
+                # merge via the _trusted invariant
+                rows = np.asarray(rows)
+                vals = np.asarray(vals)
+                uniq, inv = np.unique(rows, return_inverse=True)
+                if uniq.size != rows.size:
+                    summed = np.zeros((uniq.size,) + vals.shape[1:], vals.dtype)
+                    np.add.at(summed, inv, vals)
+                    rows, vals = uniq, summed
+                else:
+                    # _trusted promises sorted-unique: reorder even when
+                    # already unique (wire order is arbitrary)
+                    rows, vals = uniq, vals[np.argsort(inv, kind="stable")]
+                g = sp.RowSparseNDArray._trusted(rows, jnp.asarray(vals),
+                                                 stored.shape)
             else:
                 g = NDArray(jnp.asarray(grad))
             updater(key, g, w)

@@ -253,6 +253,38 @@ def test_optimizer_wire_carries_mutations_and_default_init():
     assert isinstance(sgld, optimizer.SGLD) and sgld.lr == 0.5
 
 
+def test_optimizer_wire_mutation_detection(monkeypatch):
+    """The JSON wire diffs against the post-__init__ snapshot: Trainer's
+    param_dict is tolerated, un-carried attr mutations raise, scheduler
+    mutations via set_learning_rate ride the wire, and IN-PLACE scheduler
+    edits (which the ctor-spec re-creation would lose) are detected."""
+    from mxtpu import lr_scheduler, optimizer, ps
+
+    monkeypatch.delenv("MXTPU_PS_SECRET", raising=False)
+
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    opt.param_dict = {0: object()}               # gluon Trainer does this
+    assert ps.deserialize_optimizer(ps.serialize_optimizer(opt)).lr == 0.1
+
+    bad = optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    bad.momentum = 0.5                           # not carried -> must raise
+    with pytest.raises(TypeError, match="momentum"):
+        ps.serialize_optimizer(bad)
+
+    sched = lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    o2 = optimizer.SGD(learning_rate=0.1, lr_scheduler=sched)
+    o2.set_learning_rate(0.03)                   # carried (lr + base_lr)
+    b2 = ps.deserialize_optimizer(ps.serialize_optimizer(o2))
+    assert abs(b2.lr_scheduler.base_lr - 0.03) < 1e-12
+
+    o3 = optimizer.SGD(learning_rate=0.1,
+                       lr_scheduler=lr_scheduler.FactorScheduler(
+                           step=10, factor=0.5))
+    o3.lr_scheduler.factor = 0.9                 # in-place edit: not carried
+    with pytest.raises(TypeError, match="lr_scheduler"):
+        ps.serialize_optimizer(o3)
+
+
 def _make_user_scheduler():
     from mxtpu import lr_scheduler
 

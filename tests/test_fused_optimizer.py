@@ -209,6 +209,7 @@ def test_feedforward_fit_predict_save_load(tmp_path):
     from mxtpu.model import FeedForward
     from mxtpu.symbol.symbol import _reset_names
     _reset_names()
+    mx.rng.seed(0)   # init draws from the global RNG: make order-independent
 
     rng = np.random.RandomState(0)
     X = rng.rand(64, 8).astype(np.float32)
@@ -242,6 +243,19 @@ def test_bipartite_matching_topk_strict():
     s = nd.array(np.array([[0.9, 0.8], [0.7, 0.6]], np.float32))
     x, _ = nd.contrib.bipartite_matching(s, threshold=1e-12, topk=1)
     assert int((x.asnumpy() >= 0).sum()) == 1, x.asnumpy()
+
+
+def test_lazy_update_duplicate_rows_accumulate():
+    # advisor r4: duplicate row ids must sum, not last-write-win
+    w = nd.zeros((6, 3))
+    rows = np.array([2, 4, 2], np.int64)
+    vals = np.ones((3, 3), np.float32)
+    g = mx.nd.sparse.row_sparse_array((vals, rows), shape=(6, 3))
+    nd.sgd_update(w, g, lr=1.0, wd=0.0)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[2], -2.0)       # merged: two grads summed
+    np.testing.assert_allclose(out[4], -1.0)
+    np.testing.assert_allclose(out[[0, 1, 3, 5]], 0.0)
 
 
 def test_ftrl_accepts_lazy_update_kwarg():
