@@ -1,4 +1,5 @@
 """Model zoo (parity with python/mxnet/gluon/model_zoo)."""
 
-from . import model_store, vision
+from . import model_store, transformer, vision
+from .transformer import TransformerLM, transformer_lm
 from .vision import get_model

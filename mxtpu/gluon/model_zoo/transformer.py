@@ -1,0 +1,129 @@
+"""Decoder-only transformer language model — the TPU-native flagship training
+workload.
+
+The reference's transformer support is a single helper op
+(``_contrib_div_sqrt_dim``, src/operator/contrib/transformer.cc:33) plus the
+gluon-nlp ecosystem it fed; a TPU-first framework makes the transformer a
+first-class model-zoo family instead, built over the Pallas flash-attention
+kernel (ops/attention.py) per the long-context mandate (SURVEY.md §5).
+
+Architecture (GPT-2-style, pre-LN):
+
+    tokens → embed + learned pos-embed
+           → N × [LN → causal MHA → +res, LN → FFN(4d, GELU) → +res]
+           → LN → logits = h · Eᵀ   (tied softmax head)
+
+The tied head reuses the token-embedding matrix (Press & Wolf 2017 weight
+tying) — one fewer V×d parameter and the standard LM configuration.
+
+Every layer is jit-friendly: static shapes, no data-dependent control flow,
+registered nd ops throughout so the imperative autograd tape records the same
+graph ``DataParallelTrainer`` traces under jit.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ... import ndarray as nd
+from ..block import HybridBlock
+from ..contrib.nn import MultiHeadAttention
+from ..nn.basic_layers import Dense, Embedding, LayerNorm
+
+__all__ = ["TransformerBlock", "TransformerLM", "transformer_lm"]
+
+
+class TransformerBlock(HybridBlock):
+    """One pre-LN decoder block: causal flash MHA + position-wise FFN."""
+
+    def __init__(self, units: int, num_heads: int, ffn_units: int = 0,
+                 dropout: float = 0.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        ffn_units = ffn_units or 4 * units
+        with self.name_scope():
+            self.ln1 = LayerNorm(in_channels=units)
+            self.attn = MultiHeadAttention(units, num_heads, causal=True,
+                                           dropout=dropout)
+            self.ln2 = LayerNorm(in_channels=units)
+            self.ffn1 = Dense(ffn_units, flatten=False, in_units=units)
+            self.ffn2 = Dense(units, flatten=False, in_units=ffn_units)
+
+    def forward(self, x):
+        h = x + self.attn(self.ln1(x))
+        g = nd.LeakyReLU(self.ffn1(self.ln2(h)), act_type="gelu")
+        return h + self.ffn2(g)
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only LM over token ids.
+
+    Input ``(B, T)`` int tokens, output ``(B, T, vocab)`` logits. ``T`` may be
+    anything ≤ ``max_len`` (the learned position table is sliced); multiples
+    of 128 engage the Pallas flash kernel on TPU, others fall back to the XLA
+    attention reference (ops/attention.py ``_use_pallas``).
+    """
+
+    def __init__(self, vocab_size: int, units: int = 512, num_layers: int = 6,
+                 num_heads: int = 8, max_len: int = 2048, ffn_units: int = 0,
+                 dropout: float = 0.0, tie_weights: bool = True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._vocab = vocab_size
+        self._units = units
+        self._max_len = max_len
+        self._tie = tie_weights
+        with self.name_scope():
+            self.embedding = Embedding(vocab_size, units,
+                                       weight_initializer="normal")
+            self.pos_embed = self.params.get(
+                "pos_embed", shape=(max_len, units), init="normal")
+            self.blocks = []
+            for i in range(num_layers):
+                blk = TransformerBlock(units, num_heads, ffn_units, dropout)
+                setattr(self, f"block{i}", blk)   # registers child + params
+                self.blocks.append(blk)
+            self.ln_f = LayerNorm(in_channels=units)
+            if not tie_weights:
+                self.head = Dense(vocab_size, flatten=False, in_units=units)
+
+    def forward(self, tokens):
+        B, T = tokens.shape
+        if T > self._max_len:
+            raise ValueError(f"sequence length {T} exceeds max_len "
+                             f"{self._max_len}")
+        h = self.embedding(tokens)
+        pos = nd.slice_axis(self.pos_embed.data(), axis=0, begin=0, end=T)
+        h = h + nd.reshape(pos, (1, T, self._units))
+        for blk in self.blocks:
+            h = blk(h)
+        h = self.ln_f(h)
+        if not self._tie:
+            return self.head(h)
+        # tied softmax head: logits = h · Eᵀ over the embedding table
+        w = self.embedding.weight.data()
+        flat = nd.reshape(h, (B * T, self._units))
+        return nd.reshape(nd.dot(flat, w, transpose_b=True),
+                          (B, T, self._vocab))
+
+
+_PRESETS = {
+    # name: (units, layers, heads, max_len)
+    "tiny": (64, 2, 2, 256),            # tests
+    "small": (512, 6, 8, 1024),         # ~35M params at 16k vocab
+    "base": (768, 12, 12, 1024),        # GPT-2 124M-class
+    "flagship": (1024, 8, 16, 2048),    # the bench workload: MXU-dominated
+}
+
+
+def transformer_lm(preset: str = "small", vocab_size: int = 16384, **kwargs):
+    """Factory over the preset table (model-zoo surface parity with
+    ``vision.get_model``)."""
+    try:
+        units, layers, heads, max_len = _PRESETS[preset]
+    except KeyError:
+        raise ValueError(
+            f"unknown preset {preset!r}; choose from {sorted(_PRESETS)}")
+    cfg = dict(units=units, num_layers=layers, num_heads=heads,
+               max_len=max_len)
+    cfg.update(kwargs)
+    return TransformerLM(vocab_size, **cfg)

@@ -10,9 +10,10 @@ T×T score matrix out of HBM — kernels stream K/V tiles through VMEM.
 Production shapes engage the kernel: head dims 64/96/128/... (any D ≤ 512) are
 zero-padded to the 128-lane width inside the wrapper (padding columns
 contribute nothing to q·kᵀ and produce zero output columns, sliced off
-afterwards). Sequence lengths engage when T % 128 == 0, or T ≤ 128 with
-T % 8 == 0 (Mosaic block-tiling legality); anything else falls back to the
-XLA reference. The backward pass is the standard flash
+afterwards). Sequence lengths engage when T % 128 == 0 on real hardware
+(sub-128 whole-axis blocks pass in interpret mode but real Mosaic rejects
+their vector loads — observed on v5e); anything else falls back to the XLA
+reference, which is equally fast at those sizes. The backward pass is the standard flash
 backward — forward saves the per-row log-sum-exp; two kernels recompute the
 probabilities per tile and accumulate dq (grid over q blocks) and dk/dv (grid
 over k blocks) without materializing T×T.
@@ -344,8 +345,11 @@ def _use_pallas(q, k) -> bool:
         return False
     T, D = q.shape[2], q.shape[3]
     Tk = k.shape[2]
-    return (T == Tk and D <= 512 and _pick_block(T) >= 8
-            and _pick_block(Tk) >= 8 and T >= 8)
+    # hardware gate: 128-multiple sequence only. The T<=128 whole-axis block
+    # is legal to *interpret* but real Mosaic rejects its sub-128 vector
+    # loads ("index in dimension 2 is a multiple of 128", observed on v5e
+    # with T=16, Dp=128) — and at those sizes the XLA path is just as fast.
+    return T == Tk and D <= 512 and T % 128 == 0
 
 
 def _chunk_reference_lse(q, k, v, causal, scale):
@@ -404,9 +408,9 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     """Fused scaled-dot-product attention; q,k,v: (B, H, T, D).
 
     Pallas fwd+bwd on TPU at production shapes (any head dim ≤512 via lane
-    padding; T % 128 == 0 or T ≤ 128 with T % 8 == 0), XLA reference
-    otherwise — numerically equivalent paths. Thin wrapper over
-    ``flash_chunk`` (the lse output's zero cotangent folds away in bwd).
+    padding; T % 128 == 0), XLA reference otherwise — numerically equivalent
+    paths. Thin wrapper over ``flash_chunk`` (the lse output's zero cotangent
+    folds away in bwd).
     """
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     return flash_chunk(q, k, v, causal, s)[0]
