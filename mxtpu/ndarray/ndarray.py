@@ -576,13 +576,24 @@ def _encode_entry(payload, key, v):
         payload[key] = v.asnumpy()
 
 
-def save(fname: str, data):
+def save(fname: str, data, fmt: str = "npz"):
     """Save an NDArray (dense or sparse), list, or dict of name→NDArray
     (mx.nd.save parity incl. row_sparse/csr, ndarray.cc:1537).
 
-    An explicit format marker is stored so a dict whose keys happen to look like
-    ``arr_<i>`` round-trips correctly (list-vs-dict is never inferred from key names).
+    ``fmt='npz'`` (default) writes the native npz container with an explicit
+    format marker, so a dict whose keys happen to look like ``arr_<i>``
+    round-trips correctly (list-vs-dict is never inferred from key names).
+    ``fmt='reference'`` emits the reference's NDARRAY_V2 binary format
+    (legacy_io.py; ndarray.cc:1532-1653) so the artifact loads in the
+    reference framework and its other language bindings.
     """
+    if fmt == "reference":
+        from . import legacy_io
+        with open(fname, "wb") as f:
+            f.write(legacy_io.save_bytes(data))
+        return
+    if fmt != "npz":
+        raise ValueError(f"unknown save format {fmt!r}: use 'npz' or 'reference'")
     payload = {}
     if isinstance(data, dict):
         if _SAVE_FORMAT_KEY in data:
@@ -635,7 +646,17 @@ def _decode_entries(z, keys):
 
 def load(fname: str):
     """Load from ``save``; returns dict if named, else list (mx.nd.load parity).
-    Sparse entries come back as RowSparseNDArray/CSRNDArray."""
+    Sparse entries come back as RowSparseNDArray/CSRNDArray.
+
+    The format is sniffed: files starting with the reference's dmlc list magic
+    (0x112) parse as reference NDARRAY_V1/V2 binaries (legacy_io.py) — a
+    trained reference ``.params`` artifact loads directly."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    from . import legacy_io
+    if legacy_io.is_reference_file(head):
+        with open(fname, "rb") as f:
+            return legacy_io.load_bytes(f.read())
     with open(fname, "rb") as f:
         with np.load(f, allow_pickle=False) as z:
             keys = [k for k in z.keys() if k != _SAVE_FORMAT_KEY]

@@ -724,10 +724,16 @@ def make_op_wrapper(op_key: str):
 
 
 def load_json(json_str: str) -> Symbol:
+    """Parse a symbol JSON — native mxtpu schema, or the reference's nnvm
+    graph schema (symbol.py:2549-2582 / nnvm SaveJSON: nodes with all-string
+    attrs, explicit weight/bias null inputs, ``arg_nodes``/``heads``) so a
+    ``*-symbol.json`` exported by the reference loads directly."""
     payload = json.loads(json_str)
     if payload.get("attrs", {}).get("format") != "mxtpu-symbol-json":
-        raise ValueError("not an mxtpu symbol json (reference-format graphs must "
-                         "be re-exported from this framework)")
+        if isinstance(payload.get("nodes"), list) and "arg_nodes" in payload:
+            return _load_reference_json(payload)
+        raise ValueError("not a recognizable symbol json (expected mxtpu or "
+                         "reference nnvm graph schema)")
     nodes: List[_Node] = []
     for spec in payload["nodes"]:
         attrs = {k: _parse_attr(v) for k, v in spec.get("attrs", {}).items()}
@@ -743,6 +749,69 @@ def load_json(json_str: str) -> Symbol:
 
 
 fromjson = load_json
+
+#: reference-graph attrs that are pure backend tuning noise on TPU (GPU
+#: workspace sizing / cuDNN autotune knobs) — dropped on import
+_REF_NOISE_ATTRS = {"workspace", "cudnn_tune", "cudnn_off"}
+
+#: reference op names whose registry key differs here
+_REF_OP_ALIASES = {
+    "_copy": "identity",
+    "_plus": "elemwise_add",
+    "_minus": "elemwise_sub",
+    "_mul": "elemwise_mul",
+    "_div": "elemwise_div",
+}
+
+
+def _load_reference_json(payload: dict) -> Symbol:
+    """Replay a reference nnvm graph through the op wrappers: null nodes
+    become Variables, op nodes are re-composed positionally over each op's
+    tensor-parameter order (all inputs are explicit in the reference schema,
+    so the wrappers never auto-create params). Version-tolerant: accepts
+    ``attrs``/``attr``/``param`` attr keys and 2- or 3-int input refs."""
+    node_syms: List[Symbol] = []
+    for spec in payload["nodes"]:
+        opname = spec["op"]
+        raw = spec.get("attrs") or spec.get("attr") or spec.get("param") or {}
+        if opname == "null":
+            node_syms.append(Variable(spec["name"]))
+            continue
+        opname = _REF_OP_ALIASES.get(opname, opname)
+        try:
+            op = _reg.get_op(opname)
+        except KeyError:
+            raise ValueError(
+                f"reference graph op {spec['op']!r} has no counterpart in the "
+                f"registry (node {spec['name']!r})") from None
+        # attr policy: __dunder__ scope attrs and KNOWN backend noise are
+        # dropped; anything else the kernel's signature doesn't name RAISES —
+        # silently defaulting a meaningful attr would build a different
+        # network than the artifact describes
+        sig = inspect.signature(op.fn).parameters
+        has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                         for p in sig.values())
+        attrs = {}
+        for k, v in raw.items():
+            if k.startswith("__") or k in _REF_NOISE_ATTRS:
+                continue
+            if not has_var_kw and k not in sig:
+                raise ValueError(
+                    f"reference graph attr {k}={v!r} on op {opname!r} (node "
+                    f"{spec['name']!r}) has no counterpart in the kernel "
+                    f"signature — refusing to silently drop it")
+            attrs[k] = _parse_attr(str(v))
+        ins = []
+        for ref in spec.get("inputs", []):
+            src, idx = ref[0], (ref[1] if len(ref) > 1 else 0)
+            s = node_syms[src]
+            ins.append(s if idx == 0 and len(s._heads) == 1
+                       else Symbol([s._heads[idx]]))
+        node_syms.append(
+            make_op_wrapper(opname)(*ins, name=spec["name"], **attrs))
+    heads = payload.get("heads") or [[len(payload["nodes"]) - 1, 0]]
+    return Symbol([node_syms[h[0]]._heads[h[1] if len(h) > 1 else 0]
+                   for h in heads])
 
 
 def _parse_attr(v: str):
