@@ -248,13 +248,15 @@ def _flush_grad(h, entry, g):
         if entry.grad_req == "add":
             if isinstance(h._grad, sp.RowSparseNDArray):
                 uniq, vals = g.dedup()
-                h._grad = sp.add(h._grad, sp.RowSparseNDArray(uniq, vals, g.shape))
+                h._grad = sp.add(h._grad,
+                                 sp.RowSparseNDArray._trusted(uniq, vals, g.shape))
                 return
             if h._grad is not None:
                 h._grad._set_data(h._grad.data + g.densify())
                 return
         uniq, vals = g.dedup()
-        h._grad = sp.RowSparseNDArray(uniq, vals.astype(h._data.dtype), g.shape)
+        h._grad = sp.RowSparseNDArray._trusted(
+            uniq, vals.astype(h._data.dtype), g.shape)
         return
     dense_existing = (h._grad is not None
                       and getattr(h._grad, "stype", "default") == "default")
@@ -375,9 +377,11 @@ def _run_backward_create_graph(heads, head_grads, collect_vars,
     example there is literally grad-of-grad).
 
     The original tape is kept (reference: ``retain_graph`` defaults to
-    ``create_graph``); nodes with an explicit host-side ``backward_fn``
-    (custom ``Function``) raise, matching the reference's per-op "does not
-    support second order" errors for ops without a differentiable FGradient.
+    ``create_graph``). Nodes with an explicit ``backward_fn`` (custom
+    ``Function``) replay that backward as a recorded node, so higher-order
+    autograd composes through custom Functions (reference
+    autograd.py:309-509); only a backward whose body is genuinely host-bound
+    (pure_callback) stops the chain, at the next differentiation.
     """
     from .ndarray.ndarray import NDArray
     st = _st()
@@ -412,13 +416,47 @@ def _run_backward_create_graph(heads, head_grads, collect_vars,
         out_keys = [("out", id(node), j) for j in range(node.n_outputs)]
         if not any(k in cots for k in out_keys):
             continue
-        if node.backward_fn is not None:
-            raise NotImplementedError(
-                "create_graph=True through a custom Function / explicit "
-                "backward is not supported: its backward is host code the "
-                "tape cannot differentiate (the reference likewise raises "
-                "for ops without a second-order FGradient)")
         n_in = len(node.raw_inputs)
+        if node.backward_fn is not None:
+            # Custom Function / explicit backward: replay the authored
+            # backward as a recorded node so grad-of-grad composes through it
+            # (reference autograd.py:309-509 — custom Functions participate in
+            # higher-order autograd). The replay differentiates iff the
+            # backward_fn body is traceable array math; a genuinely host-bound
+            # backward (pure_callback) fails at the NEXT differentiation,
+            # which is the honest boundary.
+            def bwd_replay(*raw, _node=node, _n_in=n_in):
+                cs = raw[_n_in:]
+                if getattr(_node.backward_fn, "_takes_input_raws", False):
+                    gs = _node.backward_fn(_node.saved, list(cs), raw[:_n_in])
+                else:
+                    gs = _node.backward_fn(_node.saved, list(cs))
+                return tuple(
+                    jnp.asarray(_dense_cot(g)) if g is not None
+                    else jnp.zeros_like(r)
+                    for g, r in zip(gs, raw[:_n_in]))
+
+            in_handles = [shim(r, e) for r, e in
+                          zip(node.raw_inputs, node.parent_entries)]
+            cot_handles = [
+                cots[k] if cots.get(k) is not None
+                else NDArray(jnp.zeros_like(_out_like(node, j)))
+                for j, k in enumerate(out_keys)]
+            # pause: the user's backward runs NDArray ops eagerly here — they
+            # must not append dead nodes to the tape (the replay node below is
+            # the recorded form)
+            with pause():
+                raw_grads = bwd_replay(*[h.data for h in in_handles],
+                                       *[h.data for h in cot_handles])
+            grad_handles = [NDArray(g) for g in raw_grads]
+            record_custom_node(bwd_replay, in_handles + cot_handles,
+                               grad_handles)
+            for entry, gh in zip(node.parent_entries, grad_handles):
+                if entry is None:
+                    continue
+                k = _entry_key(entry)
+                cots[k] = accum_nd(cots[k], gh) if k in cots else gh
+            continue
 
         def vjp_replay(*raw, _node=node, _n_in=n_in):
             ins, cs = raw[:_n_in], raw[_n_in:]
@@ -594,11 +632,29 @@ class Function:
         if is_recording():
             fn = self
 
-            def backward_fn(saved, out_grads):
-                gs = fn.backward(*[NDArray(g) for g in out_grads])
+            def backward_fn(saved, out_grads, input_raws=None):
+                if input_raws is None:
+                    gs = fn.backward(*[NDArray(g) for g in out_grads])
+                else:
+                    # higher-order replay (create_graph=True): RE-RUN forward
+                    # on the traced inputs so every save_for_backward tensor
+                    # is regenerated as a traced function of them — saved
+                    # inputs, saved outputs (the sigmoid save-s pattern), and
+                    # derived values all carry their chain term into d²/dx².
+                    # One extra forward per custom node, the standard
+                    # rematerialization price. Tensors saved OUTSIDE forward
+                    # remain genuine constants.
+                    prev = fn._saved
+                    try:
+                        with pause():   # replay must never hit the tape
+                            fn.forward(*[NDArray(r) for r in input_raws])
+                            gs = fn.backward(*[NDArray(g) for g in out_grads])
+                    finally:
+                        fn._saved = prev
                 gs = [gs] if not isinstance(gs, (tuple, list)) else gs
                 return [g._data if isinstance(g, NDArray) else g for g in gs]
 
+            backward_fn._takes_input_raws = True
             record_custom_node(None, list(inputs), outs, backward_fn=backward_fn,
                                saved={"outs": [o._data for o in outs]})
         return outs[0] if single else tuple(outs)
