@@ -28,8 +28,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .mesh import Mesh, get_default_mesh
 
 __all__ = ["allreduce", "allreduce_array", "allgather_array", "broadcast_array",
-           "reduce_scatter_array", "barrier", "psum", "pmean", "all_gather",
-           "reduce_scatter", "ppermute", "all_to_all"]
+           "reduce_scatter_array", "all_to_all_array", "barrier", "psum",
+           "pmean", "all_gather", "reduce_scatter", "ppermute", "all_to_all"]
 
 # -- in-program collectives (use inside shard_map/pjit bodies) --------------
 psum = lax.psum
@@ -103,6 +103,30 @@ def reduce_scatter_array(x, mesh: Optional[Mesh] = None, axis: int = 0):
 
     fn = jax.shard_map(_rs, mesh=mesh, in_specs=P(), out_specs=P(*spec),
                        check_vma=False)
+    return fn(jnp.asarray(x))
+
+
+def all_to_all_array(x, mesh: Optional[Mesh] = None, split_axis: int = 1,
+                     concat_axis: int = 0):
+    """Transpose shard ownership: each device scatters its ``split_axis``
+    slices to peers and concatenates what it receives along ``concat_axis``
+    (the Ulysses/MoE dispatch primitive at array level). ``x`` is sharded on
+    ``concat_axis`` in, sharded on ``split_axis`` out."""
+    mesh = mesh or get_default_mesh()
+    ax_name = mesh.axis_names[0]
+    if mesh.devices.size == 1:
+        return jnp.asarray(x)
+    in_spec = [None] * jnp.ndim(x)
+    in_spec[concat_axis] = ax_name
+    out_spec = [None] * jnp.ndim(x)
+    out_spec[split_axis] = ax_name
+
+    def _a2a(v):
+        return lax.all_to_all(v, ax_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+    fn = jax.shard_map(_a2a, mesh=mesh, in_specs=P(*in_spec),
+                       out_specs=P(*out_spec), check_vma=False)
     return fn(jnp.asarray(x))
 
 

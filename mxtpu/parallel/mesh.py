@@ -49,3 +49,25 @@ def get_default_mesh() -> Mesh:
 def set_default_mesh(mesh: Optional[Mesh]):
     global _default_mesh
     _default_mesh = mesh
+
+
+def force_virtual_cpu_devices(n_devices: int) -> int:
+    """Best-effort switch to an ``n_devices`` virtual CPU pod
+    (``--xla_force_host_platform_device_count``) for sharding dry-runs on
+    hosts without that many chips. The env route only works before jax's
+    backends initialize (sitecustomize may pin ``JAX_PLATFORMS=axon`` and
+    initialize at interpreter start); the config route flips an
+    already-initialized process to cpu. Returns the usable device count —
+    callers must clamp their mesh to it."""
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return min(n_devices, len(jax.devices()))
