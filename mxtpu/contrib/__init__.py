@@ -3,6 +3,7 @@ driver here; contrib ops live under ``mxtpu.nd.contrib`` (ops/contrib_ops.py);
 the torch plugin bridge (plugin/torch parity) is ``torch_bridge`` (torch itself
 is only imported at first use inside it)."""
 
+from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import torch_bridge  # noqa: F401

@@ -1,0 +1,6 @@
+"""ONNX import — ``mx.contrib.onnx.import_model`` surface (reference
+python/mxnet/contrib/onnx). Export's portable-graph role is covered by
+StableHLO (``mxtpu.jit.export_stablehlo``); import speaks real ONNX so zoo
+artifacts cross over."""
+
+from .onnx2mx import get_model_metadata, import_graph, import_model

@@ -1,0 +1,215 @@
+"""Minimal protobuf wire-format reader for the ONNX ModelProto subset.
+
+The environment ships no ``onnx`` package, so the importer parses the wire
+format directly (protobuf encoding is stable and documented: tag =
+(field_number << 3) | wire_type; wire types 0 varint / 1 fixed64 /
+2 length-delimited / 5 fixed32). Only the fields the op importer consumes are
+modeled — unknown fields are skipped by wire type, so files from any ONNX
+producer parse.
+
+Field numbers follow onnx/onnx.proto (the public schema):
+ModelProto{graph=7, opset_import=8}; GraphProto{node=1, name=2, initializer=5,
+input=11, output=12}; NodeProto{input=1, output=2, name=3, op_type=4,
+attribute=5}; AttributeProto{name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+type=20}; TensorProto{dims=1, data_type=2, float_data=4, int32_data=5,
+int64_data=7, name=8, raw_data=9}; ValueInfoProto{name=1, type=2};
+OperatorSetIdProto{domain=1, version=2}.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def _fields(buf: memoryview) -> Dict[int, List[Tuple[int, object]]]:
+    """One message level: field number -> [(wire_type, raw value), ...]."""
+    out: Dict[int, List[Tuple[int, object]]] = {}
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        fnum, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            val = bytes(buf[pos:pos + 8])
+            pos += 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = bytes(buf[pos:pos + 4])
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.setdefault(fnum, []).append((wt, val))
+    return out
+
+
+def _signed(v: int) -> int:
+    """Protobuf int64 varints are two's-complement in 64 bits."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _ints(entries) -> List[int]:
+    """Repeated int64: packed (wire 2) or unpacked varints."""
+    out = []
+    for wt, v in entries:
+        if wt == 0:
+            out.append(_signed(v))
+        else:
+            pos = 0
+            while pos < len(v):
+                x, pos = _read_varint(v, pos)
+                out.append(_signed(x))
+    return out
+
+
+_TENSOR_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+                  7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+class Tensor:
+    __slots__ = ("name", "array")
+
+    def __init__(self, name: str, array: np.ndarray):
+        self.name, self.array = name, array
+
+
+def parse_tensor(buf: memoryview) -> Tensor:
+    f = _fields(buf)
+    dims = _ints(f.get(1, []))
+    (_, dt), = f.get(2, [(0, 1)])
+    dtype = _TENSOR_DTYPES.get(dt)
+    if dtype is None:
+        raise ValueError(f"unsupported ONNX tensor data_type {dt}")
+    name = bytes(f[8][0][1]).decode() if 8 in f else ""
+    if 9 in f:                                        # raw_data
+        arr = np.frombuffer(bytes(f[9][0][1]), dtype)
+    elif 4 in f:                                      # float_data (packed f32)
+        raw = b"".join(bytes(v) for _, v in f[4])
+        arr = np.frombuffer(raw, np.float32).astype(dtype)
+    elif 7 in f:                                      # int64_data
+        arr = np.asarray(_ints(f[7]), np.int64).astype(dtype)
+    elif 5 in f:                                      # int32_data
+        arr = np.asarray(_ints(f[5]), np.int32).astype(dtype)
+    else:
+        arr = np.zeros(0, dtype)
+    return Tensor(name, arr.reshape(dims).copy())
+
+
+class Attribute:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name, value):
+        self.name, self.value = name, value
+
+
+def parse_attribute(buf: memoryview) -> Attribute:
+    f = _fields(buf)
+    name = bytes(f[1][0][1]).decode()
+    atype = f[20][0][1] if 20 in f else None
+    if atype == 1 or (atype is None and 2 in f):      # FLOAT
+        return Attribute(name, struct.unpack("<f", f[2][0][1])[0])
+    if atype == 2 or (atype is None and 3 in f):      # INT
+        return Attribute(name, _signed(f[3][0][1]))
+    if atype == 3 or (atype is None and 4 in f):      # STRING
+        return Attribute(name, bytes(f[4][0][1]).decode())
+    if atype == 4 or (atype is None and 5 in f):      # TENSOR
+        return Attribute(name, parse_tensor(f[5][0][1]))
+    if atype == 6 or (atype is None and 7 in f):      # FLOATS
+        raw = b"".join(bytes(v) for _, v in f.get(7, []))
+        return Attribute(name, list(np.frombuffer(raw, np.float32)))
+    if atype == 7 or (atype is None and 8 in f):      # INTS
+        return Attribute(name, _ints(f.get(8, [])))
+    return Attribute(name, None)
+
+
+class Node:
+    __slots__ = ("op_type", "name", "inputs", "outputs", "attrs")
+
+    def __init__(self, op_type, name, inputs, outputs, attrs):
+        self.op_type, self.name = op_type, name
+        self.inputs, self.outputs, self.attrs = inputs, outputs, attrs
+
+
+class Graph:
+    __slots__ = ("name", "nodes", "initializers", "inputs", "outputs")
+
+    def __init__(self, name, nodes, initializers, inputs, outputs):
+        self.name = name
+        self.nodes = nodes
+        self.initializers = initializers                # name -> np.ndarray
+        self.inputs = inputs                            # [(name, shape|None)]
+        self.outputs = outputs                          # [name]
+
+
+def _value_info(buf: memoryview):
+    f = _fields(buf)
+    name = bytes(f[1][0][1]).decode() if 1 in f else ""
+    shape = None
+    if 2 in f:                                          # TypeProto
+        tf = _fields(f[2][0][1])
+        if 1 in tf:                                     # tensor_type
+            tt = _fields(tf[1][0][1])
+            if 2 in tt:                                 # shape
+                dims = []
+                sf = _fields(tt[2][0][1])
+                for _, dbuf in sf.get(1, []):
+                    df = _fields(dbuf)
+                    dims.append(df[1][0][1] if 1 in df else None)
+                shape = tuple(dims)
+    return name, shape
+
+
+def parse_graph(buf: memoryview) -> Graph:
+    f = _fields(buf)
+    name = bytes(f[2][0][1]).decode() if 2 in f else ""
+    nodes = []
+    for _, nbuf in f.get(1, []):
+        nf = _fields(nbuf)
+        nodes.append(Node(
+            bytes(nf[4][0][1]).decode() if 4 in nf else "",
+            bytes(nf[3][0][1]).decode() if 3 in nf else "",
+            [bytes(v).decode() for _, v in nf.get(1, [])],
+            [bytes(v).decode() for _, v in nf.get(2, [])],
+            {a.name: a.value for a in
+             (parse_attribute(abuf) for _, abuf in nf.get(5, []))}))
+    inits = {}
+    for _, tbuf in f.get(5, []):
+        t = parse_tensor(tbuf)
+        inits[t.name] = t.array
+    inputs = [_value_info(v) for _, v in f.get(11, [])]
+    outputs = [_value_info(v)[0] for _, v in f.get(12, [])]
+    return Graph(name, nodes, inits, inputs, outputs)
+
+
+def parse_model(data: bytes) -> Tuple[Graph, int]:
+    """Returns (graph, opset_version) from ModelProto bytes."""
+    f = _fields(memoryview(data))
+    if 7 not in f:
+        raise ValueError("not an ONNX ModelProto (no graph field)")
+    opset = 0
+    for _, obuf in f.get(8, []):
+        of = _fields(obuf)
+        domain = bytes(of[1][0][1]).decode() if 1 in of else ""
+        if domain in ("", "ai.onnx") and 2 in of:
+            opset = of[2][0][1]
+    return parse_graph(f[7][0][1]), opset

@@ -9,14 +9,16 @@ parallelism lives in ``ring_attention`` (K/V rotation, O(T/n) memory) and
 
 from . import collectives
 from . import mesh
-from .collectives import (all_gather, all_to_all, allgather_array, allreduce,
-                          allreduce_array, allreduce_processes, barrier,
-                          broadcast_array, broadcast_processes, pmean, ppermute,
+from .collectives import (all_gather, all_to_all, all_to_all_array,
+                          allgather_array, allreduce, allreduce_array,
+                          allreduce_processes, barrier, broadcast_array,
+                          broadcast_processes, pmean, ppermute,
                           process_barrier, psum, reduce_scatter,
                           reduce_scatter_array)
 from .data_parallel import DataParallelTrainer, replicate, shard_batch
-from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, get_default_mesh,
-                   make_mesh, set_default_mesh)
+from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh,
+                   force_virtual_cpu_devices, get_default_mesh, make_mesh,
+                   set_default_mesh)
 from . import ring_attention
 from .ring_attention import ring_attention_inner, ring_self_attention
 from . import ulysses

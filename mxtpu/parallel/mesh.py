@@ -16,7 +16,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "data_parallel_mesh",
+__all__ = ["Mesh", "NamedSharding", "P", "force_virtual_cpu_devices",
+           "make_mesh", "data_parallel_mesh",
            "get_default_mesh", "set_default_mesh"]
 
 _default_mesh: Optional[Mesh] = None

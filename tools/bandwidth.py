@@ -111,6 +111,9 @@ def measure_collectives(mesh, sizes_mb, iters: int = 8):
         # would time the D2H transfer — read back ONE device-side element
         return float(arr.ravel()[0])
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ax = mesh.axis_names[0]
     rows = []
     for name, fn in ops.items():
         for mb in sizes_mb:
@@ -118,10 +121,18 @@ def measure_collectives(mesh, sizes_mb, iters: int = 8):
             # payload*factor is per-device wire bytes for all four ops
             n = int(mb * 1e6 / 4)
             n -= n % (w * w)                        # divisible for a2a/ag
+            # pre-place with the op's INPUT sharding — an unsharded operand
+            # would make every timed call pay a device-0 redistribute first,
+            # polluting the collective timing on real hardware
             if name == "all_to_all":
-                x = jnp.ones((w, n), jnp.float32)   # shard: (1, n) per device
+                x = jax.device_put(jnp.ones((w, n), jnp.float32),
+                                   NamedSharding(mesh, P(ax)))  # (1, n)/dev
+            elif name == "all_gather":
+                x = jax.device_put(jnp.ones((n,), jnp.float32),
+                                   NamedSharding(mesh, P(ax)))  # shard in
             else:
-                x = jnp.ones((n,), jnp.float32)     # replicated / dp-sharded
+                x = jax.device_put(jnp.ones((n,), jnp.float32),
+                                   NamedSharding(mesh, P()))    # replicated
             sync(fn(x))                             # warm + compile
             t0 = time.perf_counter()
             out = x
