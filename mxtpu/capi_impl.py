@@ -24,14 +24,24 @@ __all__ = ["Predictor", "create_predictor", "load_param_bytes"]
 
 
 def load_param_bytes(param_bytes: bytes) -> Tuple[Dict, Dict]:
-    """Split a params payload (nd.save npz format) into (arg_params, aux_params),
-    stripping the reference's ``arg:``/``aux:`` prefixes (c_predict_api.cc does the
-    same split when creating a predictor)."""
+    """Split a params payload (nd.save npz format, or the reference's
+    NDARRAY_V2 binary — sniffed by magic) into (arg_params, aux_params),
+    stripping the reference's ``arg:``/``aux:`` prefixes (c_predict_api.cc
+    does the same split when creating a predictor). Empty bytes → a predictor
+    whose arguments all arrive via MXPredSetInput (the pure-C compose loop)."""
     from .ndarray.ndarray import _SAVE_FORMAT_KEY, _decode_entries
 
-    with np.load(io.BytesIO(param_bytes), allow_pickle=False) as z:
-        keys = [k for k in z.keys() if k != _SAVE_FORMAT_KEY]
-        entries = _decode_entries(z, keys)
+    if not param_bytes:
+        return {}, {}
+    from .ndarray import legacy_io
+    if legacy_io.is_reference_file(param_bytes[:8]):
+        entries = legacy_io.load_bytes(param_bytes)
+        if isinstance(entries, list):
+            entries = {f"arr_{i}": v for i, v in enumerate(entries)}
+    else:
+        with np.load(io.BytesIO(param_bytes), allow_pickle=False) as z:
+            keys = [k for k in z.keys() if k != _SAVE_FORMAT_KEY]
+            entries = _decode_entries(z, keys)
     arg_params, aux_params = {}, {}
     for k, v in entries.items():
         if k.startswith("arg:"):
@@ -258,3 +268,106 @@ def kv_set_optimizer(kv, spec_json: str) -> None:
     from . import optimizer as opt_mod
     spec = _json.loads(spec_json)
     kv.set_optimizer(opt_mod.create(spec["name"], **spec.get("kwargs", {})))
+
+
+# ---------------------------------------------------------------------------
+# Symbol C surface (reference c_api_symbolic.cc: MXSymbolCreateAtomicSymbol /
+# MXSymbolCreateVariable / MXSymbolCreateFromJSON / MXSymbolCompose /
+# MXSymbolSaveToJSON / MXSymbolListArguments|Outputs|AuxiliaryStates /
+# MXSymbolInferShape). A SymbolHandle is a SymbolBox PyObject: an atomic
+# (un-composed) op descriptor until MXSymbolCompose binds its inputs in place
+# — the reference's two-step create/compose protocol — and a real Symbol
+# afterwards. A pure C client can therefore BUILD a graph, infer its shapes,
+# serialize it, and hand the JSON to MXPredCreate: no Python-authored JSON
+# anywhere in the loop.
+# ---------------------------------------------------------------------------
+
+
+class SymbolBox:
+    __slots__ = ("payload",)
+
+    def __init__(self, payload):
+        self.payload = payload       # ("atomic", op_name, attrs) | Symbol
+
+
+def _unbox(box):
+    if isinstance(box.payload, tuple):
+        raise ValueError(
+            f"symbol is an un-composed atomic op {box.payload[1]!r}: call "
+            "MXSymbolCompose first")
+    return box.payload
+
+
+def sym_create_variable(name: str):
+    from . import symbol
+    return SymbolBox(symbol.Variable(name))
+
+
+def sym_create_from_json(json_str: str):
+    from . import symbol
+    return SymbolBox(symbol.load_json(json_str))
+
+
+def sym_create_atomic(op_name: str, param_keys, param_vals):
+    from .ops import registry as reg
+    reg.get_op(op_name)              # fail fast on unknown op
+    attrs = {k: _parse_param(v)
+             for k, v in zip(param_keys, param_vals)}
+    return SymbolBox(("atomic", op_name, attrs))
+
+
+def sym_compose(box, name, in_keys, in_boxes) -> None:
+    """MXSymbolCompose semantics: bind inputs into the atomic symbol IN PLACE
+    (c_api_symbolic.cc MXSymbolCompose). All-empty keys → positional; mixed
+    keyword/positional is rejected, as in the reference."""
+    from .symbol import make_op_wrapper
+    if not isinstance(box.payload, tuple):
+        raise ValueError("MXSymbolCompose: symbol was already composed")
+    _, op_name, attrs = box.payload
+    ins = [_unbox(b) for b in in_boxes]
+    wrapper = make_op_wrapper(op_name)
+    kw = dict(attrs)
+    n_named = sum(1 for k in in_keys if k)
+    if n_named and n_named != len(list(in_keys)):
+        raise ValueError(
+            "MXSymbolCompose: keyword and positional inputs cannot be mixed "
+            "(provide keys for all inputs or for none)")
+    if n_named:
+        named = dict(zip(in_keys, ins))
+        box.payload = wrapper(name=name or None, **named, **kw)
+    else:
+        box.payload = wrapper(*ins, name=name or None, **kw)
+
+
+def sym_tojson(box) -> str:
+    return _unbox(box).tojson()
+
+
+def sym_list_arguments(box):
+    return list(_unbox(box).list_arguments())
+
+
+def sym_list_outputs(box):
+    return list(_unbox(box).list_outputs())
+
+
+def sym_list_aux(box):
+    return list(_unbox(box).list_auxiliary_states())
+
+
+def sym_infer_shape(box, keys, shapes):
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete). Unknown entries
+    (underdetermined inference) serialize as () with complete=0 — a genuine
+    scalar shape also serializes as () but with complete=1, the reference's
+    convention. Real errors (contradictory shapes, unknown names) RAISE so
+    the C boundary returns -1 with the message in MXGetLastError."""
+    s = _unbox(box)
+    feeds = {k: tuple(int(d) for d in shp) for k, shp in zip(keys, shapes)}
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(**feeds)
+    complete = int(all(t is not None
+                       for grp in (arg_shapes, out_shapes, aux_shapes)
+                       for t in (grp or [])))
+    def clean(lst):
+        return [tuple(int(d) for d in t) if t is not None else ()
+                for t in (lst or [])]
+    return (clean(arg_shapes), clean(out_shapes), clean(aux_shapes), complete)

@@ -1,0 +1,244 @@
+/* Pure-C SYMBOL client of the mxtpu C ABI (libmxtpu_capi.so).
+ *
+ * The round-4 verdict's missing slice: a C host that COMPOSES a graph —
+ * FC(8) -> relu -> FC(3) -> SoftmaxOutput — with MXSymbolCreateAtomicSymbolByName
+ * + MXSymbolCompose (no Python-authored JSON anywhere), discovers its
+ * auto-created parameters with MXSymbolListArguments, runs MXSymbolInferShape,
+ * serializes with MXSymbolSaveToJSON, binds the JSON through MXPredCreate with
+ * an EMPTY params payload (every argument arrives via MXPredSetInput), and
+ * checks the prediction against a softmax MLP computed right here in C.
+ *
+ * Reference parity target: src/c_api/c_api_symbolic.cc + c_predict_api.cc.
+ * Prints one JSON line: {"ok":1,"args":N,"complete":1,"maxdiff":...}
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* SymbolHandle;
+typedef void* PredictorHandle;
+
+extern const char* MXGetLastError(void);
+extern int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+extern int MXSymbolCreateAtomicSymbolByName(const char* op, uint32_t num_param,
+                                            const char** keys,
+                                            const char** vals,
+                                            SymbolHandle* out);
+extern int MXSymbolCompose(SymbolHandle sym, const char* name,
+                           uint32_t num_args, const char** keys,
+                           SymbolHandle* args);
+extern int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+extern int MXSymbolListArguments(SymbolHandle sym, uint32_t* size,
+                                 const char*** names);
+extern int MXSymbolInferShape(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_size, const uint32_t** in_ndim, const uint32_t*** in_data,
+    uint32_t* out_size, const uint32_t** out_ndim, const uint32_t*** out_data,
+    uint32_t* aux_size, const uint32_t** aux_ndim, const uint32_t*** aux_data,
+    int* complete);
+extern int MXSymbolFree(SymbolHandle sym);
+extern int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                        int param_size, int dev_type, int dev_id,
+                        uint32_t num_input, const char** input_keys,
+                        const uint32_t* input_shape_indptr,
+                        const uint32_t* input_shape_data,
+                        PredictorHandle* out);
+extern int MXPredSetInput(PredictorHandle h, const char* key,
+                          const float* data, uint32_t size);
+extern int MXPredForward(PredictorHandle h);
+extern int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                           uint32_t size);
+extern int MXPredFree(PredictorHandle h);
+
+#define CHECK(call)                                                     \
+  do {                                                                  \
+    if ((call) != 0) {                                                  \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError());        \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+#define B 2
+#define IN 4
+#define H 8
+#define C 3
+
+/* deterministic parameter patterns (mirrored by the expected-value math) */
+static float w1v(int i, int j) { return 0.05f * (float)(i - 3) + 0.02f * (float)j; }
+static float b1v(int i) { return 0.01f * (float)i; }
+static float w2v(int i, int j) { return 0.03f * (float)(j - 4) - 0.02f * (float)i; }
+static float b2v(int i) { return 0.05f - 0.01f * (float)i; }
+static float xv(int n, int j) { return 0.3f * (float)n + 0.1f * (float)j - 0.2f; }
+
+int main(void) {
+  /* ---- compose the graph, pure C ---------------------------------------- */
+  SymbolHandle data, fc1, relu, fc2, net;
+  CHECK(MXSymbolCreateVariable("data", &data));
+
+  const char* fc1_keys[] = {"num_hidden"};
+  const char* fc1_vals[] = {"8"};
+  CHECK(MXSymbolCreateAtomicSymbolByName("FullyConnected", 1, fc1_keys,
+                                         fc1_vals, &fc1));
+  const char* dkey[] = {"data"};
+  SymbolHandle dargs[] = {data};
+  CHECK(MXSymbolCompose(fc1, "fc1", 1, dkey, dargs));
+
+  const char* act_keys[] = {"act_type"};
+  const char* act_vals[] = {"relu"};
+  CHECK(MXSymbolCreateAtomicSymbolByName("Activation", 1, act_keys, act_vals,
+                                         &relu));
+  SymbolHandle rargs[] = {fc1};
+  CHECK(MXSymbolCompose(relu, "relu1", 1, NULL, rargs));
+
+  const char* fc2_keys[] = {"num_hidden"};
+  const char* fc2_vals[] = {"3"};
+  CHECK(MXSymbolCreateAtomicSymbolByName("FullyConnected", 1, fc2_keys,
+                                         fc2_vals, &fc2));
+  SymbolHandle f2args[] = {relu};
+  CHECK(MXSymbolCompose(fc2, "fc2", 1, dkey, f2args));
+
+  CHECK(MXSymbolCreateAtomicSymbolByName("SoftmaxOutput", 0, NULL, NULL,
+                                         &net));
+  SymbolHandle nargs[] = {fc2};
+  CHECK(MXSymbolCompose(net, "softmax", 1, NULL, nargs));
+
+  /* ---- discover the auto-created parameters ------------------------------ */
+  uint32_t n_args = 0;
+  const char** arg_names = NULL;
+  CHECK(MXSymbolListArguments(net, &n_args, &arg_names));
+  /* expected: data + 2x(weight,bias) + label = 6 */
+  if (n_args != 6) {
+    fprintf(stderr, "FAIL: expected 6 arguments, got %u\n", n_args);
+    return 1;
+  }
+  /* copy the names: the backing store is reused by later Symbol calls */
+  char names_buf[6][128];
+  const char* names[6];
+  for (uint32_t i = 0; i < n_args; ++i) {
+    strncpy(names_buf[i], arg_names[i], 127);
+    names_buf[i][127] = 0;
+    names[i] = names_buf[i];
+  }
+
+  /* ---- infer shapes from the data shape ---------------------------------- */
+  const char* ikeys[] = {"data"};
+  const uint32_t indptr[] = {0, 2};
+  const uint32_t ishape[] = {B, IN};
+  uint32_t in_size, out_size, aux_size;
+  const uint32_t *in_ndim, *out_ndim, *aux_ndim;
+  const uint32_t **in_data, **out_data, **aux_data;
+  int complete = 0;
+  CHECK(MXSymbolInferShape(net, 1, ikeys, indptr, ishape, &in_size, &in_ndim,
+                           &in_data, &out_size, &out_ndim, &out_data,
+                           &aux_size, &aux_ndim, &aux_data, &complete));
+  if (!complete || in_size != n_args) {
+    fprintf(stderr, "FAIL: infer_shape incomplete (%d) or size %u\n",
+            complete, in_size);
+    return 1;
+  }
+  /* stash the inferred arg shapes before the store is reused */
+  uint32_t shapes[6][4];
+  uint32_t ndims[6];
+  uint32_t total_dims = 0;
+  for (uint32_t i = 0; i < in_size; ++i) {
+    ndims[i] = in_ndim[i];
+    for (uint32_t d = 0; d < in_ndim[i]; ++d) shapes[i][d] = in_data[i][d];
+    total_dims += in_ndim[i];
+  }
+
+  /* ---- serialize, bind via the predict ABI (empty params) ---------------- */
+  const char* json = NULL;
+  CHECK(MXSymbolSaveToJSON(net, &json));
+  char* json_copy = strdup(json);
+
+  uint32_t bind_indptr[7];
+  uint32_t bind_dims[24];
+  uint32_t pos = 0;
+  bind_indptr[0] = 0;
+  for (uint32_t i = 0; i < n_args; ++i) {
+    for (uint32_t d = 0; d < ndims[i]; ++d) bind_dims[pos++] = shapes[i][d];
+    bind_indptr[i + 1] = pos;
+  }
+  PredictorHandle pred = NULL;
+  CHECK(MXPredCreate(json_copy, NULL, 0, 1, 0, n_args, names, bind_indptr,
+                     bind_dims, &pred));
+
+  /* ---- feed every argument from C --------------------------------------- */
+  float x[B * IN], w1[H * IN], b1[H], w2[C * H], b2[C];
+  for (int n = 0; n < B; ++n)
+    for (int j = 0; j < IN; ++j) x[n * IN + j] = xv(n, j);
+  for (int i = 0; i < H; ++i)
+    for (int j = 0; j < IN; ++j) w1[i * IN + j] = w1v(i, j);
+  for (int i = 0; i < H; ++i) b1[i] = b1v(i);
+  for (int i = 0; i < C; ++i)
+    for (int j = 0; j < H; ++j) w2[i * H + j] = w2v(i, j);
+  for (int i = 0; i < C; ++i) b2[i] = b2v(i);
+  float label[B] = {0.0f, 0.0f};
+
+  for (uint32_t i = 0; i < n_args; ++i) {
+    const char* nm = names[i];
+    uint32_t sz = 1;
+    for (uint32_t d = 0; d < ndims[i]; ++d) sz *= shapes[i][d];
+    const float* src = NULL;
+    if (strcmp(nm, "data") == 0) src = x;
+    else if (strstr(nm, "fc1_weight")) src = w1;
+    else if (strstr(nm, "fc1_bias")) src = b1;
+    else if (strstr(nm, "fc2_weight")) src = w2;
+    else if (strstr(nm, "fc2_bias")) src = b2;
+    else if (strstr(nm, "label")) src = label;
+    if (src == NULL) {
+      fprintf(stderr, "FAIL: unexpected argument %s\n", nm);
+      return 1;
+    }
+    CHECK(MXPredSetInput(pred, nm, src, sz));
+  }
+
+  /* ---- forward + verify against the same MLP computed here --------------- */
+  CHECK(MXPredForward(pred));
+  float out[B * C];
+  CHECK(MXPredGetOutput(pred, 0, out, B * C));
+
+  float maxdiff = 0.0f;
+  for (int n = 0; n < B; ++n) {
+    float h[H], logits[C], prob[C];
+    for (int i = 0; i < H; ++i) {
+      float acc = b1[i];
+      for (int j = 0; j < IN; ++j) acc += w1[i * IN + j] * x[n * IN + j];
+      h[i] = acc > 0.0f ? acc : 0.0f;
+    }
+    float m = -1e30f;
+    for (int i = 0; i < C; ++i) {
+      float acc = b2[i];
+      for (int j = 0; j < H; ++j) acc += w2[i * H + j] * h[j];
+      logits[i] = acc;
+      if (acc > m) m = acc;
+    }
+    float z = 0.0f;
+    for (int i = 0; i < C; ++i) {
+      prob[i] = expf(logits[i] - m);
+      z += prob[i];
+    }
+    for (int i = 0; i < C; ++i) {
+      float d = fabsf(out[n * C + i] - prob[i] / z);
+      if (d > maxdiff) maxdiff = d;
+    }
+  }
+  if (maxdiff > 1e-4f) {
+    fprintf(stderr, "FAIL: prediction mismatch, maxdiff=%g\n", (double)maxdiff);
+    return 1;
+  }
+
+  printf("{\"ok\":1,\"args\":%u,\"complete\":%d,\"maxdiff\":%g}\n", n_args,
+         complete, (double)maxdiff);
+  free(json_copy);
+  MXPredFree(pred);
+  MXSymbolFree(net);
+  MXSymbolFree(fc2);
+  MXSymbolFree(relu);
+  MXSymbolFree(fc1);
+  MXSymbolFree(data);
+  return 0;
+}

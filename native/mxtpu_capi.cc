@@ -933,3 +933,309 @@ int MXKVStoreSetOptimizer(KVStoreHandle handle, const char* spec_json) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Symbol ABI — the graph-composition slice of the reference's c_api.h
+// (src/c_api/c_api_symbolic.cc: MXSymbolCreateAtomicSymbol :134,
+// MXSymbolCreateVariable :161, MXSymbolCreateFromJSON, MXSymbolCompose :342,
+// MXSymbolSaveToJSON, MXSymbolListArguments/Outputs/AuxiliaryStates,
+// MXSymbolInferShape :466). A SymbolHandle is a capi_impl.SymbolBox PyObject:
+// atomic descriptor after CreateAtomicSymbolByName, a real framework Symbol
+// after Compose (in-place, reference protocol). Ops are addressed by NAME
+// (same declared deviation as MXImperativeInvokeByName). String/shape return
+// buffers are thread-local, valid until the next Symbol call on the thread —
+// the reference's per-thread ret-store lifetime contract
+// (c_api_common.h MXAPIThreadLocalEntry).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+thread_local std::string g_sym_json_ret;
+thread_local std::vector<std::string> g_sym_strs;
+thread_local std::vector<const char*> g_sym_str_ptrs;
+
+// MXSymbolInferShape backing store
+struct ShapeRet {
+  std::vector<std::vector<uint32_t>> dims;   // flattened per-tensor shapes
+  std::vector<uint32_t> ndims;
+  std::vector<const uint32_t*> ptrs;
+};
+thread_local ShapeRet g_shape_ret[3];        // arg / out / aux
+
+int fill_shape_group(PyObject* seq, ShapeRet* slot, uint32_t* size,
+                     const uint32_t** ndim_out, const uint32_t*** data_out) {
+  Py_ssize_t n = PyList_Size(seq);
+  slot->dims.assign(static_cast<size_t>(n), {});
+  slot->ndims.assign(static_cast<size_t>(n), 0);
+  slot->ptrs.assign(static_cast<size_t>(n), nullptr);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* t = PyList_GET_ITEM(seq, i);
+    Py_ssize_t nd = PyTuple_Size(t);
+    slot->ndims[i] = static_cast<uint32_t>(nd);
+    slot->dims[i].resize(static_cast<size_t>(nd));
+    for (Py_ssize_t d = 0; d < nd; ++d)
+      slot->dims[i][d] = static_cast<uint32_t>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(t, d)));
+    slot->ptrs[i] = slot->dims[i].data();
+  }
+  *size = static_cast<uint32_t>(n);
+  *ndim_out = slot->ndims.data();
+  *data_out = slot->ptrs.data();
+  return 0;
+}
+
+int sym_string_list(const char* fn, void* handle, uint32_t* out_size,
+                    const char*** out_array) {
+  if (handle == nullptr || out_size == nullptr || out_array == nullptr) {
+    g_last_error = std::string(fn) + ": null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl(fn, "(O)", static_cast<PyObject*>(handle));
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    Py_ssize_t n = PyList_Size(r);
+    g_sym_strs.clear();
+    g_sym_str_ptrs.clear();
+    bool ok = true;
+    for (Py_ssize_t i = 0; i < n && ok; ++i) {
+      const char* c = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+      if (c == nullptr) {
+        set_error_from_python();
+        ok = false;
+      } else {
+        g_sym_strs.emplace_back(c);
+      }
+    }
+    if (ok) {
+      for (auto& s : g_sym_strs) g_sym_str_ptrs.push_back(s.c_str());
+      *out_size = static_cast<uint32_t>(n);
+      *out_array = g_sym_str_ptrs.data();
+      rc = 0;
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* SymbolHandle;
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  if (name == nullptr || out == nullptr) {
+    g_last_error = "MXSymbolCreateVariable: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("sym_create_variable", "(s)", name);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    *out = r;
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  if (json == nullptr || out == nullptr) {
+    g_last_error = "MXSymbolCreateFromJSON: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("sym_create_from_json", "(s)", json);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    *out = r;
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolCreateAtomicSymbolByName(const char* op_name, uint32_t num_param,
+                                     const char** keys, const char** vals,
+                                     SymbolHandle* out) {
+  if (op_name == nullptr || out == nullptr ||
+      (num_param > 0 && (keys == nullptr || vals == nullptr))) {
+    g_last_error = "MXSymbolCreateAtomicSymbolByName: null argument";
+    return -1;
+  }
+  if (!ensure_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* ks = PyList_New(num_param);
+  PyObject* vs = PyList_New(num_param);
+  if (ks != nullptr && vs != nullptr) {
+    for (uint32_t i = 0; i < num_param; ++i) {
+      PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+      PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+    }
+    PyObject* r = call_impl("sym_create_atomic", "(sOO)", op_name, ks, vs);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      *out = r;
+      rc = 0;
+    }
+  }
+  if (PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, uint32_t num_args,
+                    const char** keys, SymbolHandle* args) {
+  if (sym == nullptr || (num_args > 0 && args == nullptr)) {
+    g_last_error = "MXSymbolCompose: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* ks = PyList_New(num_args);
+  PyObject* ins = PyList_New(num_args);
+  if (ks != nullptr && ins != nullptr) {
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyList_SET_ITEM(ks, i, PyUnicode_FromString(
+          keys != nullptr && keys[i] != nullptr ? keys[i] : ""));
+      PyObject* o = static_cast<PyObject*>(args[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(ins, i, o);
+    }
+    PyObject* r = call_impl("sym_compose", "(OsOO)",
+                            static_cast<PyObject*>(sym),
+                            name != nullptr ? name : "", ks, ins);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      Py_DECREF(r);
+      rc = 0;
+    }
+  }
+  if (PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(ks);
+  Py_XDECREF(ins);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json) {
+  if (sym == nullptr || out_json == nullptr) {
+    g_last_error = "MXSymbolSaveToJSON: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = call_impl("sym_tojson", "(O)", static_cast<PyObject*>(sym));
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    const char* c = PyUnicode_AsUTF8(r);
+    if (c != nullptr) {
+      g_sym_json_ret = c;
+      *out_json = g_sym_json_ret.c_str();
+      rc = 0;
+    } else {
+      set_error_from_python();
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolListArguments(SymbolHandle sym, uint32_t* out_size,
+                          const char*** out_array) {
+  return sym_string_list("sym_list_arguments", sym, out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, uint32_t* out_size,
+                        const char*** out_array) {
+  return sym_string_list("sym_list_outputs", sym, out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, uint32_t* out_size,
+                                const char*** out_array) {
+  return sym_string_list("sym_list_aux", sym, out_size, out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle sym, uint32_t num_args, const char** keys,
+                       const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete) {
+  if (sym == nullptr || complete == nullptr ||
+      (num_args > 0 && (keys == nullptr || arg_ind_ptr == nullptr ||
+                        arg_shape_data == nullptr))) {
+    g_last_error = "MXSymbolInferShape: null argument";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* ks = PyList_New(num_args);
+  PyObject* shps = PyList_New(num_args);
+  if (ks != nullptr && shps != nullptr) {
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+      uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+      PyObject* t = PyTuple_New(hi - lo);
+      for (uint32_t d = lo; d < hi; ++d)
+        PyTuple_SET_ITEM(t, d - lo,
+                         PyLong_FromUnsignedLong(arg_shape_data[d]));
+      PyList_SET_ITEM(shps, i, t);
+    }
+    PyObject* r = call_impl("sym_infer_shape", "(OOO)",
+                            static_cast<PyObject*>(sym), ks, shps);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      PyObject* arg_s = PyTuple_GET_ITEM(r, 0);
+      PyObject* out_s = PyTuple_GET_ITEM(r, 1);
+      PyObject* aux_s = PyTuple_GET_ITEM(r, 2);
+      *complete = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)));
+      fill_shape_group(arg_s, &g_shape_ret[0], in_shape_size, in_shape_ndim,
+                       in_shape_data);
+      fill_shape_group(out_s, &g_shape_ret[1], out_shape_size, out_shape_ndim,
+                       out_shape_data);
+      fill_shape_group(aux_s, &g_shape_ret[2], aux_shape_size, aux_shape_ndim,
+                       aux_shape_data);
+      Py_DECREF(r);
+      rc = 0;
+    }
+  }
+  if (PyErr_Occurred()) set_error_from_python();
+  Py_XDECREF(ks);
+  Py_XDECREF(shps);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolFree(SymbolHandle sym) {
+  if (sym == nullptr) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_DECREF(static_cast<PyObject*>(sym));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+}  // extern "C"

