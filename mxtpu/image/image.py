@@ -248,6 +248,15 @@ class ImageIter:
         if preprocess_threads and preprocess_threads > 1:
             from concurrent.futures import ThreadPoolExecutor
             self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self._out_dtype = kwargs.get("dtype", "float32")
+        if self._out_dtype == "uint8":
+            if kwargs.get("mean") is not None or kwargs.get("std") is not None:
+                raise ValueError(
+                    "dtype='uint8' emits raw pixels — normalization belongs "
+                    "on-device for that layout; drop mean/std or use float32")
+            # keep the data u8 end to end: no cast, no normalize in the chain
+            self.auglist = [a for a in self.auglist
+                            if not isinstance(a, CastAug)]
         self._items = []
         if path_imgrec:
             import threading
@@ -256,6 +265,7 @@ class ImageIter:
             self._rec_lock = threading.Lock()  # file reads serialize; decode doesn't
             self._items = list(range(len(self._rec)))
             self._mode = "rec"
+            self._init_native_batch(path_imgrec)
         elif path_imglist:
             # .lst format (tools/im2rec.py): index \t label... \t rel_path
             entries = []
@@ -280,6 +290,81 @@ class ImageIter:
             raise ValueError("need path_imgrec, path_imglist, or imglist")
         self._shuffle = shuffle
         self.reset()
+
+    def _init_native_batch(self, path_imgrec: str):
+        """Whole-batch native path (iter_image_recordio_2.cc ParseChunk
+        parity): when the aug chain reduces to crop+mirror[+normalize], one C
+        call per batch does parallel record reads and the fused
+        decode→crop→mirror→normalize→NCHW write into the batch slab — no
+        per-record Python, no per-image array hops."""
+        from .. import native
+        self._nb = None
+        if not native.available():
+            return
+        def reducible(a):
+            # the C kernel hardcodes p=0.5 mirror and float32/uint8 output —
+            # other parameters must take the per-image path
+            if isinstance(a, HorizontalFlipAug):
+                return a.p == 0.5
+            if isinstance(a, CastAug):
+                return a.typ == "float32"
+            return isinstance(a, (RandomCropAug, CenterCropAug))
+
+        if not all(reducible(a) for a in self.auglist):
+            return
+        mean, std = (self._fused_norm if self._fused_norm is not None
+                     else (None, None))
+        if self._out_dtype == "uint8" and (mean is not None or std is not None):
+            return                        # u8 out means normalize-on-device
+        try:
+            offsets, sizes = native.rio_index(path_imgrec)
+        except Exception:
+            return
+        self._nb = {
+            "path": path_imgrec, "offsets": offsets, "sizes": sizes,
+            "mean": mean, "std": std,
+            "rand_crop": any(isinstance(a, RandomCropAug) for a in self.auglist),
+            "rand_mirror": any(isinstance(a, HorizontalFlipAug)
+                               for a in self.auglist),
+        }
+
+    def _next_native(self, take, pad):
+        """One C pass for the whole batch; None → caller falls back."""
+        import struct as _struct
+
+        from .. import native
+        from ..io import DataBatch
+        from ..recordio import _IR_FORMAT, _IR_SIZE
+        nb = self._nb
+        idx = np.asarray(take, np.int64)
+        try:
+            buf, rec_offs = native.rio_read_batch(
+                nb["path"], nb["offsets"][idx], nb["sizes"][idx])
+        except Exception:
+            return None
+        n = len(take)
+        img_offs = np.empty(n, np.int64)
+        img_sizes = np.empty(n, np.int64)
+        labels = []
+        for i in range(n):
+            off = int(rec_offs[i])
+            flag, label, _, _ = _struct.unpack_from(_IR_FORMAT, buf, off)
+            hdr = _IR_SIZE + (4 * flag if flag > 0 else 0)
+            if flag > 0:
+                label = np.frombuffer(buf, np.float32, flag, off + _IR_SIZE)
+            img_offs[i] = off + hdr
+            img_sizes[i] = int(nb["sizes"][idx[i]]) - hdr
+            labels.append(np.asarray(label, np.float32))
+        data = native.decode_augment_batch(
+            buf, img_offs, img_sizes,
+            (self.data_shape[1], self.data_shape[2]),
+            mean=nb["mean"], std=nb["std"], rand_crop=nb["rand_crop"],
+            rand_mirror=nb["rand_mirror"],
+            seed=pyrandom.getrandbits(63), out_dtype=self._out_dtype)
+        if data is None:
+            return None
+        return DataBatch(data=[nd.array(data, dtype=self._out_dtype)],
+                         label=[nd.array(np.stack(labels))], pad=pad)
 
     def reset(self):
         self._cursor = 0
@@ -326,6 +411,12 @@ class ImageIter:
         take = self._items[self._cursor:self._cursor + self.batch_size]
         pad = self.batch_size - len(take)
         take = take + [take[-1]] * pad
+        if getattr(self, "_nb", None) is not None:
+            batch = self._next_native(take, pad)
+            if batch is not None:
+                self._cursor += self.batch_size
+                return batch
+            self._nb = None            # e.g. non-JPEG records: stop retrying
         if self._pool is not None:
             results = list(self._pool.map(self._read, take))
         else:
@@ -334,6 +425,11 @@ class ImageIter:
         arrs = [r[0].asnumpy() if isinstance(r[0], NDArray) else np.asarray(r[0])
                 for r in results]
         self._cursor += self.batch_size
+        if self._out_dtype == "uint8":
+            data = np.stack([np.asarray(a).transpose(2, 0, 1)
+                             for a in arrs]).astype(np.uint8)
+            return DataBatch(data=[nd.array(data, dtype="uint8")],
+                             label=[nd.array(np.stack(labels))], pad=pad)
         if self._fused_norm is not None and arrs[0].dtype == np.uint8:
             from .. import native
             data = native.nhwc_u8_to_nchw_f32(np.stack(arrs),

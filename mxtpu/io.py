@@ -460,16 +460,25 @@ def ImageRecordIter(path_imgrec: str, data_shape, batch_size: int,
                     rand_crop: bool = False, rand_mirror: bool = False,
                     mean_r: float = 0, mean_g: float = 0, mean_b: float = 0,
                     std_r: float = 1, std_g: float = 1, std_b: float = 1,
-                    resize: int = 0, **kwargs) -> DataIter:
+                    resize: int = 0, dtype: str = "float32",
+                    **kwargs) -> DataIter:
     """ImageRecordIter parity (iter_image_recordio_2.cc): RecordIO → threaded decode/
-    augment → NCHW batches, wrapped in a prefetcher."""
+    augment → NCHW batches, wrapped in a prefetcher.
+
+    ``dtype='uint8'`` emits raw NCHW uint8 batches (no normalize) — the
+    feed-to-accelerator layout where normalization runs on-device and the
+    wire carries 1 byte/px."""
     from .image import ImageIter
     mean = None
     if mean_r or mean_g or mean_b:
         mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    std = None
+    if (std_r, std_g, std_b) != (1, 1, 1):
+        std = np.array([std_r, std_g, std_b], np.float32)
     it = ImageIter(batch_size, data_shape, label_width, path_imgrec=path_imgrec,
                    shuffle=shuffle, resize=resize, rand_crop=rand_crop,
-                   rand_mirror=rand_mirror, mean=mean)
+                   rand_mirror=rand_mirror, mean=mean, std=std,
+                   preprocess_threads=preprocess_threads, dtype=dtype)
     return PrefetchingIter(_ImageIterAdapter(it, batch_size),
                            prefetch=prefetch_buffer)
 

@@ -94,8 +94,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_int64,
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
             ctypes.c_int64]
+        lib.decode_augment_batch.restype = ctypes.c_int
+        lib.decode_augment_batch.argtypes = [
+            ctypes.c_char_p, i64p, i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_int]
         lib.mxtpu_io_abi_version.restype = ctypes.c_int
-        if lib.mxtpu_io_abi_version() != 2:
+        if lib.mxtpu_io_abi_version() != 3:
             return None  # stale artifact: degrade gracefully, don't crash
         _lib = lib
         return _lib
@@ -158,6 +164,38 @@ def jpeg_decode(buf: bytes) -> Optional[np.ndarray]:
         return None
     out = np.empty((h.value, w.value, 3), np.uint8)
     if lib.jpeg_decode(buf, len(buf), out, out.size) != 0:
+        return None
+    return out
+
+
+def decode_augment_batch(blob: bytes, offsets: np.ndarray, sizes: np.ndarray,
+                         hw: Tuple[int, int], mean=None, std=None,
+                         rand_crop: bool = False, rand_mirror: bool = False,
+                         seed: int = 0, out_dtype: str = "float32",
+                         num_threads: int = 0) -> Optional[np.ndarray]:
+    """One threaded C pass per batch: JPEG decode -> crop -> mirror ->
+    [normalize ->] NCHW into a preallocated slab (iter_image_recordio_2.cc
+    ParseChunk parity). Returns None when the native path can't serve the
+    batch (no library, non-JPEG record, image smaller than target) — the
+    caller falls back to the per-image path."""
+    lib = _load()
+    if lib is None:
+        return None
+    H, W = int(hw[0]), int(hw[1])
+    n = len(sizes)
+    u8 = out_dtype == "uint8"
+    out = np.empty((n, 3, H, W), np.uint8 if u8 else np.float32)
+    _m = None if mean is None else np.ascontiguousarray(mean, np.float32)
+    _s = None if std is None else np.ascontiguousarray(std, np.float32)
+    rc = lib.decode_augment_batch(
+        blob, np.ascontiguousarray(offsets, np.int64),
+        np.ascontiguousarray(sizes, np.int64), n, H, W,
+        None if _m is None else _m.ctypes.data_as(ctypes.c_void_p),
+        None if _s is None else _s.ctypes.data_as(ctypes.c_void_p),
+        1 if rand_crop else 0, 1 if rand_mirror else 0,
+        ctypes.c_uint64(seed & (2**64 - 1)), 1 if u8 else 0,
+        out.ctypes.data_as(ctypes.c_void_p), num_threads)
+    if rc != 0:
         return None
     return out
 

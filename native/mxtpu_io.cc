@@ -255,6 +255,95 @@ int jpeg_decode(const uint8_t*, int64_t, uint8_t*, int64_t) { return -1; }
 
 #endif  // MXTPU_HAVE_JPEG
 
-int mxtpu_io_abi_version() { return 2; }
+namespace {
+
+// splitmix64: per-image deterministic stream from (seed, index)
+inline uint64_t mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+}  // namespace
+
+// The whole per-record pipeline of the reference's iter_image_recordio_2.cc
+// ParseChunk loop (:50-149) as ONE threaded C pass writing straight into the
+// preallocated batch slab: JPEG decode -> (center|random) crop -> optional
+// mirror -> [(x - mean)/std ->] NCHW, float32 (out_dtype=0) or uint8
+// (out_dtype=1, mean/std must be null — the feed-to-device layout where
+// normalize runs on-chip). Removes every per-record Python hop and per-image
+// array allocation of the fallback path. Returns 0, -1 on a decode failure,
+// -2 when a decoded image is smaller than the HxW target, -3 on bad args.
+int decode_augment_batch(const uint8_t* blob, const int64_t* offsets,
+                         const int64_t* sizes, int64_t n, int64_t H, int64_t W,
+                         const float* mean, const float* stddev, int rand_crop,
+                         int rand_mirror, uint64_t seed, int out_dtype,
+                         void* out, int num_threads) {
+  if (blob == nullptr || offsets == nullptr || sizes == nullptr ||
+      out == nullptr || H <= 0 || W <= 0 ||
+      (out_dtype == 1 && (mean != nullptr || stddev != nullptr)))
+    return -3;
+  std::atomic<int> failed{0};
+  const int64_t img_out = 3 * H * W;
+  parallel_for(
+      n,
+      [&](int64_t i) {
+        if (failed.load(std::memory_order_relaxed)) return;
+        const uint8_t* buf = blob + offsets[i];
+        int64_t h = 0, w = 0, c = 0;
+        if (jpeg_dims(buf, sizes[i], &h, &w, &c) != 0) {
+          failed.store(-1);
+          return;
+        }
+        if (h < H || w < W) {
+          failed.store(-2);
+          return;
+        }
+        std::vector<uint8_t> scratch(static_cast<size_t>(h * w * 3));
+        if (jpeg_decode(buf, sizes[i], scratch.data(),
+                        static_cast<int64_t>(scratch.size())) != 0) {
+          failed.store(-1);
+          return;
+        }
+        uint64_t r = mix64(seed ^ static_cast<uint64_t>(i));
+        const int64_t x0 = rand_crop ? static_cast<int64_t>(r % (w - W + 1))
+                                     : (w - W) / 2;
+        r = mix64(r);
+        const int64_t y0 = rand_crop ? static_cast<int64_t>(r % (h - H + 1))
+                                     : (h - H) / 2;
+        r = mix64(r);
+        const bool mirror = rand_mirror && (r & 1);
+        for (int64_t ch = 0; ch < 3; ++ch) {
+          const float m = mean ? mean[ch] : 0.0f;
+          const float inv_s = stddev ? 1.0f / stddev[ch] : 1.0f;
+          for (int64_t y = 0; y < H; ++y) {
+            const uint8_t* srow = scratch.data() + ((y0 + y) * w + x0) * 3 + ch;
+            const int64_t base = i * img_out + (ch * H + y) * W;
+            if (out_dtype == 1) {
+              uint8_t* d = static_cast<uint8_t*>(out) + base;
+              if (mirror) {
+                for (int64_t x = 0; x < W; ++x) d[x] = srow[(W - 1 - x) * 3];
+              } else {
+                for (int64_t x = 0; x < W; ++x) d[x] = srow[x * 3];
+              }
+            } else {
+              float* d = static_cast<float*>(out) + base;
+              if (mirror) {
+                for (int64_t x = 0; x < W; ++x)
+                  d[x] = (static_cast<float>(srow[(W - 1 - x) * 3]) - m) * inv_s;
+              } else {
+                for (int64_t x = 0; x < W; ++x)
+                  d[x] = (static_cast<float>(srow[x * 3]) - m) * inv_s;
+              }
+            }
+          }
+        }
+      },
+      num_threads);
+  return failed.load();
+}
+
+int mxtpu_io_abi_version() { return 3; }
 
 }  // extern "C"

@@ -165,3 +165,71 @@ def test_tsan_race_detection(tmp_path):
     assert "WARNING: ThreadSanitizer" not in r.stderr, \
         f"data race detected:\n{r.stderr[-4000:]}"
     assert r.returncode == 0, f"stress run failed rc={r.returncode}:\n{r.stderr[-2000:]}"
+
+
+def test_decode_augment_batch_matches_per_image_path(tmp_path):
+    """The whole-batch native path (decode_augment_batch) must equal the
+    per-image fallback bitwise-close for the deterministic config (center
+    crop + normalize, no rand)."""
+    rec = _make_rec(tmp_path, n=12, hw=24)
+    kwargs = dict(data_shape=(3, 20, 20), batch_size=6,
+                  mean_r=10.0, mean_g=20.0, mean_b=30.0)
+    fast = ImageRecordIter(rec, preprocess_threads=2, **kwargs)
+    slow_inner = ImageRecordIter(rec, preprocess_threads=2, **kwargs)
+    slow_inner.iter._it._nb = None          # force the per-image path
+    b_fast = next(iter(fast))
+    b_slow = next(iter(slow_inner))
+    np.testing.assert_allclose(b_fast.data[0].asnumpy(),
+                               b_slow.data[0].asnumpy(), rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(b_fast.label[0].asnumpy(),
+                               b_slow.label[0].asnumpy())
+
+
+def test_decode_augment_batch_uint8_mode(tmp_path):
+    """dtype='uint8' emits raw NCHW u8 (exact integers vs the per-image
+    decode + transpose)."""
+    from mxtpu.image import ImageIter, imdecode
+    rec = _make_rec(tmp_path, n=8, hw=24)
+    it = ImageIter(4, (3, 20, 20), path_imgrec=rec, preprocess_threads=1,
+                   dtype="uint8")
+    assert it._nb is not None               # native path engaged
+    batch = next(it)
+    got = batch.data[0].asnumpy()
+    assert got.dtype == np.uint8 and got.shape == (4, 3, 20, 20)
+    # oracle: decode record 0 and center-crop 24->20
+    from mxtpu.gluon.data import RecordFileDataset
+    raw = RecordFileDataset(rec)[0]
+    _, payload = recordio.unpack(raw)
+    img = np.asarray(imdecode(payload).asnumpy())
+    y0 = x0 = (24 - 20) // 2
+    oracle = img[y0:y0 + 20, x0:x0 + 20].transpose(2, 0, 1)
+    np.testing.assert_array_equal(got[0], oracle)
+
+
+def test_decode_augment_batch_multifloat_labels_and_fallback(tmp_path):
+    """flag>0 multi-float labels parse; resize disables the native path."""
+    rec = str(tmp_path / "multi.rec")
+    w = MXRecordIO(rec, "w")
+    rs = np.random.RandomState(3)
+    from PIL import Image
+    import io as pyio
+    for i in range(6):
+        img = (rs.rand(24, 24, 3) * 255).astype(np.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=90)
+        lab = np.array([i, i + 0.5, 9.0], np.float32)
+        w.write(recordio.pack(IRHeader(3, lab, i, 0), buf.getvalue()))
+    w.close()
+
+    from mxtpu.image import ImageIter
+    it = ImageIter(3, (3, 20, 20), label_width=3, path_imgrec=rec,
+                   preprocess_threads=1)
+    assert it._nb is not None
+    b = next(it)
+    labels = b.label[0].asnumpy()
+    assert labels.shape == (3, 3)
+    np.testing.assert_allclose(labels[1], [1.0, 1.5, 9.0])
+
+    it_resize = ImageIter(3, (3, 16, 16), path_imgrec=rec, resize=20,
+                          preprocess_threads=1)
+    assert it_resize._nb is None            # resize -> per-image path
