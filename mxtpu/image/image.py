@@ -314,8 +314,6 @@ class ImageIter:
             return
         mean, std = (self._fused_norm if self._fused_norm is not None
                      else (None, None))
-        if self._out_dtype == "uint8" and (mean is not None or std is not None):
-            return                        # u8 out means normalize-on-device
         try:
             offsets, sizes = native.rio_index(path_imgrec)
         except Exception:

@@ -90,10 +90,20 @@ class BaseModule:
                     cb(BatchEndParam(epoch, nbatch, eval_metric))
         return eval_metric.get_name_value()
 
-    def predict(self, eval_data: DataIter, num_batch=None, reset: bool = True):
+    def predict(self, eval_data: DataIter, num_batch=None, reset: bool = True,
+                chain: int = 1):
+        """``chain=n`` turns on dispatch-amortized serving: n batches run as
+        ONE compiled program (mxtpu.serving.ChainedPredictor), paying the
+        per-call dispatch once per chain — the cure for RPC-floor-gated
+        small-batch serving on disaggregated accelerators."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
+        # chained serving needs a directly-callable block: Module only
+        # (Bucketing/Sequential modules fall through to the per-batch loop)
+        if chain > 1 and getattr(self, "_block", None) is not None \
+                and not getattr(self, "_symbolic", True):
+            return self._predict_chained(eval_data, num_batch, chain)
         outputs = []
         for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
@@ -105,6 +115,45 @@ class BaseModule:
             outputs.append(outs)
         if not outputs:
             return []
+        joined = [nd.concatenate([o[i] for o in outputs], axis=0)
+                  for i in range(len(outputs[0]))]
+        return joined[0] if len(joined) == 1 else joined
+
+    def _predict_chained(self, eval_data: DataIter, num_batch, chain: int):
+        from .serving import ChainedPredictor
+        # predictor cached per chain length: its jitted programs are the
+        # whole point — a fresh one per call would recompile every time
+        cache = getattr(self, "_chained_predictors", None)
+        if cache is None:
+            cache = self._chained_predictors = {}
+        cp = cache.get(chain)
+        if cp is None:
+            cp = cache[chain] = ChainedPredictor(self._block, chain)
+        pads = []
+
+        def stream():
+            for nbatch, batch in enumerate(eval_data):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                if len(batch.data) != 1:
+                    raise ValueError(
+                        "predict(chain=n) supports single-input modules; use "
+                        "the per-batch path for multi-input data")
+                pads.append(batch.pad)
+                yield batch.data[0]
+
+        per_batch = cp.predict_batches(stream())
+        if not per_batch:
+            return []
+        from .gluon.loss import SoftmaxCrossEntropyLoss
+        softmax_head = isinstance(self._loss, SoftmaxCrossEntropyLoss)
+        outputs = []
+        for outs, pad in zip(per_batch, pads):
+            if softmax_head:           # get_outputs() probability parity
+                outs = [outs[0].softmax()] + outs[1:]
+            if pad:
+                outs = [o[:o.shape[0] - pad] for o in outs]
+            outputs.append(outs)
         joined = [nd.concatenate([o[i] for o in outputs], axis=0)
                   for i in range(len(outputs[0]))]
         return joined[0] if len(joined) == 1 else joined
