@@ -1,6 +1,8 @@
-"""ONNX import — ``mx.contrib.onnx.import_model`` surface (reference
-python/mxnet/contrib/onnx). Export's portable-graph role is covered by
-StableHLO (``mxtpu.jit.export_stablehlo``); import speaks real ONNX so zoo
-artifacts cross over."""
+"""ONNX interchange — ``mx.contrib.onnx`` surface (reference
+python/mxnet/contrib/onnx): ``import_model`` consumes real ONNX files
+(onnx2mx) and ``export_model`` produces them (mx2onnx), both through the
+dependency-free wire codec in ``_proto.py``. StableHLO
+(``mxtpu.jit.export_stablehlo``) remains the compiler-native portable form."""
 
+from .mx2onnx import export_model
 from .onnx2mx import get_model_metadata, import_graph, import_model

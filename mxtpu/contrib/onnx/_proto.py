@@ -213,3 +213,117 @@ def parse_model(data: bytes) -> Tuple[Graph, int]:
         if domain in ("", "ai.onnx") and 2 in of:
             opset = of[2][0][1]
     return parse_graph(f[7][0][1]), opset
+
+
+# ---------------------------------------------------------------------------
+# writer — the inverse wire encoding, for mx2onnx export
+# ---------------------------------------------------------------------------
+
+
+def _varint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def w_varint(field: int, value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64                     # two's-complement int64
+    return _varint(field << 3) + _varint(value)
+
+
+def w_bytes(field: int, data: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(data)) + data
+
+
+def w_str(field: int, s: str) -> bytes:
+    return w_bytes(field, s.encode())
+
+
+def w_f32(field: int, v: float) -> bytes:
+    return _varint((field << 3) | 5) + struct.pack("<f", v)
+
+
+_NP_TO_ONNX_DTYPE = {np.dtype(v): k for k, v in _TENSOR_DTYPES.items()}
+
+
+def w_tensor(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    dt = _NP_TO_ONNX_DTYPE.get(arr.dtype)
+    if dt is None:
+        raise ValueError(f"tensor {name!r}: unsupported dtype {arr.dtype}")
+    out = b"".join(w_varint(1, int(d)) for d in arr.shape)
+    out += w_varint(2, dt)
+    out += w_str(8, name)
+    out += w_bytes(9, arr.tobytes())
+    return out
+
+
+def w_attr(name: str, value) -> bytes:
+    """AttributeProto with the explicit type tag (field 20)."""
+    out = w_str(1, name)
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, float):
+        out += w_f32(2, value) + w_varint(20, 1)             # FLOAT
+    elif isinstance(value, int):
+        out += w_varint(3, value) + w_varint(20, 2)          # INT
+    elif isinstance(value, str):
+        out += w_bytes(4, value.encode()) + w_varint(20, 3)  # STRING
+    elif isinstance(value, (list, tuple)) and value \
+            and all(isinstance(v, float) for v in value):
+        out += b"".join(w_f32(7, v) for v in value) + w_varint(20, 6)
+    elif isinstance(value, (list, tuple)):
+        out += b"".join(w_varint(8, int(v)) for v in value) + w_varint(20, 7)
+    else:
+        raise TypeError(f"attr {name!r}: unsupported value {value!r}")
+    return out
+
+
+def w_node(op_type: str, inputs, outputs, name: str = "", attrs=None) -> bytes:
+    out = b"".join(w_str(1, i) for i in inputs)
+    out += b"".join(w_str(2, o) for o in outputs)
+    if name:
+        out += w_str(3, name)
+    out += w_str(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += w_bytes(5, w_attr(k, v))
+    return out
+
+
+def w_value_info(name: str, shape=None, elem_type: int = 1) -> bytes:
+    tt = w_varint(1, elem_type)
+    if shape is not None:
+        dims = b""
+        for i, d in enumerate(shape):
+            if d is None or isinstance(d, str):
+                # dynamic dimension → dim_param (Dimension field 2)
+                dims += w_bytes(1, w_str(2, d if isinstance(d, str)
+                                         else f"dyn_{i}"))
+            else:
+                dims += w_bytes(1, w_varint(1, int(d)))
+        tt += w_bytes(2, dims)
+    return w_str(1, name) + w_bytes(2, w_bytes(1, tt))
+
+
+def w_model(nodes, initializers, inputs, outputs, graph_name: str = "mxtpu",
+            opset: int = 13, producer: str = "mxtpu") -> bytes:
+    """nodes: encoded NodeProto bytes; initializers: encoded TensorProto
+    bytes; inputs/outputs: encoded ValueInfoProto bytes."""
+    graph = b"".join(w_bytes(1, n) for n in nodes)
+    graph += w_str(2, graph_name)
+    graph += b"".join(w_bytes(5, t) for t in initializers)
+    graph += b"".join(w_bytes(11, v) for v in inputs)
+    graph += b"".join(w_bytes(12, v) for v in outputs)
+    model = w_varint(1, 8)                              # ir_version
+    model += w_str(2, producer)
+    model += w_bytes(7, graph)
+    model += w_bytes(8, w_varint(2, opset))             # opset_import
+    return model

@@ -1,0 +1,211 @@
+"""Symbol → ONNX export — functional counterpart of the reference's
+``contrib.onnx.mx2onnx`` (python/mxnet/contrib/onnx/mx2onnx/
+export_model.py:95 ``export_model``, op tables in ``_op_translations.py``).
+
+The graph walks the Symbol DAG directly (no executor bind needed) and the
+protobuf is emitted by the wire writer in ``_proto.py`` — no onnx package in
+the image. Covered op set mirrors the importer: the zoo families
+(conv/BN/activations/pools/FC/concat/softmax/flatten/elementwise) plus
+reshape/transpose/clip/dropout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import _proto as P
+
+__all__ = ["export_model"]
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus"}
+_ELEMWISE = {"elemwise_add": "Add", "broadcast_add": "Add", "_plus": "Add",
+             "elemwise_sub": "Sub", "broadcast_sub": "Sub", "_minus": "Sub",
+             "elemwise_mul": "Mul", "broadcast_mul": "Mul", "_mul": "Mul",
+             "elemwise_div": "Div", "broadcast_div": "Div", "_div": "Div"}
+
+
+class _Exporter:
+    def __init__(self, sym, params: Dict, input_shapes: Dict):
+        self.sym = sym
+        self.params = params
+        self.input_shapes = dict(input_shapes)
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.inputs: List[bytes] = []
+        self._emitted_inits = set()
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _tname(node, j: int) -> str:
+        return node.name if j == 0 else f"{node.name}_out{j}"
+
+    def _in_names(self, node) -> List[str]:
+        return [self._tname(c, j) for c, j in node.inputs]
+
+    def _add_init(self, name: str, arr) -> str:
+        if name not in self._emitted_inits:
+            raw = np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy") else arr)
+            if raw.dtype.name == "bfloat16":
+                raw = raw.astype(np.float32)
+            self.initializers.append(P.w_tensor(name, raw))
+            self._emitted_inits.add(name)
+        return name
+
+    def _emit(self, op: str, ins, outs, name: str, attrs=None):
+        self.nodes.append(P.w_node(op, ins, outs, name=name, attrs=attrs))
+
+    # -- per-op translation -------------------------------------------------
+    def _convert(self, node):
+        key = node.op_key
+        name = node.name
+        ins = self._in_names(node)
+        out = self._tname(node, 0)
+        a = node.attrs
+
+        if key in ("FullyConnected",):
+            srcs = list(ins)
+            if a.get("flatten", True):     # identity on 2-D, required on >2-D
+                flat = f"{name}_flat"
+                self._emit("Flatten", [srcs[0]], [flat], f"{name}_flatten",
+                           {"axis": 1})
+                srcs[0] = flat
+            self._emit("Gemm", srcs, [out], name,
+                       {"alpha": 1.0, "beta": 1.0, "transB": 1})
+        elif key == "Activation":
+            act = _ACT.get(a.get("act_type", "relu"))
+            if act is None:
+                raise NotImplementedError(
+                    f"Activation {a.get('act_type')!r} has no ONNX mapping")
+            self._emit(act, ins, [out], name)
+        elif key in ("relu",):
+            self._emit("Relu", ins, [out], name)
+        elif key in ("sigmoid",):
+            self._emit("Sigmoid", ins, [out], name)
+        elif key in ("tanh",):
+            self._emit("Tanh", ins, [out], name)
+        elif key == "Convolution":
+            attrs = {"kernel_shape": [int(k) for k in a["kernel"]],
+                     "group": int(a.get("num_group", 1))}
+            if a.get("stride"):
+                attrs["strides"] = [int(s) for s in a["stride"]]
+            if a.get("dilate"):
+                attrs["dilations"] = [int(d) for d in a["dilate"]]
+            if a.get("pad"):
+                attrs["pads"] = [int(p) for p in a["pad"]] * 2
+            self._emit("Conv", ins, [out], name, attrs)
+        elif key == "BatchNorm":
+            if a.get("fix_gamma", True):
+                # MXNet's fix_gamma=True (the default) computes with gamma=1
+                # regardless of the stored values — export ones or the
+                # consumer scales by garbage
+                garr = self.params.get(ins[1])
+                if garr is None:
+                    raise ValueError(
+                        f"BatchNorm {name!r}: fix_gamma=True needs the gamma "
+                        "param to size its ones replacement")
+                shape = np.asarray(
+                    garr.asnumpy() if hasattr(garr, "asnumpy") else garr).shape
+                ins[1] = self._add_init(f"{name}_fixed_gamma",
+                                        np.ones(shape, np.float32))
+            self._emit("BatchNormalization", ins, [out], name,
+                       {"epsilon": float(a.get("eps", 1e-3)),   # MXNet default
+                        "momentum": float(a.get("momentum", 0.9))})
+        elif key == "Pooling":
+            ptype = a.get("pool_type", "max")
+            if a.get("global_pool", False):
+                op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+                self._emit(op, ins, [out], name)
+            else:
+                op = {"max": "MaxPool", "avg": "AveragePool"}[ptype]
+                attrs = {"kernel_shape": [int(k) for k in a["kernel"]]}
+                if a.get("stride"):
+                    attrs["strides"] = [int(s) for s in a["stride"]]
+                if a.get("pad"):
+                    attrs["pads"] = [int(p) for p in a["pad"]] * 2
+                if ptype == "avg":
+                    attrs["count_include_pad"] = int(
+                        a.get("count_include_pad", True))
+                self._emit(op, ins, [out], name, attrs)
+        elif key == "softmax":
+            self._emit("Softmax", ins, [out], name,
+                       {"axis": int(a.get("axis", -1))})
+        elif key in ("SoftmaxOutput", "Softmax"):
+            # legacy loss head ("Softmax" is its alias): drop the label
+            # input; multi_output mode softmaxes over axis 1
+            self._emit("Softmax", ins[:1], [out], name,
+                       {"axis": 1 if a.get("multi_output", False) else -1})
+        elif key in ("Flatten", "flatten"):
+            self._emit("Flatten", ins, [out], name, {"axis": 1})
+        elif key in _ELEMWISE:
+            self._emit(_ELEMWISE[key], ins, [out], name)
+        elif key in ("Concat", "concat"):
+            self._emit("Concat", ins, [out], name,
+                       {"axis": int(a.get("dim", 1))})
+        elif key in ("Reshape", "reshape"):
+            shp = self._add_init(f"{name}_shape",
+                                 np.asarray(a["shape"], np.int64))
+            self._emit("Reshape", ins + [shp], [out], name)
+        elif key == "transpose":
+            self._emit("Transpose", ins, [out], name,
+                       {"perm": [int(x) for x in a.get("axes", ())]})
+        elif key == "clip":
+            lo = self._add_init(f"{name}_min",
+                                np.float32(a.get("a_min", -np.inf)))
+            hi = self._add_init(f"{name}_max",
+                                np.float32(a.get("a_max", np.inf)))
+            self._emit("Clip", ins + [lo, hi], [out], name)
+        elif key == "Dropout":
+            self._emit("Identity", ins[:1], [out], name)
+        else:
+            raise NotImplementedError(
+                f"Symbol op {key!r} (node {name!r}) has no ONNX translation")
+
+    # -- driver ------------------------------------------------------------
+    def run(self) -> bytes:
+        from ...symbol.symbol import _topo
+        nodes = _topo(self.sym._heads)
+        # which op-parameter slots consume each variable: a var used ONLY as
+        # a loss-head 'label' doesn't export (the head becomes plain Softmax)
+        slots: Dict[int, set] = {}
+        for n in nodes:
+            for (child, _), pname in zip(n.inputs, n.input_params):
+                slots.setdefault(id(child), set()).add(pname)
+        for node in nodes:
+            if node.op_key is None:
+                if node.name in self.params:
+                    self._add_init(node.name, self.params[node.name])
+                elif slots.get(id(node)) == {"label"}:
+                    continue               # loss-head labels don't export
+                else:
+                    shape = self.input_shapes.get(node.name)
+                    if shape is None:
+                        raise ValueError(
+                            f"no shape for graph input {node.name!r}: pass "
+                            f"input_shapes={{{node.name!r}: (...)}} or "
+                            "include it in params")
+                    self.inputs.append(P.w_value_info(node.name, shape))
+            else:
+                self._convert(node)
+        outs = [P.w_value_info(self._tname(n, j), None)
+                for n, j in self.sym._heads]
+        return P.w_model(self.nodes, self.initializers, self.inputs, outs)
+
+
+def export_model(sym, params: Dict, input_shapes: Dict,
+                 onnx_file: Optional[str] = None,
+                 aux_params: Optional[Dict] = None):
+    """Symbol + params → ONNX ModelProto bytes; written to ``onnx_file`` when
+    given (reference export_model API, mx2onnx/export_model.py:95). ``params``
+    holds arg params; ``aux_params`` (BatchNorm running stats) merge in."""
+    merged = dict(params)
+    if aux_params:
+        merged.update(aux_params)
+    data = _Exporter(sym, merged, input_shapes).run()
+    if onnx_file:
+        with open(onnx_file, "wb") as f:
+            f.write(data)
+        return onnx_file
+    return data

@@ -1,0 +1,59 @@
+package AI::MXTPU;
+# AI::MXTPU — Perl binding over the mxtpu C ABI (reference perl-package
+# capability, SURVEY §2.6). The XS layer (MXTPU.xs) marshals to
+# libmxtpu_capi.so; this module adds the object wrapper AI::MXNet-style
+# users expect: load a checkpoint, set named inputs, forward, read outputs.
+
+use strict;
+use warnings;
+use DynaLoader ();
+
+our $VERSION = '0.01';
+our @ISA = ('DynaLoader');
+
+# the build script drops MXTPU.so next to this file (blib-free layout)
+sub dl_load_flags { 0x01 }    # RTLD_GLOBAL for the embedded interpreter
+__PACKAGE__->bootstrap($VERSION);
+
+package AI::MXTPU::Predictor;
+
+sub new {
+    my ($class, %args) = @_;
+    my @names  = @{ $args{input_names} };
+    my @shapes = @{ $args{input_shapes} };
+    my $h = AI::MXTPU::pred_create($args{symbol_json}, $args{params},
+                                   \@names, \@shapes);
+    return bless { h => $h }, $class;
+}
+
+sub set_input {
+    my ($self, $key, @vals) = @_;
+    AI::MXTPU::pred_set_input($self->{h}, $key, pack('f*', @vals));
+}
+
+sub forward {
+    my ($self) = @_;
+    AI::MXTPU::pred_forward($self->{h});
+}
+
+sub output_shape {
+    my ($self, $idx) = @_;
+    return @{ AI::MXTPU::pred_output_shape($self->{h}, $idx // 0) };
+}
+
+sub output {
+    my ($self, $idx) = @_;
+    my @shape = $self->output_shape($idx // 0);
+    my $numel = 1;
+    $numel *= $_ for @shape;
+    return unpack('f*', AI::MXTPU::pred_get_output($self->{h}, $idx // 0,
+                                                   $numel));
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTPU::pred_free($self->{h}) if $self->{h};
+    $self->{h} = undef;
+}
+
+1;

@@ -1,0 +1,158 @@
+/* AI::MXTPU — Perl XS binding over the mxtpu C ABI (libmxtpu_capi.so).
+ *
+ * The reference ships a full Perl binding (perl-package/AI-MXNet) built on
+ * its C API; this is the same capability demonstrated the same way: a THIRD
+ * non-C/C++ language driving the stable C boundary (after the pure-C and
+ * C++-RAII clients), closing SURVEY §2.6's bindings row. Scope matches the
+ * reference's deployment story: load a symbol-JSON + params checkpoint,
+ * feed named float inputs, predict (c_predict_api parity).
+ *
+ * Build (tests/test_perl_binding.py does this on demand):
+ *   xsubpp -typemap .../ExtUtils/typemap MXTPU.xs > MXTPU.c
+ *   gcc -shared -fPIC -I$PERL_CORE MXTPU.c -o auto/AI/MXTPU/MXTPU.so \
+ *       -L<repo>/native -lmxtpu_capi -Wl,-rpath,<repo>/native
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <stdint.h>
+#include <stdlib.h>
+
+typedef void* PredictorHandle;
+extern const char* MXGetLastError(void);
+extern int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                        int param_size, int dev_type, int dev_id,
+                        uint32_t num_input, const char** input_keys,
+                        const uint32_t* input_shape_indptr,
+                        const uint32_t* input_shape_data,
+                        PredictorHandle* out);
+extern int MXPredGetNumOutputs(PredictorHandle h, uint32_t* out);
+extern int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                                uint32_t** shape_data, uint32_t* shape_ndim);
+extern int MXPredSetInput(PredictorHandle h, const char* key,
+                          const float* data, uint32_t size);
+extern int MXPredForward(PredictorHandle h);
+extern int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                           uint32_t size);
+extern int MXPredFree(PredictorHandle h);
+
+MODULE = AI::MXTPU  PACKAGE = AI::MXTPU
+
+PROTOTYPES: DISABLE
+
+const char*
+last_error()
+  CODE:
+    RETVAL = MXGetLastError();
+  OUTPUT:
+    RETVAL
+
+SV*
+pred_create(sym_json, params_sv, names_av, shapes_av)
+    const char* sym_json
+    SV* params_sv
+    AV* names_av
+    AV* shapes_av
+  CODE:
+  {
+    STRLEN plen = 0;
+    const char* pbytes = SvOK(params_sv) ? SvPVbyte(params_sv, plen) : NULL;
+    uint32_t n = (uint32_t)(av_len(names_av) + 1);
+    if ((uint32_t)(av_len(shapes_av) + 1) != n)
+      croak("pred_create: names/shapes length mismatch");
+    const char** keys = (const char**)malloc(n * sizeof(char*));
+    uint32_t* indptr = (uint32_t*)malloc((n + 1) * sizeof(uint32_t));
+    uint32_t total = 0, i;
+    for (i = 0; i < n; ++i) {
+      AV* shp = (AV*)SvRV(*av_fetch(shapes_av, i, 0));
+      total += (uint32_t)(av_len(shp) + 1);
+    }
+    uint32_t* dims = (uint32_t*)malloc(total * sizeof(uint32_t));
+    uint32_t pos = 0;
+    indptr[0] = 0;
+    for (i = 0; i < n; ++i) {
+      keys[i] = SvPV_nolen(*av_fetch(names_av, i, 0));
+      AV* shp = (AV*)SvRV(*av_fetch(shapes_av, i, 0));
+      uint32_t nd = (uint32_t)(av_len(shp) + 1), d;
+      for (d = 0; d < nd; ++d)
+        dims[pos++] = (uint32_t)SvUV(*av_fetch(shp, d, 0));
+      indptr[i + 1] = pos;
+    }
+    PredictorHandle h = NULL;
+    int rc = MXPredCreate(sym_json, pbytes, (int)plen, 1, 0, n, keys, indptr,
+                          dims, &h);
+    free(keys); free(indptr); free(dims);
+    if (rc != 0) croak("MXPredCreate failed: %s", MXGetLastError());
+    RETVAL = newSViv(PTR2IV(h));
+  }
+  OUTPUT:
+    RETVAL
+
+void
+pred_set_input(handle, key, packed_floats)
+    SV* handle
+    const char* key
+    SV* packed_floats
+  CODE:
+  {
+    STRLEN blen = 0;
+    const char* buf = SvPVbyte(packed_floats, blen);
+    if (blen % 4 != 0) croak("pred_set_input: buffer not float32-packed");
+    if (MXPredSetInput(INT2PTR(PredictorHandle, SvIV(handle)), key,
+                       (const float*)buf, (uint32_t)(blen / 4)) != 0)
+      croak("MXPredSetInput failed: %s", MXGetLastError());
+  }
+
+void
+pred_forward(handle)
+    SV* handle
+  CODE:
+    if (MXPredForward(INT2PTR(PredictorHandle, SvIV(handle))) != 0)
+      croak("MXPredForward failed: %s", MXGetLastError());
+
+SV*
+pred_output_shape(handle, index)
+    SV* handle
+    unsigned int index
+  CODE:
+  {
+    uint32_t* shape = NULL;
+    uint32_t ndim = 0;
+    if (MXPredGetOutputShape(INT2PTR(PredictorHandle, SvIV(handle)), index,
+                             &shape, &ndim) != 0)
+      croak("MXPredGetOutputShape failed: %s", MXGetLastError());
+    AV* av = newAV();
+    uint32_t d;
+    for (d = 0; d < ndim; ++d) av_push(av, newSVuv(shape[d]));
+    RETVAL = newRV_noinc((SV*)av);
+  }
+  OUTPUT:
+    RETVAL
+
+SV*
+pred_get_output(handle, index, numel)
+    SV* handle
+    unsigned int index
+    unsigned int numel
+  CODE:
+  {
+    SV* out = newSV(numel * 4);
+    SvPOK_on(out);
+    if (MXPredGetOutput(INT2PTR(PredictorHandle, SvIV(handle)), index,
+                        (float*)SvPVX(out), numel) != 0) {
+      SvREFCNT_dec(out);
+      croak("MXPredGetOutput failed: %s", MXGetLastError());
+    }
+    SvCUR_set(out, numel * 4);
+    RETVAL = out;
+  }
+  OUTPUT:
+    RETVAL
+
+void
+pred_free(handle)
+    SV* handle
+  CODE:
+    MXPredFree(INT2PTR(PredictorHandle, SvIV(handle)));

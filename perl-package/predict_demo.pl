@@ -1,0 +1,42 @@
+#!/usr/bin/perl
+# Pure-Perl client of the mxtpu C ABI through AI::MXTPU (XS).
+# Usage: predict_demo.pl <symbol.json> <file.params> <input_name> <d0,d1,...>
+# Prints one JSON line: {"ok":1,"shape":[...],"checksum":...,"first":...}
+use strict;
+use warnings;
+use AI::MXTPU;
+
+@ARGV == 4 or die "usage: $0 symbol.json file.params input_name d0,d1,...\n";
+my ($sym_path, $params_path, $input_name, $shape_csv) = @ARGV;
+
+local $/;                         # slurp
+open(my $sf, '<', $sym_path) or die "open $sym_path: $!";
+my $sym_json = <$sf>;
+close $sf;
+open(my $pf, '<:raw', $params_path) or die "open $params_path: $!";
+my $params = <$pf>;
+close $pf;
+
+my @shape = split /,/, $shape_csv;
+my $numel = 1;
+$numel *= $_ for @shape;
+
+my $pred = AI::MXTPU::Predictor->new(
+    symbol_json  => $sym_json,
+    params       => $params,
+    input_names  => [$input_name],
+    input_shapes => [\@shape],
+);
+
+# same deterministic ramp as native/capi_demo.c
+my @x = map { 0.01 * ($_ % 100) - 0.5 } 0 .. $numel - 1;
+$pred->set_input($input_name, @x);
+$pred->forward();
+
+my @out_shape = $pred->output_shape(0);
+my @out = $pred->output(0);
+my $checksum = 0;
+$checksum += $_ for @out;
+
+printf "{\"ok\":1,\"shape\":[%s],\"checksum\":%.6f,\"first\":%.6f}\n",
+    join(',', @out_shape), $checksum, $out[0];

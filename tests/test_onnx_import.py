@@ -140,3 +140,97 @@ def test_unsupported_op_raises(tmp_path):
     _export(Odd().eval(), (x,), path)
     with pytest.raises(NotImplementedError, match="no\\s+translation"):
         mxonnx.import_model(path)
+
+
+def _roundtrip(sym_build, params, input_shapes, x_feed, tmp_path, fname):
+    """export_model -> import_model -> eval must match direct Symbol eval."""
+    from mxtpu import nd
+    from mxtpu import symbol as sym_mod
+    s = sym_build(sym_mod)
+    path = str(tmp_path / fname)
+    mxonnx.export_model(s, params, input_shapes, onnx_file=path)
+
+    feeds = {k: nd.array(v) for k, v in x_feed.items()}
+    feeds.update({k: nd.array(np.asarray(v)) for k, v in params.items()})
+    # labels for loss heads
+    for argn in s.list_arguments():
+        if argn not in feeds:
+            feeds[argn] = nd.array(np.zeros(
+                (next(iter(x_feed.values())).shape[0],), np.float32))
+    (want,) = s.eval(**feeds)
+
+    s2, arg2, aux2 = mxonnx.import_model(path)
+    feeds2 = {k: nd.array(v) for k, v in x_feed.items()}
+    feeds2.update(arg2)
+    feeds2.update(aux2)
+    (got,) = s2.eval(**feeds2)
+    np.testing.assert_allclose(got.asnumpy(), want.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_export_mlp_roundtrip(tmp_path):
+    rs = np.random.RandomState(0)
+    params = {"fc1_weight": rs.rand(8, 6).astype(np.float32),
+              "fc1_bias": rs.rand(8).astype(np.float32),
+              "fc2_weight": rs.rand(3, 8).astype(np.float32),
+              "fc2_bias": rs.rand(3).astype(np.float32)}
+
+    def build(sym):
+        d = sym.Variable("data")
+        h = sym.Activation(sym.FullyConnected(d, num_hidden=8, name="fc1"),
+                           act_type="relu")
+        return sym.SoftmaxOutput(
+            sym.FullyConnected(h, num_hidden=3, name="fc2"), name="out")
+
+    _roundtrip(build, params, {"data": (4, 6)},
+               {"data": rs.rand(4, 6).astype(np.float32)}, tmp_path, "mlp.onnx")
+
+
+def test_export_convnet_roundtrip(tmp_path):
+    rs = np.random.RandomState(1)
+    params = {
+        "c1_weight": (rs.rand(8, 3, 3, 3) * 0.2).astype(np.float32),
+        "c1_bias": rs.rand(8).astype(np.float32),
+        "bn_gamma": rs.rand(8).astype(np.float32) + 0.5,
+        "bn_beta": rs.rand(8).astype(np.float32),
+        "bn_moving_mean": rs.rand(8).astype(np.float32),
+        "bn_moving_var": rs.rand(8).astype(np.float32) + 0.5,
+    }
+
+    def build(sym):
+        d = sym.Variable("data")
+        c = sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                            name="c1")
+        b = sym.BatchNorm(c, name="bn", use_global_stats=True,
+                          fix_gamma=False)
+        r = sym.Activation(b, act_type="relu")
+        p = sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max")
+        g = sym.Pooling(p, kernel=(1, 1), global_pool=True, pool_type="avg")
+        return sym.flatten(g)
+
+    _roundtrip(build, params, {"data": (2, 3, 8, 8)},
+               {"data": rs.rand(2, 3, 8, 8).astype(np.float32)}, tmp_path,
+               "conv.onnx")
+
+
+def test_export_bn_fix_gamma_default(tmp_path):
+    """MXNet's fix_gamma=True default computes with gamma=1 — the exporter
+    must emit ones, not the stored gamma (numeric bug caught in review)."""
+    rs = np.random.RandomState(4)
+    params = {
+        "c_weight": (rs.rand(4, 3, 1, 1) * 0.5).astype(np.float32),
+        "c_bias": rs.rand(4).astype(np.float32),
+        "b_gamma": rs.rand(4).astype(np.float32) + 2.0,   # non-unit on purpose
+        "b_beta": rs.rand(4).astype(np.float32),
+        "b_moving_mean": rs.rand(4).astype(np.float32),
+        "b_moving_var": rs.rand(4).astype(np.float32) + 0.5,
+    }
+
+    def build(sym):
+        d = sym.Variable("data")
+        c = sym.Convolution(d, kernel=(1, 1), num_filter=4, name="c")
+        return sym.BatchNorm(c, name="b", use_global_stats=True)  # fix_gamma=True
+
+    _roundtrip(build, params, {"data": (2, 3, 4, 4)},
+               {"data": rs.rand(2, 3, 4, 4).astype(np.float32)}, tmp_path,
+               "bn.onnx")
