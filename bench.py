@@ -479,8 +479,9 @@ def bench_pipeline():
     for tag in ("native", "pil"):
         saved = mxnative.jpeg_decode
         if tag == "pil":
-            # disable ONLY the decode entry point: the RecordIO scan and the
-            # fused normalize stay native in both legs, so the delta is decode
+            # disable the native decode entry point: the RecordIO scan and
+            # the fused normalize stay native in both legs, so the delta is
+            # the decode+assembly path (whole-batch C pass vs per-image PIL)
             mxnative.jpeg_decode = lambda buf: None
         try:
             it = mximage.ImageIter(batch_size=128, data_shape=(3, hw, hw),
@@ -488,6 +489,9 @@ def bench_pipeline():
                                    mean=(123.68, 116.78, 103.94),
                                    std=(58.4, 57.12, 57.38),
                                    preprocess_threads=os.cpu_count() or 8)
+            if tag == "pil":
+                it._nb = None   # the whole-batch C path bypasses jpeg_decode;
+                                # the pil leg must run the per-image pipeline
             with jax.default_device(jax.local_devices(backend="cpu")[0]):
                 next(it)  # warm
                 it.reset()

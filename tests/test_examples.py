@@ -103,3 +103,14 @@ def test_long_context_attention_example():
     out = _run_example("long_context_attention.py",
                        ["--seq-len", "1024"], virtual_devices=8)
     assert "LONG_CONTEXT_OK" in out, out[-1500:]
+
+
+def test_transformer_lm_learns_markov_structure():
+    """Flagship family at toy size: the decoder transformer must learn the
+    planted chain well below the uniform baseline (SURVEY §4 convergence
+    tier; mirrors the word-LM gate)."""
+    from examples.train_transformer_lm import main
+    ppl = main(["--vocab", "60", "--corpus-len", "16000", "--epochs", "3",
+                "--units", "64", "--layers", "2", "--heads", "2",
+                "--seq-len", "32", "--batch-size", "16", "--lr", "3e-3"])
+    assert ppl < 20.0, f"transformer LM did not learn the chain: ppl {ppl}"
