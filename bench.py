@@ -328,10 +328,12 @@ def bench_word_lm(steps: int = 30):
 
 
 def bench_transformer_lm(steps: int = 24, B: int = 32, T: int = 1024,
-                         micro_batches: int = 4, vocab: int = 16384):
-    """Flagship MXU workload: decoder-transformer LM training (model_zoo
-    ``transformer_lm('flagship')``: d1024 L8 H16, ~120M params, Pallas flash
-    attention) through DataParallelTrainer with gradient accumulation.
+                         micro_batches: int = 4, vocab: int = 16384,
+                         preset: str = "flagship"):
+    """Flagship MXU workload: decoder-transformer LM training through
+    DataParallelTrainer with gradient accumulation, over the Pallas flash
+    attention kernel. Presets: 'flagship' (d1024 L8 H16, ~120M params) and
+    'wide' (d2048 L4, whose 2048×8192 FFN matmuls saturate the MXU).
 
     Unlike ResNet-50 (HBM-traffic-bound at 57-72 flop/B — benchmark/
     MFU_ANALYSIS.md), a transformer step is dominated by large matmuls, so
@@ -342,12 +344,13 @@ def bench_transformer_lm(steps: int = 24, B: int = 32, T: int = 1024,
     from mxtpu import nd, optimizer as opt_mod
     from mxtpu.gluon.loss import SoftmaxCrossEntropyLoss
     from mxtpu.gluon.model_zoo import transformer_lm
+    from mxtpu.gluon.model_zoo.transformer import _PRESETS
     from mxtpu.parallel import DataParallelTrainer, shard_batch
     from mxtpu.parallel.mesh import data_parallel_mesh
 
     import mxtpu as mx
     mx.rng.seed(0)
-    net = transformer_lm("flagship", vocab_size=vocab)
+    net = transformer_lm(preset, vocab_size=vocab)
     net.initialize()
     net.cast("bfloat16")
 
@@ -398,7 +401,9 @@ def bench_transformer_lm(steps: int = 24, B: int = 32, T: int = 1024,
             f"transformer_lm learning gate FAILED: loss {loss_start:.3f} -> "
             f"{loss_end:.3f} (memorizing one batch must drive it down)")
 
-    log(f"[transformer_lm] d1024 L8 H16 b{B} T{T} x{micro_batches}: "
+    units, layers, heads, _ = _PRESETS[preset]
+    cfg = f"d{units}_L{layers}_H{heads}_b{B}_T{T}_x{micro_batches}"
+    log(f"[transformer_lm] {cfg}: "
         f"compile {compile_s:.0f}s, {step_ms:.1f} ms/step -> {tok_s:.0f} tok/s")
     log(f"[transformer_lm] flops/step: XLA={xla_flops/1e9:.0f}G "
         f"analytic~{analytic_flops/1e9:.0f}G -> MFU="
@@ -409,7 +414,7 @@ def bench_transformer_lm(steps: int = 24, B: int = 32, T: int = 1024,
     return {"tokens_s": round(tok_s, 1), "step_ms": round(step_ms, 2),
             "mfu": round(mfu, 4) if mfu is not None else None,
             "xla_gflops_per_step": round(xla_flops / 1e9, 1),
-            "config": f"d1024_L8_H16_b{B}_T{T}_x{micro_batches}",
+            "config": cfg,
             "loss_start": round(loss_start, 3), "loss_end": round(loss_end, 3)}
 
 
@@ -751,7 +756,11 @@ def main():
     for cfg in TRAIN_CONFIGS:
         train[cfg[0]] = bench_train(*cfg)
     e2e = bench_train_e2e(train.get("bf16_b128", {}).get("step_ms"))
-    tlm = bench_transformer_lm()
+    tlm = bench_transformer_lm()                       # d1024 L8 (flagship)
+    tlm_wide = bench_transformer_lm(preset="wide")     # d2048 L4: MXU ceiling
+    mfus = [m for m in (tlm["mfu"], tlm_wide["mfu"]) if m is not None]
+    tlm = {"flagship": tlm, "wide": tlm_wide,
+           "best_mfu": max(mfus) if mfus else None}
     lm = bench_word_lm()
     score = bench_inference()
     attn = bench_attention()

@@ -112,6 +112,9 @@ _PRESETS = {
     "small": (512, 6, 8, 1024),         # ~35M params at 16k vocab
     "base": (768, 12, 12, 1024),        # GPT-2 124M-class
     "flagship": (1024, 8, 16, 2048),    # the bench workload: MXU-dominated
+    "wide": (2048, 4, 16, 2048),        # fewer/wider blocks: 2048x8192 FFN
+                                        # matmuls saturate the MXU (64.9% MFU
+                                        # measured on v5e vs 44% at d1024 L8)
 }
 
 
