@@ -105,6 +105,153 @@ class TransformerLM(HybridBlock):
         return nd.reshape(nd.dot(flat, w, transpose_b=True),
                           (B, T, self._vocab))
 
+    # -- autoregressive decoding (TPU-first: one jitted scan, static KV
+    # cache — no per-token dispatch, no dynamic shapes) ---------------------
+    def _gen_params(self):
+        """Raw weight pytree, passed as a jit ARGUMENT so weight updates
+        don't recompile the decode program."""
+        def raw(p):
+            return p.data().data
+        layers = []
+        for blk in self.blocks:
+            at = blk.attn
+            layers.append(dict(
+                ln1_g=raw(blk.ln1.gamma), ln1_b=raw(blk.ln1.beta),
+                qw=raw(at.q_proj.weight), qb=raw(at.q_proj.bias),
+                kw=raw(at.k_proj.weight), kb=raw(at.k_proj.bias),
+                vw=raw(at.v_proj.weight), vb=raw(at.v_proj.bias),
+                ow=raw(at.out_proj.weight), ob=raw(at.out_proj.bias),
+                ln2_g=raw(blk.ln2.gamma), ln2_b=raw(blk.ln2.beta),
+                f1w=raw(blk.ffn1.weight), f1b=raw(blk.ffn1.bias),
+                f2w=raw(blk.ffn2.weight), f2b=raw(blk.ffn2.bias)))
+        out = dict(embed=raw(self.embedding.weight),
+                   pos=raw(self.pos_embed), ln_f_g=raw(self.ln_f.gamma),
+                   ln_f_b=raw(self.ln_f.beta), layers=layers)
+        if not self._tie:
+            out["head_w"] = raw(self.head.weight)
+            out["head_b"] = raw(self.head.bias)
+        return out
+
+    def _build_generate(self, B: int, P: int, TOT: int, greedy: bool):
+        """One compiled decode program for (batch B, prompt bucket P, scan
+        bucket TOT): the TRUE prompt length arrives as a traced scalar, so
+        natural-length prompts share programs per bucket instead of
+        recompiling per length."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        H = self.blocks[0].attn._heads
+        U = self._units
+        D = U // H
+        L = len(self.blocks)
+        scale = 1.0 / math.sqrt(D)
+
+        def ln(x, g, b, eps=1e-5):
+            m = jnp.mean(x, axis=-1, keepdims=True)
+            v = jnp.var(x, axis=-1, keepdims=True)
+            return (x - m) * lax.rsqrt(v + eps) * g + b
+
+        total = TOT
+
+        def step_fn(params, carry, t):
+            caches, tok, key = carry        # caches: (L,2,B,H,TOT,D)
+            x = params["embed"][tok] + params["pos"][t]        # (B, U)
+            new_caches = caches
+            for i, lp in enumerate(params["layers"]):
+                h = ln(x, lp["ln1_g"], lp["ln1_b"])
+                q = (h @ lp["qw"].T + lp["qb"]).reshape(B, H, D)
+                k = (h @ lp["kw"].T + lp["kb"]).reshape(B, H, D)
+                v = (h @ lp["vw"].T + lp["vb"]).reshape(B, H, D)
+                new_caches = lax.dynamic_update_slice(
+                    new_caches,
+                    jnp.stack([k, v])[None, :, :, :, None, :],
+                    (i, 0, 0, 0, t, 0))
+                K = new_caches[i, 0]        # (B, H, total, D)
+                V = new_caches[i, 1]
+                s = jnp.einsum("bhd,bhtd->bht", q, K) * scale
+                mask = jnp.arange(total) <= t
+                s = jnp.where(mask[None, None, :], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                ctx = jnp.einsum("bht,bhtd->bhd", p, V).reshape(B, U)
+                x = x + ctx @ lp["ow"].T + lp["ob"]
+                g = ln(x, lp["ln2_g"], lp["ln2_b"])
+                g = jax.nn.gelu(g @ lp["f1w"].T + lp["f1b"], approximate=False)
+                x = x + g @ lp["f2w"].T + lp["f2b"]
+            h = ln(x, params["ln_f_g"], params["ln_f_b"])
+            if self._tie:
+                logits = h @ params["embed"].T                  # (B, vocab)
+            else:
+                logits = h @ params["head_w"].T + params["head_b"]
+            if greedy:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, logits, axis=-1) \
+                    .astype(jnp.int32)
+            return (new_caches, nxt, key), nxt
+
+        def run(params, prompt, t0, key):
+            caches0 = jnp.zeros((L, 2, B, H, TOT, D),
+                                params["embed"].dtype)
+
+            def body(carry, t):
+                # prompt positions are FORCED; generated positions feed back
+                caches, prev, key = carry
+                tok = jnp.where(t < t0, prompt[:, jnp.minimum(t, P - 1)],
+                                prev)
+                new_carry, nxt = step_fn(params, (caches, tok, key), t)
+                return new_carry, nxt
+
+            init = (caches0, jnp.zeros((B,), jnp.int32), key)
+            _, outs = lax.scan(body, init, jnp.arange(TOT))
+            return outs.T                                       # (B, TOT)
+
+        return jax.jit(run)
+
+    def generate(self, tokens, max_new_tokens: int, greedy: bool = True,
+                 seed: int = 0):
+        """Autoregressive continuation: returns ``(B, T0 + max_new_tokens)``
+        int tokens (prompt + generated). One compiled ``lax.scan`` over a
+        static KV cache — the prompt prefills through the same step program,
+        so decode costs one dispatch total, not one per token."""
+        import jax
+        import jax.numpy as jnp
+
+        from ... import autograd
+        from ...ndarray.ndarray import NDArray
+        raw = tokens.data if isinstance(tokens, NDArray) else jnp.asarray(tokens)
+        B, T0 = raw.shape
+        if T0 < 1:
+            raise ValueError("generate needs a non-empty prompt (give a BOS "
+                             "token for unconditional generation)")
+        if any(p._data is None for p in self.collect_params().values()):
+            with autograd.predict_mode():   # materialize deferred params
+                self(NDArray(raw))
+        total = T0 + int(max_new_tokens)
+        if total > self._max_len:
+            raise ValueError(f"prompt {T0} + {max_new_tokens} new exceeds "
+                             f"max_len {self._max_len}")
+
+        def bucket(n):                      # share programs per 32-bucket
+            return min(self._max_len, -(-n // 32) * 32)
+
+        P, TOT = bucket(T0), bucket(total)
+        key = (B, P, TOT, bool(greedy))
+        cache = getattr(self, "_gen_fns", None)
+        if cache is None:
+            cache = self._gen_fns = {}
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = self._build_generate(B, P, TOT, greedy)
+        padded = jnp.zeros((B, P), jnp.int32).at[:, :T0].set(
+            raw.astype(jnp.int32))
+        outs = fn(self._gen_params(), padded, jnp.int32(T0),
+                  jax.random.key(seed))
+        # outs[t] is the token sampled AFTER position t; stitch prompt + tail
+        gen = outs[:, T0 - 1:total - 1]
+        return NDArray(jnp.concatenate([raw.astype(jnp.int32), gen], axis=1))
+
 
 _PRESETS = {
     # name: (units, layers, heads, max_len)
