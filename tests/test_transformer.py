@@ -183,3 +183,19 @@ def test_generate_untied_head_and_bucket_reuse():
     n_prog = len(net._gen_fns)
     net.generate(nd.array(rs.randint(0, VOCAB, (1, 9)).astype(np.int32)), 4)
     assert len(net._gen_fns) == n_prog
+
+
+def test_quantize_net_composes_with_transformer():
+    """int8 LM serving: quantize_net swaps the projection/FFN Dense layers
+    for int8 twins and the quantized model's next-token choices agree."""
+    from mxtpu.contrib import quantization as q
+    net = _tiny()
+    x = nd.array(np.random.RandomState(8).randint(0, VOCAB, (2, 16)),
+                 dtype="int32")
+    with autograd.predict_mode():
+        want = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[x], calib_mode="naive")
+    with autograd.predict_mode():
+        got = qnet(x).asnumpy()
+    agree = (got.argmax(-1) == want.argmax(-1)).mean()
+    assert agree > 0.9, f"int8 transformer top-1 agreement {agree}"
