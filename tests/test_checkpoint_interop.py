@@ -105,6 +105,17 @@ def test_v2_save_roundtrip_and_bf16_widening(tmp_path):
     assert isinstance(lst, list) and len(lst) == 1
 
 
+
+def test_v2_csr_roundtrip(tmp_path):
+    dense = np.array([[0, 1.5, 0], [2.5, 0, 0], [0, 0, 3.5]], np.float32)
+    csr = mx.nd.sparse.csr_matrix(dense)
+    path = tmp_path / "csr.params"
+    nd.save(str(path), {"m": csr}, fmt="reference")
+    got = nd.load(str(path))["m"]
+    assert got.stype == "csr"
+    np.testing.assert_array_equal(got.todense().asnumpy(), dense)
+
+
 def _ref_mlp_json():
     """A reference-schema MLP graph, as the reference's Symbol.save would emit
     it (all-string attrs, explicit weight/bias null nodes, 3-int input refs,
