@@ -2,38 +2,56 @@
 
 The reference's engine batches consecutive async ops into one engine op to cut
 per-op dispatch overhead (op bulking, threaded_engine.h:404 BulkAppend/
-BulkFlush, env ``MXNET_ENGINE_BULK_SIZE``). On TPU that concern is owned by
-XLA: everything inside a ``jit``/``hybridize`` trace compiles into ONE fused
-program, which is bulking taken to its limit — so these context managers keep
-the reference API shape while documenting where the behavior went. They still
-carry real information: the bulk size is recorded and queryable, and
-``bulk(0)``/``set_bulk_size(0)`` is honored by running eagerly (no-op here,
-since eager dispatch is already per-op).
+BulkFlush, env ``MXNET_ENGINE_BULK_SIZE``, default 15). On TPU that concern
+is owned by XLA — everything inside a jit trace compiles into ONE fused
+program — and the framework-level equivalent of "bulk the whole step" is the
+fused training-step executor (``mxtpu.step_cache.StepExecutor``), which
+``Module.forward_backward`` uses by default.
+
+So unlike earlier revisions, this knob is now a REAL lever:
+
+* ``bulk_size() > 0`` (the default, from ``MXNET_ENGINE_BULK_SIZE`` or 15):
+  training front-ends may compile forward+backward+update into one cached,
+  donated XLA program.
+* ``bulk(0)`` / ``set_bulk_size(0)``: forces the eager per-op dispatch path —
+  the debugging mode where Monitor hooks fire, ``autograd`` records a real
+  tape, and every op is a separate dispatch (exactly the reference's
+  bulking opt-out).
 """
 
 from __future__ import annotations
 
+import os
 from contextlib import contextmanager
 
-__all__ = ["bulk", "set_bulk_size"]
+__all__ = ["bulk", "set_bulk_size", "bulk_size", "DEFAULT_BULK_SIZE"]
 
-_bulk_size = 0
+# reference default: MXNET_ENGINE_BULK_SIZE=15 (docs/faq/env_var.md)
+DEFAULT_BULK_SIZE = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
+
+_bulk_size = DEFAULT_BULK_SIZE
 
 
 def set_bulk_size(size: int) -> int:
     """Set the bulk-execution budget; returns the previous value
-    (engine.py set_bulk_size parity). Informational on TPU: fusion happens at
-    jit boundaries, not dispatch time."""
+    (engine.py set_bulk_size parity). ``0`` disables step fusion — training
+    front-ends fall back to eager per-op dispatch."""
     global _bulk_size
     prev, _bulk_size = _bulk_size, int(size)
     return prev
 
 
+def bulk_size() -> int:
+    """Current bulk budget. ``0`` means eager per-op execution; any positive
+    value lets the step executor fuse whole training steps."""
+    return _bulk_size
+
+
 @contextmanager
 def bulk(size: int):
-    """``with mx.engine.bulk(n):`` scope (engine.py bulk parity). Under XLA the
-    equivalent lever is hybridizing the enclosing block so the scope becomes
-    one compiled program."""
+    """``with mx.engine.bulk(n):`` scope (engine.py bulk parity).
+    ``bulk(0)`` scopes the eager opt-out; any positive size re-enables step
+    fusion inside the scope."""
     prev = set_bulk_size(size)
     try:
         yield

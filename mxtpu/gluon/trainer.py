@@ -8,6 +8,8 @@ from typing import Dict, List, Optional, Union
 from .. import kvstore as kv_mod
 from .. import optimizer as opt_mod
 from ..ndarray.ndarray import NDArray
+from ..step_cache import (build_update_all, cache_stats, donation_supported,
+                          optimizer_fingerprint, unique_buffers)
 from .parameter import Parameter, ParameterDict
 
 
@@ -31,6 +33,10 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._compression_params = compression_params
         self._kv_initialized = False
+        # bulked update: ONE jitted program applying the optimizer to every
+        # parameter (vs one dispatch per param), cached by signature
+        self._bulk_cache: Dict[tuple, object] = {}
+        self._bulk_stats = cache_stats("trainer_update")
 
     # -- kvstore wiring ----------------------------------------------------
     def _init_kvstore(self):
@@ -86,10 +92,90 @@ class Trainer:
             # local kvstore without server updater: push/pull is a no-op reduce for
             # a single logical device — grads already aggregated by XLA collectives.
 
+    # -- bulked update (engine-op-bulking parity for the optimizer pass) ----
+    def _can_bulk_update(self) -> bool:
+        from .. import engine
+        if engine.bulk_size() == 0 or not self._params:
+            return False
+        if self._kvstore is not None and getattr(self, "_update_on_kv", False):
+            return False
+        opt = self._optimizer
+        if getattr(opt, "multi_precision", False):
+            return False
+        for p in self._params:
+            if p._data is None:
+                return False
+            g = p._data._grad
+            if g is None or getattr(g, "stype", "default") != "default":
+                return False    # stale or row-sparse grads: per-param path
+        return True
+
+    def _bulk_update(self):
+        """Apply the optimizer to ALL params in one compiled program — the
+        dispatch-amortized sibling of the reference's op bulking, sharing
+        ``step_cache.build_update_all`` with the fused training step."""
+        import jax.numpy as jnp
+
+        opt = self._optimizer
+        params = self._params
+        donate = donation_supported()
+        for i, p in enumerate(params):
+            if self._states[i] is None:
+                st = opt.create_state_multi_precision(i, p.data())
+                self._states[i] = unique_buffers(st) if donate else tuple(st)
+
+        def asig(v):
+            return (tuple(v.shape), str(v.dtype),
+                    getattr(v, "sharding", None))
+
+        sig = (tuple(asig(p._data._data) for p in params),
+               tuple(asig(p._data._grad._data) for p in params),
+               tuple(tuple(asig(s) for s in (st or ()))
+                     for st in self._states),
+               optimizer_fingerprint(opt))
+        entry = self._bulk_cache.get(sig)
+        if entry is None:
+            self._bulk_stats.miss()
+            import jax
+            update_all = build_update_all(
+                opt,
+                [getattr(p, "lr_mult", 1.0) * opt.lr_mult.get(i, 1.0)
+                 for i, p in enumerate(params)],
+                [getattr(p, "wd_mult", 1.0) * opt.wd_mult.get(i, 1.0)
+                 for i, p in enumerate(params)])
+            entry = self._bulk_cache[sig] = jax.jit(
+                update_all, donate_argnums=(0, 2) if donate else ())
+        else:
+            self._bulk_stats.hit()
+
+        t = max([opt._index_update_count.get(i, 0)
+                 for i in range(len(params))] or [0]) + 1
+        # eager parity: _update_count runs before _get_lr, so the scheduler
+        # sees the post-increment num_update
+        lr = jnp.float32(opt.lr_scheduler(max(opt.num_update, t))
+                         if opt.lr_scheduler else opt.lr)
+        wd = jnp.float32(opt.wd)
+        rescale = jnp.float32(opt.rescale_grad)
+        clip = jnp.float32(opt.clip_gradient
+                           if opt.clip_gradient is not None else 0.0)
+        new_params, new_states = entry(
+            [p._data._data for p in params],
+            [p._data._grad._data for p in params],
+            list(self._states), lr, wd, rescale, clip, t)
+        for p, w in zip(params, new_params):
+            p._data._set_data(w)
+        self._states = list(new_states)
+        for i in range(len(params)):
+            opt._index_update_count[i] = t
+        opt.num_update = max(opt.num_update, t)
+
     def update(self, batch_size: int, ignore_stale_grad: bool = False,
                _skip_allreduce: bool = False):
         self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._can_bulk_update():
+            self._bulk_update()
+            return
         for i, p in enumerate(self._params):
             if p._data is None:
                 continue

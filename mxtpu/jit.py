@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from . import autograd, rng
 from .ndarray.ndarray import NDArray
+from .step_cache import cache_stats
 
 __all__ = ["CachedOp", "jit", "grad", "value_and_grad", "export_stablehlo"]
 
@@ -47,6 +48,7 @@ class CachedOp:
         self.static_alloc = static_alloc  # API parity; XLA always plans statically
         self.static_shape = static_shape
         self._cache: Dict[tuple, dict] = {}
+        self._stats = cache_stats("cached_op")
 
     # -- signature ---------------------------------------------------------
     @staticmethod
@@ -122,10 +124,12 @@ class CachedOp:
         entry = self._cache.get(sig)
         first = None
         if entry is None:
+            self._stats.miss()
             entry = self._build(sig, args)
             raw_outs, mutated, key = entry.pop("first")
             first = True
         else:
+            self._stats.hit()
             key = rng.next_key()
             raw_outs, mutated = entry["jitted"](
                 [p.data for p in self.params], [a.data for a in args], key)

@@ -246,6 +246,11 @@ class Module(BaseModule):
         self._outputs: List[NDArray] = []
         self._loss_val: Optional[NDArray] = None
         self._batch_size = 0
+        # fused-step state (step_cache.StepExecutor): forward_backward+update
+        # collapse into one compiled program when the step is fusable
+        self._step_exec = None
+        self._fused_pending = False
+        self._fuse_broken = False
 
     @property
     def symbol(self):
@@ -373,6 +378,74 @@ class Module(BaseModule):
             self._loss_val = None
             self._exposed = None  # never serve a stale train-time exposure
 
+    # -- fused step (forward+backward+update as ONE compiled program) -------
+    def _hooks_installed(self, block) -> bool:
+        if block._forward_hooks or block._forward_pre_hooks:
+            return True
+        return any(self._hooks_installed(c) for c in block._children.values())
+
+    def _step_fusable(self, data_batch) -> bool:
+        """The whole-step compile covers the monitor-less, locally-updated
+        common case; anything needing per-op visibility or special gradient
+        plumbing takes the eager path (reference analogue: ops with monitors
+        or cross-device reduction are never bulked)."""
+        from . import engine
+        if engine.bulk_size() == 0 or self._fuse_broken or self._symbolic:
+            return False
+        if self._trainer is None or not self.optimizer_initialized:
+            return False
+        if getattr(self, "_inputs_need_grad", False):
+            return False
+        if not data_batch.label:
+            return False
+        if self._hooks_installed(self._block):
+            return False     # Monitor / user hooks need eager per-op outputs
+        tr = self._trainer
+        try:
+            tr._init_kvstore()
+        except Exception:
+            return False
+        if tr._kvstore is not None and getattr(tr, "_update_on_kv", False):
+            return False     # server-side updates can't fuse into the step
+        opt = tr._optimizer
+        if getattr(opt, "multi_precision", False):
+            return False
+        if any(p.grad_req != "write" or p._data is None for p in tr._params):
+            return False     # grad_req='add' accumulation stays eager
+        return True
+
+    def forward_backward(self, data_batch: DataBatch):
+        if self._step_fusable(data_batch):
+            try:
+                self._fused_step(data_batch)
+                return
+            except Exception:
+                # trace/compile failure (unsupported optimizer kernel, exotic
+                # block): permanently fall back to the eager path — behavior
+                # is preserved, only the fusion speedup is lost
+                self._fuse_broken = True
+                self.logger.warning(
+                    "Module: fused-step compile failed; falling back to "
+                    "eager forward/backward/update", exc_info=True)
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def _fused_step(self, data_batch: DataBatch):
+        if self._step_exec is None:
+            from .step_cache import StepExecutor
+            self._step_exec = StepExecutor(self._block, self._loss,
+                                           self._trainer)
+        data = [d if isinstance(d, NDArray) else nd.array(d)
+                for d in data_batch.data]
+        label = data_batch.label[0]
+        label = label if isinstance(label, NDArray) else nd.array(label)
+        self._batch_size = data[0].shape[0]
+        res = self._step_exec.step(data, label, batch_size=self._batch_size)
+        self._outputs = res["outputs_list"]
+        self._exposed = res["exposed"]
+        self._loss_val = res["loss"]
+        self._fused_pending = True
+
     def backward(self, out_grads=None):
         if self._symbolic:
             autograd.backward(list(self._outputs),
@@ -388,6 +461,11 @@ class Module(BaseModule):
 
     def update(self):
         assert self._trainer is not None, "init_optimizer first"
+        if self._fused_pending:
+            # the fused step already applied the optimizer inside the same
+            # compiled program; update() just completes the protocol
+            self._fused_pending = False
+            return
         self._trainer.step(self._batch_size)
 
     def get_outputs(self, merge_multi_context=True) -> List[NDArray]:
@@ -496,6 +574,16 @@ class BucketingModule(BaseModule):
         self._curr = self._get_module(key, data_batch.provide_data,
                                       data_batch.provide_label)
         self._curr.forward(data_batch, is_train)
+
+    def forward_backward(self, data_batch: DataBatch):
+        # delegate to the bucket's Module so each bucket shape gets the fused
+        # whole-step compile (one step-cache entry per bucket — the
+        # shared-executor story at step granularity)
+        key = data_batch.bucket_key if data_batch.bucket_key is not None \
+            else self._default_key
+        self._curr = self._get_module(key, data_batch.provide_data,
+                                      data_batch.provide_label)
+        self._curr.forward_backward(data_batch)
 
     def backward(self, out_grads=None):
         self._curr.backward(out_grads)

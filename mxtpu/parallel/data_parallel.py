@@ -19,6 +19,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import autograd
 from .. import ndarray as nd_mod
 from ..ndarray.ndarray import NDArray
+from ..step_cache import build_update_all, cache_stats
 from .mesh import Mesh, get_default_mesh
 
 __all__ = ["shard_batch", "replicate", "DataParallelTrainer"]
@@ -104,6 +105,7 @@ class DataParallelTrainer:
         self._step_fn = None
         self._params: List = []
         self._states: List = []
+        self._stats = cache_stats("data_parallel_step")
 
     def _spec_for(self, name) -> P:
         if self.param_shardings is None:
@@ -151,8 +153,16 @@ class DataParallelTrainer:
         param_handles = self._param_handles
         aux_handles = self._aux_handles
         from .. import rng as rng_mod
+        # the per-param optimizer application is the SAME inlined
+        # preprocess+kernel composition the fused Module step uses
+        # (step_cache.build_update_all) — one shared code path for every
+        # whole-step compile in the framework
+        update_all = build_update_all(
+            opt,
+            [getattr(p, "lr_mult", 1.0) for p in param_handles],
+            [getattr(p, "wd_mult", 1.0) for p in param_handles])
 
-        def step(params, auxs, states, x, y, lr, key, t):
+        def step(params, auxs, states, x, y, lr, wd, rescale, clip, key, t):
             provider = rng_mod.push_trace_provider(key)
             saved = [p._data._data for p in param_handles]
             saved_aux = [p._data._data for p in aux_handles]
@@ -212,17 +222,9 @@ class DataParallelTrainer:
 
                     (loss_val, new_auxs), grads = jax.value_and_grad(
                         loss_of, has_aux=True)(list(params))
-                new_params, new_states = [], []
-                for i, (p, g, st) in enumerate(zip(params, grads, states)):
-                    g = g.astype(p.dtype)
-                    out = opt._kernel(p, g, lr.astype(p.dtype), jnp.asarray(
-                        opt.wd, p.dtype), t, *st)
-                    if isinstance(out, tuple):
-                        new_params.append(out[0])
-                        new_states.append(tuple(out[1:]))
-                    else:
-                        new_params.append(out)
-                        new_states.append(())
+                new_params, new_states = update_all(
+                    list(params), list(grads), list(states),
+                    lr, wd, rescale, clip, t)
                 return new_params, new_auxs, new_states, loss_val
             finally:
                 for p, v in zip(param_handles, saved):
@@ -239,7 +241,7 @@ class DataParallelTrainer:
         self._step_fn = jax.jit(
             step,
             in_shardings=(self._param_sh, repl, self._state_sh, batch, batch,
-                          repl, repl, None),
+                          repl, repl, repl, repl, repl, None),
             out_shardings=(self._param_sh, repl, self._state_sh, repl))
 
     def step_async(self, x, y) -> NDArray:
@@ -250,9 +252,12 @@ class DataParallelTrainer:
         x = x if isinstance(x, NDArray) else nd_mod.array(x)
         y = y if isinstance(y, NDArray) else nd_mod.array(y)
         if self._step_fn is None:
+            self._stats.miss()
             self._collect(x)
             self._build()
             self._t = 0
+        else:
+            self._stats.hit()
         if self.micro_batches > 1 and x.shape[0] % self.micro_batches:
             raise ValueError(
                 f"batch size {x.shape[0]} is not divisible by "
@@ -261,11 +266,19 @@ class DataParallelTrainer:
         xs = shard_batch(x, self.mesh).data
         ys = shard_batch(y, self.mesh).data
         self._t += 1
-        lr = jnp.asarray(self.optimizer.learning_rate, jnp.float32)
+        opt = self.optimizer
+        lr = jnp.asarray(opt.learning_rate, jnp.float32)
+        wd = jnp.asarray(opt.wd, jnp.float32)
+        # grads are mean-loss grads already; rescale stays 1 (clip honors the
+        # optimizer's clip_gradient, a static variant inside update_all)
+        rescale = jnp.float32(1.0)
+        clip = jnp.float32(opt.clip_gradient
+                           if opt.clip_gradient is not None else 0.0)
         key = jax.random.key(self._t)
         params = [p.data().data for p in self._param_handles]
         auxs = [p.data().data for p in self._aux_handles]
-        args = (params, auxs, self._states, xs, ys, lr, key, self._t)
+        args = (params, auxs, self._states, xs, ys, lr, wd, rescale, clip,
+                key, self._t)
         # keep only avals (shape/dtype) for cost_analysis — holding the real
         # arrays would pin the previous step's buffers in HBM
         self._last_avals = jax.tree.map(

@@ -109,10 +109,45 @@ def dumps(reset: bool = False) -> str:
     if _state["config"].get("aggregate_stats"):
         out = get_summary()
     else:
-        out = json.dumps({"traceEvents": _state["events"]})
+        out = json.dumps({"traceEvents": _state["events"],
+                          "compileCaches": get_compile_stats()})
     if reset:
         _state["events"] = []
     return out
+
+
+# ---------------------------------------------------------------------------
+# compile-cache observability (step_cache registry)
+# ---------------------------------------------------------------------------
+
+
+def get_compile_stats() -> dict:
+    """Per-cache {hits, traces, retraces} for every signature cache in the
+    framework (fused training step, CachedOp/hybridize, symbol Executor
+    backward, DataParallelTrainer step). The TPU-native analogue of the
+    reference's engine-bulk forensics: a fixed-shape training loop should
+    show exactly one trace and N-1 hits — anything else is a retrace leak."""
+    from .step_cache import snapshot
+    return snapshot()
+
+
+def reset_compile_stats(name: Optional[str] = None):
+    """Zero one named cache's counters (or all). Tests and epoch-boundary
+    accounting use this; the caches themselves are untouched."""
+    from .step_cache import reset_stats
+    reset_stats(name)
+
+
+def compile_cache_summary() -> str:
+    """Human-readable compile-cache table (pairs with get_summary())."""
+    stats = get_compile_stats()
+    lines = [f"{'Cache':<24s}{'Hits':>10s}{'Traces':>10s}{'Retraces':>10s}"]
+    lines.append("-" * len(lines[0]))
+    for name in sorted(stats):
+        s = stats[name]
+        lines.append(f"{name:<24s}{s['hits']:>10d}{s['traces']:>10d}"
+                     f"{s['retraces']:>10d}")
+    return "\n".join(lines)
 
 
 class Domain:

@@ -1,0 +1,377 @@
+"""Fused training-step executor + framework-wide compile-cache registry.
+
+The reference's headline perf design is the dependency engine bulking many
+small pushed ops into few engine ops (``MXNET_ENGINE_BULK_SIZE``,
+threaded_engine.h:404) plus CachedOp whole-graph execution. The TPU-native
+equivalent of "bulk size = everything" is compiling the ENTIRE training step —
+forward, loss, backward, gradient scaling, and optimizer update — into one
+XLA program with donated parameter/optimizer-state buffers. That is what
+:class:`StepExecutor` does; ``mxtpu.module.Module`` routes
+``forward_backward``/``update`` through it whenever the step is fusable, and
+``engine.bulk(0)`` / ``engine.set_bulk_size(0)`` is the documented opt-out
+that forces the eager per-op path (debugging, Monitor spying).
+
+This module also owns the framework-wide **compile-cache registry**: every
+signature cache (CachedOp / StepExecutor / symbol Executor backward /
+DataParallelTrainer) registers its hits and traces here, exposed through
+``mxtpu.profiler.get_compile_stats()`` — the observability story for "did my
+loop retrace?" (the reference's equivalent forensic is engine bulk logging).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CacheStats", "cache_stats", "snapshot", "reset_stats",
+           "StepExecutor", "build_update_all", "optimizer_fingerprint"]
+
+
+# ---------------------------------------------------------------------------
+# compile-cache registry
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_registry: "Dict[str, CacheStats]" = {}
+
+
+class CacheStats:
+    """Hit/trace counters for one named signature cache.
+
+    ``misses`` counts traces (every compile of a new signature); ``retraces``
+    is the number of compiles beyond the first — the "my fixed-shape loop
+    recompiled" red flag tests and CI guards key off.
+    """
+
+    __slots__ = ("name", "hits", "misses")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.hits = 0
+        self.misses = 0
+
+    def hit(self):
+        self.hits += 1
+
+    def miss(self):
+        self.misses += 1
+
+    @property
+    def traces(self) -> int:
+        return self.misses
+
+    @property
+    def retraces(self) -> int:
+        return max(0, self.misses - 1)
+
+    def as_dict(self) -> dict:
+        return {"hits": self.hits, "traces": self.misses,
+                "retraces": self.retraces}
+
+
+def cache_stats(name: str) -> CacheStats:
+    """Get-or-create the stats entry for a named cache."""
+    with _lock:
+        st = _registry.get(name)
+        if st is None:
+            st = _registry[name] = CacheStats(name)
+        return st
+
+
+def snapshot() -> Dict[str, dict]:
+    """All registered caches → {hits, traces, retraces}."""
+    with _lock:
+        return {name: st.as_dict() for name, st in _registry.items()}
+
+
+def reset_stats(name: Optional[str] = None):
+    """Zero one cache's counters, or all of them (tests, epoch boundaries)."""
+    with _lock:
+        targets = [_registry[name]] if name in _registry else (
+            [] if name is not None else list(_registry.values()))
+        for st in targets:
+            st.hits = 0
+            st.misses = 0
+
+
+# ---------------------------------------------------------------------------
+# shared in-trace optimizer application
+# ---------------------------------------------------------------------------
+
+
+def optimizer_fingerprint(opt) -> tuple:
+    """Static hyperparameter identity of an optimizer instance.
+
+    Part of every fused-step cache key: scalar hyperparams (momentum, betas,
+    eps, …) are baked into the trace by ``_kernel``, so changing one must
+    retrace. Dynamic per-step values (lr, wd, rescale_grad, update counts)
+    are traced arguments and deliberately excluded.
+    """
+    dynamic = {"lr", "wd", "rescale_grad", "num_update"}
+    items = tuple(sorted(
+        (k, v) for k, v in vars(opt).items()
+        if isinstance(v, (int, float, bool, str)) and k not in dynamic))
+    return (type(opt).__name__, opt.clip_gradient is not None, items)
+
+
+def build_update_all(opt, lr_mults: Sequence[float], wd_mults: Sequence[float]):
+    """One traceable function applying ``opt`` to every parameter.
+
+    Exactly the ``_preprocess_grad`` + ``_kernel`` composition the eager
+    ``Optimizer.update`` path jits per parameter (and that the
+    ``mx.nd.*_update`` fused ops in ``ndarray/fused_optimizer.py`` wrap) —
+    inlined so the whole multi-parameter update fuses into the enclosing
+    step program. Shared by :class:`StepExecutor` and
+    ``parallel.data_parallel.DataParallelTrainer``.
+
+    Returns ``update_all(params, grads, states, lr, wd, rescale, clip, t)``
+    → ``(new_params, new_states)``. ``clip`` is ignored unless the optimizer
+    has ``clip_gradient`` set (a static variant, like ``_get_jitted``).
+    """
+    clipped = opt.clip_gradient is not None
+
+    def update_all(params, grads, states, lr, wd, rescale, clip, t):
+        new_params: List[Any] = []
+        new_states: List[Tuple] = []
+        for i, (w, g, st) in enumerate(zip(params, grads, states)):
+            dt = w.dtype
+            gg = opt._preprocess_grad(g.astype(dt), rescale.astype(dt),
+                                      clip.astype(dt) if clipped else None)
+            out = opt._kernel(w, gg, lr.astype(dt) * lr_mults[i],
+                              wd.astype(dt) * wd_mults[i], t, *st)
+            if isinstance(out, tuple):
+                new_params.append(out[0])
+                new_states.append(tuple(out[1:]))
+            else:
+                new_params.append(out)
+                new_states.append(())
+        return new_params, new_states
+
+    return update_all
+
+
+def _sharding_of(raw):
+    # sharding participates in the executable's contract (same rationale as
+    # CachedOp._shard_key): re-placed arrays must retrace
+    return getattr(raw, "sharding", None)
+
+
+def _arr_sig(raw) -> tuple:
+    return (tuple(raw.shape), str(raw.dtype), _sharding_of(raw))
+
+
+def donation_supported() -> bool:
+    """Buffer donation is a real transfer-of-ownership only on accelerator
+    backends; on cpu XLA ignores it with a warning, so we skip it there."""
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def unique_buffers(state: Tuple) -> Tuple:
+    """Deep-copy optimizer-state arrays so no two donated leaves alias one
+    buffer (freshly created zeros states can share a constant; XLA rejects
+    donating the same buffer twice)."""
+    return tuple(jnp.array(s, copy=True) if hasattr(s, "dtype") else s
+                 for s in state)
+
+
+# ---------------------------------------------------------------------------
+# StepExecutor
+# ---------------------------------------------------------------------------
+
+
+class StepExecutor:
+    """Compile forward+loss+backward+optimizer-update into ONE cached program.
+
+    Wraps a Gluon-style ``block``, a ``loss_fn`` (callable on
+    ``(outputs[0], label)`` returning per-sample losses), and a
+    ``gluon.Trainer`` whose optimizer/state it drives. Each ``step()``:
+
+    * looks up the signature (input/param/state shapes+dtypes+shardings,
+      grad_req layout, optimizer hyperparam fingerprint) in the cache;
+    * on miss, traces the whole step once (``jax.jit`` with
+      ``donate_argnums`` on parameters and optimizer state when the backend
+      supports donation) and records a trace in the ``module_step`` registry
+      entry;
+    * runs the compiled program and writes back parameters, aux (BatchNorm
+      moving stats), optimizer state, and parameter gradients — so eager
+      introspection (``param.grad()``) and eager/fused interleaving stay
+      coherent.
+
+    The gradient written back is the UNSCALED sum-gradient (eager-backward
+    parity); rescaling by 1/batch_size happens inside the traced update,
+    exactly where ``Trainer.step`` applies ``rescale_grad``.
+    """
+
+    def __init__(self, block, loss_fn, trainer, cache_name: str = "module_step"):
+        self.block = block
+        self.loss_fn = loss_fn
+        self.trainer = trainer
+        self._cache: Dict[tuple, dict] = {}
+        self._stats = cache_stats(cache_name)
+        self._param_handles = list(trainer._params)
+        self._aux_handles = [p for p in trainer._all_params
+                             if p.grad_req == "null" and p._data is not None]
+
+    # -- signature ---------------------------------------------------------
+    def _ensure_states(self):
+        tr = self.trainer
+        opt = tr._optimizer
+        donate = donation_supported()
+        for i, p in enumerate(self._param_handles):
+            if tr._states[i] is None:
+                st = opt.create_state_multi_precision(i, p.data())
+                tr._states[i] = unique_buffers(st) if donate else tuple(st)
+
+    def _sig(self, data, label) -> tuple:
+        tr = self.trainer
+        return (
+            tuple(_arr_sig(d.data) for d in data),
+            _arr_sig(label.data) if label is not None else None,
+            tuple(_arr_sig(p._data._data) for p in self._param_handles),
+            tuple(_arr_sig(p._data._data) for p in self._aux_handles),
+            tuple(tuple(_arr_sig(s) for s in (st or ()))
+                  for st in tr._states),
+            tuple(p.grad_req for p in self._param_handles),
+            optimizer_fingerprint(tr._optimizer),
+        )
+
+    # -- tracing -----------------------------------------------------------
+    def _build(self) -> dict:
+        from . import autograd, rng
+        from .ndarray.ndarray import NDArray
+        from .gluon.loss import SoftmaxCrossEntropyLoss
+
+        block, loss_fn = self.block, self.loss_fn
+        opt = self.trainer._optimizer
+        param_handles = self._param_handles
+        aux_handles = self._aux_handles
+        # static per-param multipliers (the _get_lr/_get_wd composition)
+        lr_mults = [getattr(p, "lr_mult", 1.0) * opt.lr_mult.get(i, 1.0)
+                    for i, p in enumerate(param_handles)]
+        wd_mults = [getattr(p, "wd_mult", 1.0) * opt.wd_mult.get(i, 1.0)
+                    for i, p in enumerate(param_handles)]
+        update_all = build_update_all(opt, lr_mults, wd_mults)
+        softmax_expose = isinstance(loss_fn, SoftmaxCrossEntropyLoss)
+        struct: dict = {}
+
+        def pure(param_raws, aux_raws, state_raws, data_raws, label_raw,
+                 lr, wd, rescale, clip, t, key):
+            provider = rng.push_trace_provider(key)
+            saved_p = [p._data._data for p in param_handles]
+            saved_a = [p._data._data for p in aux_handles]
+            try:
+                def loss_on(ps):
+                    for p, r in zip(param_handles, ps):
+                        p._data._data = r
+                        p._data._version += 1
+                    for p, r in zip(aux_handles, aux_raws):
+                        p._data._data = r
+                        p._data._version += 1
+                    with autograd.pause(train_mode=True):
+                        out = block(*[NDArray(d) for d in data_raws])
+                        single = not isinstance(out, (tuple, list))
+                        outs = [out] if single else list(out)
+                        loss = loss_fn(outs[0], NDArray(label_raw))
+                    struct["single"] = single
+                    new_aux = [p._data._data for p in aux_handles]
+                    # sum-of-loss head: eager backward seeds ones on the
+                    # per-sample loss vector, which IS d(sum)/d(.)
+                    return (jnp.sum(loss.data.astype(jnp.float32)),
+                            (new_aux, [o.data for o in outs], loss.data))
+
+                (_, (new_aux, raw_outs, loss_arr)), grads = \
+                    jax.value_and_grad(loss_on, has_aux=True)(list(param_raws))
+                new_params, new_states = update_all(
+                    param_raws, grads, state_raws, lr, wd, rescale, clip, t)
+                exposed0 = (jax.nn.softmax(raw_outs[0], axis=-1)
+                            if softmax_expose else None)
+                return (new_params, new_aux, new_states, list(grads),
+                        loss_arr, raw_outs, exposed0)
+            finally:
+                for p, r in zip(param_handles, saved_p):
+                    p._data._data = r
+                    p._data._version += 1
+                for p, r in zip(aux_handles, saved_a):
+                    p._data._data = r
+                    p._data._version += 1
+                rng.pop_trace_provider()
+
+        donate = (0, 2) if donation_supported() else ()
+        jitted = jax.jit(pure, donate_argnums=donate)
+        return {"jitted": jitted, "struct": struct}
+
+    # -- the step ----------------------------------------------------------
+    def step(self, data: Sequence, label, batch_size: Optional[int] = None):
+        """Run one fused train step. Returns a dict with detached
+        ``loss`` (per-sample array), ``outputs``, and ``exposed`` (softmaxed
+        outputs when the loss is classification, else None)."""
+        from . import rng
+        from .ndarray.ndarray import NDArray
+
+        tr = self.trainer
+        tr._init_kvstore()
+        opt = tr._optimizer
+        self._ensure_states()
+        batch_size = batch_size if batch_size is not None else data[0].shape[0]
+
+        sig = self._sig(data, label)
+        entry = self._cache.get(sig)
+        if entry is None:
+            self._stats.miss()
+            entry = self._cache[sig] = self._build()
+        else:
+            self._stats.hit()
+
+        t = max([opt._index_update_count.get(i, 0)
+                 for i in range(len(self._param_handles))] or [0]) + 1
+        # eager parity: _update_count precedes _get_lr, so the scheduler sees
+        # the post-increment num_update
+        lr = jnp.float32(opt.lr_scheduler(max(opt.num_update, t))
+                         if opt.lr_scheduler else opt.lr)
+        wd = jnp.float32(opt.wd)
+        rescale = jnp.float32(tr._scale / batch_size)
+        clip = jnp.float32(opt.clip_gradient
+                           if opt.clip_gradient is not None else 0.0)
+        key = rng.next_key()
+
+        out = entry["jitted"](
+            [p._data._data for p in self._param_handles],
+            [p._data._data for p in self._aux_handles],
+            list(tr._states),
+            [d.data for d in data],
+            label.data if label is not None else None,
+            lr, wd, rescale, clip, t, key)
+        new_params, new_aux, new_states, grads, loss_arr, raw_outs, exposed0 = out
+
+        # write-back: params/aux/state swap + eager-visible gradients
+        for p, v in zip(self._param_handles, new_params):
+            p._data._set_data(v)
+        for p, v in zip(self._aux_handles, new_aux):
+            p._data._set_data(v)
+        tr._states = list(new_states)
+        for p, g in zip(self._param_handles, grads):
+            h = p._data
+            if h._grad is not None and getattr(h._grad, "stype",
+                                               "default") == "default":
+                h._grad._set_data(g)
+            else:
+                h._grad = NDArray(g)
+        for i in range(len(self._param_handles)):
+            opt._index_update_count[i] = t
+        opt.num_update = max(opt.num_update, t)
+
+        outputs = [NDArray(r) for r in raw_outs]
+        return {
+            "loss": NDArray(loss_arr),
+            "outputs": outputs[0] if entry["struct"].get("single", True)
+            and len(outputs) == 1 else outputs,
+            "outputs_list": outputs,
+            "exposed": ([NDArray(exposed0)] + outputs[1:]
+                        if exposed0 is not None else None),
+        }

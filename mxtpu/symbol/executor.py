@@ -15,12 +15,33 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import autograd
 from ..ndarray.ndarray import NDArray
+from ..step_cache import cache_stats
 from .symbol import Symbol, eval_graph, _req_of
 
 __all__ = ["Executor"]
+
+
+def _split_resolved(resolved: dict):
+    """Partition per-node resolved attrs into static values (flags, floats)
+    and array leaves (RNG keys): arrays become traced inputs of the memoized
+    backward so a fresh forward's keys replay without retracing."""
+    static: Dict[int, dict] = {}
+    arr_spec: List[tuple] = []
+    arr_vals: List = []
+    for nid, attrs in resolved.items():
+        stat = {}
+        for k, v in attrs.items():
+            if isinstance(v, (jax.Array, np.ndarray)):
+                arr_spec.append((nid, k))
+                arr_vals.append(v)
+            else:
+                stat[k] = v
+        static[nid] = stat
+    return static, arr_spec, arr_vals
 
 
 class Executor:
@@ -42,6 +63,10 @@ class Executor:
         self.outputs: List[NDArray] = []
         self._is_train = False
         self._resolved: Optional[dict] = None
+        # memoized backward programs per (live/fixed/resolved signature):
+        # repeated forward/backward on fixed shapes traces jax.vjp ONCE
+        self._bwd_cache: Dict[tuple, "jax.stages.Wrapped"] = {}
+        self._bwd_stats = cache_stats("symbol_backward")
 
     @property
     def arg_arrays(self) -> List[NDArray]:
@@ -81,35 +106,83 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None):
-        """One jax.vjp over the whole bound graph, accumulated per grad_req."""
+        """One jax.vjp over the whole bound graph, accumulated per grad_req.
+
+        The vjp is wrapped in ``jax.jit`` and memoized per (live-arg
+        signature, fixed-arg signature, is_train, resolved-attr structure,
+        cotangent signature): repeated forward/backward on fixed shapes
+        traces ONCE instead of re-running the whole-graph trace every call.
+        Per-forward RNG keys (dropout masks) enter as traced inputs, so the
+        compiled backward still replays each forward's exact program.
+        """
         live = [n for n in self._arg_names if self._grad_req[n] != "null"]
         if not live:
             return
         if self._resolved is None:
             raise RuntimeError("backward before forward")
-        fixed = {n: self.arg_dict[n].data for n in self._arg_names
-                 if n not in live}
-        fixed.update({n: a.data for n, a in self.aux_dict.items()})
-        heads, is_train, resolved = (self._symbol._heads, self._is_train,
-                                     self._resolved)
+        fixed_names = [n for n in self._arg_names if n not in live] \
+            + list(self.aux_dict.keys())
+        fixed_vals = [self.arg_dict[n].data for n in self._arg_names
+                      if n not in live] \
+            + [a.data for a in self.aux_dict.values()]
+        live_vals = [self.arg_dict[n].data for n in live]
+        res_static, arr_spec, arr_vals = _split_resolved(self._resolved)
+        if out_grads is None:
+            cot_vals = None
+        else:
+            og = out_grads if isinstance(out_grads, (list, tuple)) \
+                else [out_grads]
+            cot_vals = [jnp.asarray(g.data if isinstance(g, NDArray) else g)
+                        for g in og]
 
-        def pure(vals):
-            feed = dict(fixed)
-            feed.update(zip(live, vals))
-            return tuple(eval_graph(heads, feed, is_train, resolved=resolved))
+        def asig(v):
+            return (tuple(v.shape), str(v.dtype))
 
-        with autograd.pause(train_mode=is_train):
-            outs, vjp_fn = jax.vjp(pure, [self.arg_dict[n].data for n in live])
-            if out_grads is None:
-                cots = tuple(jnp.ones_like(o) for o in outs)
-            else:
-                og = out_grads if isinstance(out_grads, (list, tuple)) \
-                    else [out_grads]
-                cots = tuple(
-                    jnp.asarray(g.data if isinstance(g, NDArray) else g,
-                                dtype=o.dtype)
-                    for g, o in zip(og, outs))
-            (grads,) = vjp_fn(cots)
+        sig = (tuple(live), tuple(asig(v) for v in live_vals),
+               tuple(fixed_names), tuple(asig(v) for v in fixed_vals),
+               self._is_train,
+               tuple((nid, k) + asig(v)
+                     for (nid, k), v in zip(arr_spec, arr_vals)),
+               tuple((nid, tuple(sorted((k, repr(v)) for k, v in st.items())))
+                     for nid, st in sorted(res_static.items())),
+               None if cot_vals is None
+               else tuple(asig(v) for v in cot_vals))
+        fn = self._bwd_cache.get(sig)
+        if fn is None:
+            self._bwd_stats.miss()
+            heads, is_train = self._symbol._heads, self._is_train
+            spec = list(arr_spec)
+            static = {nid: dict(st) for nid, st in res_static.items()}
+            f_names, live_names = list(fixed_names), list(live)
+            default_cots = cot_vals is None
+
+            def bwd(lvals, fvals, avals, cvals):
+                resolved = {nid: dict(st) for nid, st in static.items()}
+                for (nid, k), v in zip(spec, avals):
+                    resolved[nid][k] = v
+                fixed = dict(zip(f_names, fvals))
+
+                def pure(vals):
+                    feed = dict(fixed)
+                    feed.update(zip(live_names, vals))
+                    return tuple(eval_graph(heads, feed, is_train,
+                                            resolved=resolved))
+
+                outs, vjp_fn = jax.vjp(pure, list(lvals))
+                if default_cots:
+                    cots = tuple(jnp.ones_like(o) for o in outs)
+                else:
+                    cots = tuple(jnp.asarray(c, dtype=o.dtype)
+                                 for c, o in zip(cvals, outs))
+                (grads,) = vjp_fn(cots)
+                return grads
+
+            fn = self._bwd_cache[sig] = jax.jit(bwd)
+        else:
+            self._bwd_stats.hit()
+
+        with autograd.pause(train_mode=self._is_train):
+            grads = fn(live_vals, fixed_vals, arr_vals, cot_vals)
         for name, g in zip(live, grads):
             req = self._grad_req[name]
             tgt = self.grad_dict.get(name)

@@ -54,9 +54,17 @@ def test_attr_scope_attaches_and_serializes():
 
 
 def test_engine_bulk_shims():
-    assert engine.set_bulk_size(16) == 0
-    assert engine.set_bulk_size(0) == 16
-    with engine.bulk(8):
-        assert engine.set_bulk_size(8) == 8
-    assert engine.set_bulk_size(0) == 0       # restored on exit
-    assert mx.engine is engine
+    start = engine.bulk_size()
+    try:
+        assert engine.set_bulk_size(16) == start
+        assert engine.set_bulk_size(0) == 16
+        assert engine.bulk_size() == 0         # eager opt-out engaged
+        with engine.bulk(8):
+            assert engine.bulk_size() == 8
+        assert engine.bulk_size() == 0         # restored on exit
+        assert mx.engine is engine
+        # the default is the reference's MXNET_ENGINE_BULK_SIZE default (>0):
+        # step fusion on unless explicitly opted out
+        assert engine.DEFAULT_BULK_SIZE > 0
+    finally:
+        engine.set_bulk_size(start)

@@ -1,0 +1,64 @@
+"""Compile-count regression guard (tier-1 CI).
+
+The whole-step cache is only a win while fixed-shape training loops trace
+ONCE. This guard runs a LeNet-style training loop plus an eval pass and
+fails if the framework performs more than two step traces (train + eval
+signatures) — so future PRs can't silently reintroduce per-step retracing
+(the exact regression ISSUE 1 removed from Executor.backward).
+"""
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import engine, nd, profiler
+from mxtpu.gluon import nn
+from mxtpu.gluon.block import HybridBlock
+from mxtpu.io import DataBatch, DataDesc
+
+
+class GuardNet(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2D(4, kernel_size=3, in_channels=1)
+        self.p1 = nn.MaxPool2D(pool_size=2)
+        self.flat = nn.Flatten()
+        self.fc = nn.Dense(10, in_units=4 * 5 * 5)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.p1(self.c1(x).relu())))
+
+
+def test_lenet_loop_traces_at_most_twice():
+    batch, steps = 8, 8
+    with engine.bulk(engine.DEFAULT_BULK_SIZE):
+        profiler.reset_compile_stats()
+        mx.rng.seed(0)
+        mod = mx.Module(GuardNet(), data_names=("data",),
+                        label_names=("softmax_label",))
+        mod.bind(data_shapes=[DataDesc("data", (batch, 1, 12, 12))],
+                 label_shapes=[DataDesc("softmax_label", (batch,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
+        rs = np.random.RandomState(0)
+        train = DataBatch(
+            data=[nd.array(rs.rand(batch, 1, 12, 12).astype(np.float32))],
+            label=[nd.array(rs.randint(0, 10, batch).astype(np.float32))])
+        for _ in range(steps):
+            mod.forward_backward(train)
+            mod.update()
+        # eval signature (is_train=False forward) rides the eager/jit path;
+        # it must not multiply step traces either
+        for _ in range(3):
+            mod.forward(train, is_train=False)
+            mod.get_outputs()
+
+        stats = profiler.get_compile_stats()
+        step = stats.get("module_step", {"traces": 0, "hits": 0})
+        assert step["traces"] <= 2, (
+            f"training loop step-traced {step['traces']} times (max 2: train "
+            f"+ eval signatures) — per-step retracing regressed: {stats}")
+        # and the loop genuinely reused the cache, not silently eager
+        assert step["traces"] >= 1
+        assert step["hits"] >= steps - 1

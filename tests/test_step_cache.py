@@ -1,0 +1,299 @@
+"""Fused training-step executor (mxtpu.step_cache) — trace-once caching,
+signature-keyed invalidation, eager/fused numerical parity, and the
+compile-cache registry exposed through the profiler.
+
+The step cache is the TPU-native form of the reference's engine op bulking
+(MXNET_ENGINE_BULK_SIZE): the whole fwd+loss+bwd+update compiles once per
+signature; ``engine.bulk(0)`` is the documented eager opt-out.
+"""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, engine, nd, profiler
+from mxtpu import symbol as sym
+from mxtpu.gluon import nn
+from mxtpu.gluon.block import HybridBlock
+from mxtpu.io import DataBatch, DataDesc
+
+
+class LeNet(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2D(4, kernel_size=3, in_channels=1)
+        self.p1 = nn.MaxPool2D(pool_size=2)
+        self.flat = nn.Flatten()
+        self.fc1 = nn.Dense(16, in_units=4 * 5 * 5)
+        self.fc2 = nn.Dense(10, in_units=16)
+
+    def forward(self, x):
+        x = x.astype("float32")     # accept f16 feeds (dtype-retrace leg)
+        x = self.p1(self.c1(x).relu())
+        return self.fc2(self.fc1(self.flat(x)).relu())
+
+
+def make_module(batch=8, seed=0):
+    mx.rng.seed(seed)
+    mod = mx.Module(LeNet(), data_names=("data",),
+                    label_names=("softmax_label",))
+    mod.bind(data_shapes=[DataDesc("data", (batch, 1, 12, 12))],
+             label_shapes=[DataDesc("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    return mod
+
+
+def make_batch(batch=8, seed=0, dtype=np.float32):
+    rs = np.random.RandomState(seed)
+    x = nd.array(rs.rand(batch, 1, 12, 12).astype(dtype))
+    y = nd.array(rs.randint(0, 10, batch).astype(np.float32))
+    return DataBatch(data=[x], label=[y])
+
+
+def copy_params(src_mod, dst_mod):
+    """Positional parameter copy (gluon's global name counters make the
+    name-keyed set_params path ambiguous across two instances)."""
+    for ps, pd in zip(src_mod._block.collect_params().values(),
+                      dst_mod._block.collect_params().values()):
+        pd.set_data(ps.data())
+
+
+@pytest.fixture
+def bulked():
+    """Fusion on (the default), counters zeroed, state restored after."""
+    prev = engine.set_bulk_size(engine.DEFAULT_BULK_SIZE)
+    profiler.reset_compile_stats()
+    yield
+    engine.set_bulk_size(prev)
+
+
+def _stats(name):
+    return profiler.get_compile_stats().get(name,
+                                            {"hits": 0, "traces": 0,
+                                             "retraces": 0})
+
+
+def test_one_trace_across_identical_steps(bulked):
+    mod = make_module()
+    b = make_batch()
+    n = 6
+    for _ in range(n):
+        mod.forward_backward(b)
+        mod.update()
+    st = _stats("module_step")
+    assert st["traces"] == 1, f"fixed-shape loop retraced: {st}"
+    assert st["retraces"] == 0
+    assert st["hits"] == n - 1
+    assert not mod._fuse_broken
+
+
+def test_retrace_on_shape_dtype_sharding_change(bulked):
+    mod = make_module()
+    mod.forward_backward(make_batch(batch=8))
+    mod.update()
+    assert _stats("module_step")["traces"] == 1
+
+    # batch-shape change → new signature → exactly one more trace
+    mod.forward_backward(make_batch(batch=4))
+    mod.update()
+    assert _stats("module_step")["traces"] == 2
+
+    # dtype change → one more trace
+    mod.forward_backward(make_batch(batch=8, dtype=np.float16))
+    mod.update()
+    assert _stats("module_step")["traces"] == 3
+
+    # sharding change (dp-sharded input over the 8-device pod simulator)
+    from mxtpu.parallel import shard_batch
+    from mxtpu.parallel.mesh import data_parallel_mesh
+    mesh = data_parallel_mesh()
+    b = make_batch(batch=8)
+    b = DataBatch(data=[shard_batch(b.data[0], mesh)], label=b.label)
+    mod.forward_backward(b)
+    mod.update()
+    assert _stats("module_step")["traces"] == 4
+
+    # placement is honestly part of the executable's contract: the first
+    # sharded step re-places params/optimizer state, so one transitional
+    # retrace may follow — after that, the sharded signature must be stable
+    b2 = make_batch(batch=8)
+    b2 = DataBatch(data=[shard_batch(b2.data[0], mesh)], label=b2.label)
+    mod.forward_backward(b2)
+    mod.update()
+    settled = _stats("module_step")["traces"]
+    assert settled <= 5
+    for s in range(2):
+        b3 = make_batch(batch=8, seed=s)
+        b3 = DataBatch(data=[shard_batch(b3.data[0], mesh)], label=b3.label)
+        mod.forward_backward(b3)
+        mod.update()
+    assert _stats("module_step")["traces"] == settled
+
+
+def test_fused_matches_eager_lenet_sgd_momentum(bulked):
+    """Numerical parity: N fused steps == N eager (engine.bulk(0)) steps,
+    same init, LeNet fwd+bwd+SGD-momentum."""
+    fused = make_module(seed=3)
+    eager = make_module(seed=3)
+    copy_params(fused, eager)
+
+    steps = [make_batch(seed=s) for s in range(4)]
+    fused_losses, eager_losses = [], []
+    for b in steps:
+        fused.forward_backward(b)
+        fused.update()
+        fused_losses.append(float(fused._loss_val.mean().data))
+    with engine.bulk(0):
+        before = _stats("module_step")["traces"]
+        for b in steps:
+            eager.forward_backward(b)
+            eager.update()
+            eager_losses.append(float(eager._loss_val.mean().data))
+        # bulk(0) really forced the eager path: no step-cache traffic
+        assert _stats("module_step")["traces"] == before
+
+    np.testing.assert_allclose(fused_losses, eager_losses, rtol=1e-5,
+                               atol=1e-6)
+    for pf, pe in zip(fused._block.collect_params().values(),
+                      eager._block.collect_params().values()):
+        np.testing.assert_allclose(pf.data().asnumpy(), pe.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"param {pf.name} diverged")
+    # fused path exposes eager-visible gradients too
+    for p in fused._trainer._params:
+        assert p.grad() is not None
+
+
+def test_fused_outputs_match_eager_forward(bulked):
+    """get_outputs()/update_metric see the SAME tensors the eager path
+    produces (pre-update params, softmaxed exposure)."""
+    fused = make_module(seed=5)
+    eager = make_module(seed=5)
+    copy_params(fused, eager)
+    b = make_batch(seed=7)
+    fused.forward_backward(b)
+    with engine.bulk(0):
+        eager.forward_backward(b)
+    fo = fused.get_outputs()[0].asnumpy()
+    eo = eager.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(fo, eo, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(fo.sum(axis=-1), np.ones(fo.shape[0]),
+                               rtol=1e-5)      # probabilities exposed
+    fused.update()
+    eager.update()
+
+
+def test_monitor_forces_eager_path(bulked):
+    """Installed Monitor hooks need per-op visibility: the module must skip
+    fusion and the monitor must still capture activations."""
+    from mxtpu.monitor import Monitor
+    mod = make_module()
+    mon = Monitor(interval=1)
+    for blk in mod._monitor_blocks():
+        mon.install(blk)
+    before = _stats("module_step")["traces"]
+    mon.tic()
+    mod.forward_backward(make_batch())
+    mod.update()
+    res = mon.toc()
+    assert _stats("module_step")["traces"] == before  # eager path taken
+    assert any("output" in name for _, name, _ in res)
+
+
+def test_trainer_bulk_update_single_trace_and_parity(bulked):
+    """Trainer.update applies ALL params in one compiled program, cached by
+    signature, matching the per-param eager path numerically."""
+    def run(bulk_sz, tag):
+        mx.rng.seed(11)
+        net = nn.Dense(4, in_units=3)
+        net.initialize()
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9})
+        x = nd.array(np.linspace(-1, 1, 12, dtype=np.float32).reshape(4, 3))
+        with engine.bulk(bulk_sz):
+            for _ in range(3):
+                with autograd.record():
+                    loss = (net(x) ** 2).mean()
+                loss.backward()
+                tr.step(4)
+        return [p.data().asnumpy() for p in net.collect_params().values()]
+
+    profiler.reset_compile_stats("trainer_update")
+    bulked_params = run(engine.DEFAULT_BULK_SIZE, "bulk")
+    st = _stats("trainer_update")
+    assert st["traces"] == 1 and st["hits"] == 2
+    eager_params = run(0, "eager")
+    assert _stats("trainer_update")["traces"] == 1     # bulk(0) honored
+    for b, e in zip(bulked_params, eager_params):
+        np.testing.assert_allclose(b, e, rtol=1e-5, atol=1e-6)
+
+
+def test_executor_backward_memoized(bulked):
+    """symbol Executor.backward traces its vjp once per signature: repeated
+    forward/backward on fixed shapes hits the cache, and grads stay right."""
+    profiler.reset_compile_stats("symbol_backward")
+    x = sym.Variable("x")
+    w = sym.Variable("w")
+    y = sym.FullyConnected(x, w, no_bias=True, num_hidden=3, name="fc")
+    xv = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    wv = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+    ex = y.bind(None, {"x": nd.array(xv), "w": nd.array(wv)},
+                args_grad={"x": nd.zeros((4, 5)), "w": nd.zeros((3, 5))})
+    cot = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+    for i in range(4):
+        ex.forward()
+        ex.backward(nd.array(cot))
+        np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), cot @ wv,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), cot.T @ xv,
+                                   rtol=1e-5, atol=1e-5)
+    st = _stats("symbol_backward")
+    assert st["traces"] == 1, f"Executor.backward retraced: {st}"
+    assert st["hits"] == 3
+
+    # default-cotangent variant is a separate signature: one more trace, then
+    # cached again
+    ex.forward()
+    ex.backward()
+    ex.forward()
+    ex.backward()
+    assert _stats("symbol_backward")["traces"] == 2
+
+
+def test_executor_backward_dropout_replays_per_forward():
+    """RNG keys enter the memoized backward as traced inputs: each forward's
+    dropout mask replays exactly (grad nonzero where kept, zero where
+    dropped), without retracing."""
+    profiler.reset_compile_stats("symbol_backward")
+    x = sym.Variable("x")
+    d = sym.Dropout(x, p=0.5, name="drop")
+    xv = np.random.RandomState(0).rand(64).astype(np.float32) + 0.5
+    ex = d.bind(None, {"x": nd.array(xv)}, args_grad={"x": nd.zeros((64,))})
+    masks = []
+    for _ in range(3):
+        out = ex.forward(is_train=True)[0].asnumpy()
+        ex.backward(nd.array(np.ones(64, np.float32)))
+        g = ex.grad_dict["x"].asnumpy()
+        # backward replays the SAME mask the forward drew
+        np.testing.assert_allclose((out != 0).astype(np.float32) * 2.0, g,
+                                   rtol=1e-6)
+        masks.append(tuple(out != 0))
+    assert len(set(masks)) > 1          # fresh mask per forward
+    assert _stats("symbol_backward")["traces"] == 1
+
+
+def test_profiler_compile_stats_surface(bulked):
+    mod = make_module()
+    b = make_batch()
+    mod.forward_backward(b)
+    mod.update()
+    stats = profiler.get_compile_stats()
+    assert "module_step" in stats
+    table = profiler.compile_cache_summary()
+    assert "module_step" in table and "Retraces" in table
+    import json
+    dump = json.loads(profiler.dumps())
+    assert "compileCaches" in dump
