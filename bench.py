@@ -742,6 +742,99 @@ def bench_int8():
     return results
 
 
+def _checkpoint_probe_module():
+    """A ~16 MB (params + SGD-momentum slots) MLP Module: big enough that a
+    blocking save is serialize/fsync-dominated, small enough that the probe
+    runs in seconds on the cpu fallback."""
+    import mxtpu as mx
+    from mxtpu import nd
+    from mxtpu.gluon import nn
+    from mxtpu.gluon.block import HybridBlock
+    from mxtpu.io import DataBatch, DataDesc
+
+    class Probe(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Dense(2048, in_units=1024)
+            self.fc2 = nn.Dense(10, in_units=2048)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x).relu())
+
+    batch = 16
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(batch, 1024).astype(np.float32))
+    y = nd.array(rs.randint(0, 10, batch).astype(np.float32))
+    mod = mx.Module(Probe(), data_names=("data",),
+                    label_names=("softmax_label",))
+    mod.bind(data_shapes=[DataDesc("data", (batch, 1024))],
+             label_shapes=[DataDesc("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    b = DataBatch(data=[x], label=[y])
+    mod.forward_backward(b)   # materialize params + momentum slots
+    mod.update()
+    return mod
+
+
+def bench_checkpoint(module=None, iters: int = 5):
+    """Checkpoint-subsystem scenario: async handoff vs blocking save wall
+    time, plus committed bytes, through ``mxtpu.checkpoint.CheckpointManager``
+    with the profiler counters as the source of truth. The subsystem's
+    contract (docs/checkpointing.md): the training thread blocks for <10% of
+    a blocking save's wall time on an async save."""
+    import shutil
+    import tempfile
+
+    from mxtpu import profiler
+    from mxtpu.checkpoint import CheckpointManager
+
+    if module is None:
+        module = _checkpoint_probe_module()
+
+    d = tempfile.mkdtemp(prefix="mxtpu-bench-ckpt-")
+    profiler.reset_checkpoint_stats()
+    try:
+        mgr = CheckpointManager(d, max_to_keep=2)
+        mgr.save(0, module=module, blocking=True)   # warm: writer thread,
+                                                    # first npz serialize
+        blocking_ms = []
+        for i in range(iters):
+            t0 = time.perf_counter()
+            mgr.save(2 * i + 1, module=module, blocking=True)
+            blocking_ms.append((time.perf_counter() - t0) * 1e3)
+
+        handoff_ms = []
+        for i in range(iters):
+            t0 = time.perf_counter()
+            mgr.save(2 * i + 2, module=module, blocking=False)
+            handoff_ms.append((time.perf_counter() - t0) * 1e3)
+            # drain between samples: measure the handoff, not queue backlog
+            mgr.wait_until_finished()
+        mgr.close()
+        stats = profiler.get_checkpoint_stats()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    blocking = float(np.median(blocking_ms))
+    handoff = float(np.median(handoff_ms))
+    out = {
+        "blocking_save_ms": round(blocking, 3),
+        "async_handoff_ms": round(handoff, 3),
+        "async_blocked_frac": round(handoff / max(blocking, 1e-9), 4),
+        "committed_bytes_per_step": int(stats["committed_bytes"]
+                                        / max(stats["commits"], 1)),
+        "commits": stats["commits"],
+        "write_ms_last": round(stats["write_ms_last"], 3),
+    }
+    log(f"[checkpoint] blocking={blocking:.1f} ms async-handoff={handoff:.2f} "
+        f"ms (blocked frac {out['async_blocked_frac']:.3f}); "
+        f"{out['committed_bytes_per_step']/1e6:.1f} MB/step committed")
+    return out
+
+
 def bench_comm():
     """Allreduce bandwidth block (BASELINE.json's KVStore-allreduce GB/s
     north star). Single-chip hardware here, so this reports the local/device
@@ -819,6 +912,9 @@ def bench_cpu_fallback():
     dt = time.perf_counter() - t0
     img_s = steps * batch / dt
     caches = profiler.get_compile_stats()
+    # the checkpoint scenario reuses the trained LeNet module — the fallback
+    # path must keep emitting the same keys as the full harness
+    ckpt = bench_checkpoint(module=mod)
     log(f"[cpu-fallback] lenet b{batch}: {img_s:.0f} img/s, loss "
         f"{loss_start:.3f} -> {loss_end:.3f}, "
         f"step traces={caches.get('module_step', {}).get('traces')}")
@@ -830,6 +926,7 @@ def bench_cpu_fallback():
         "platform": jax.default_backend(),
         "loss_start": round(loss_start, 3),
         "loss_end": round(loss_end, 3),
+        "checkpoint": ckpt,
         "compile_caches": caches,
     }))
 
@@ -878,6 +975,7 @@ def main():
     pipe = bench_pipeline()
     i8 = bench_int8()
     comm = bench_comm()
+    ckpt = bench_checkpoint()
 
     best_tag = max(train, key=lambda t: train[t]["img_s"])
     best = train[best_tag]
@@ -897,6 +995,7 @@ def main():
         "pipeline_img_s": pipe,
         "int8": i8,
         "comm": comm,
+        "checkpoint": ckpt,
         "compile_caches": _compile_caches(),
     }))
 

@@ -33,7 +33,10 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                # clamp: two boundaries can land within one time.time() tick
+                # (coarse clocks / fused fast steps) — never divide by zero
+                elapsed = max(time.time() - self.tic, 1e-9)
+                speed = self.frequent * self.batch_size / elapsed
                 if param.eval_metric is not None:
                     nv = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -50,14 +53,36 @@ class Speedometer:
             self.tic = time.time()
 
 
-def do_checkpoint(prefix: str, period: int = 1):
-    """Epoch-end checkpoint callback (callback.py module_checkpoint parity)."""
+def do_checkpoint(prefix, period: int = 1, module=None, trainer=None):
+    """Epoch-end checkpoint callback (callback.py module_checkpoint parity).
+
+    ``prefix`` may be a path prefix (legacy ``prefix-####.params`` layout,
+    written atomically through ``checkpoint.save_legacy``) or a
+    ``checkpoint.CheckpointManager`` — then the save is ASYNC (background
+    writer, atomic step-dir commit). Pass ``module=`` (and optionally
+    ``trainer=``) in manager mode to capture the FULL resumable state —
+    optimizer slots and RNG — not just params; ``Module.fit(resume_from=...)``
+    picks the run up from it.
+    """
     period = max(1, int(period))
 
     def _callback(epoch, sym, arg_params, aux_params):
         if (epoch + 1) % period == 0:
-            from .model import save_checkpoint
-            save_checkpoint(prefix, epoch + 1, sym, arg_params, aux_params)
+            from .checkpoint import CheckpointManager
+            if isinstance(prefix, CheckpointManager):
+                # epoch meta records the NEXT epoch: everything up to and
+                # including `epoch` is complete, resume starts cleanly after
+                if module is not None:
+                    prefix.save(epoch + 1, module=module, trainer=trainer,
+                                epoch=epoch + 1)
+                else:
+                    prefix.save(epoch + 1, arg_params=arg_params,
+                                aux_params=aux_params, epoch=epoch + 1,
+                                extra_meta={"symbol": getattr(sym, "name",
+                                                              None)})
+            else:
+                from .model import save_checkpoint
+                save_checkpoint(prefix, epoch + 1, sym, arg_params, aux_params)
 
     return _callback
 

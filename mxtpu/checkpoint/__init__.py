@@ -1,0 +1,50 @@
+"""``mxtpu.checkpoint`` — fault-tolerant async checkpoint subsystem.
+
+The production-grade replacement for the reference's save_checkpoint /
+do_checkpoint helpers (python/mxnet/model.py:384, callback.py): async saves
+with an atomic commit protocol, retention/GC, multi-process shard awareness,
+legacy-layout compat, and a SIGTERM preemption hook. See ``manager.py`` for
+the design notes and ``docs/checkpointing.md`` for the knob mapping.
+
+Import structure: ``atomic_io`` is dependency-free and imported eagerly (low
+layers like ``ndarray.save`` use it); the manager/snapshot layers import the
+rest of the framework and load lazily.
+"""
+
+from . import atomic_io
+from .atomic_io import committed_steps
+
+__all__ = ["CheckpointManager", "TrainingSnapshot", "atomic_io",
+           "committed_steps", "latest_step", "all_steps", "save_legacy",
+           "strip_amp_cast"]
+
+_LAZY = {
+    "CheckpointManager": ("mxtpu.checkpoint.manager", "CheckpointManager"),
+    "save_legacy": ("mxtpu.checkpoint.manager", "save_legacy"),
+    "strip_amp_cast": ("mxtpu.checkpoint.manager", "strip_amp_cast"),
+    "TrainingSnapshot": ("mxtpu.checkpoint.snapshot", "TrainingSnapshot"),
+    "manager": ("mxtpu.checkpoint.manager", None),
+    "snapshot": ("mxtpu.checkpoint.snapshot", None),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(entry[0])
+    obj = mod if entry[1] is None else getattr(mod, entry[1])
+    globals()[name] = obj
+    return obj
+
+
+def latest_step(directory: str, step_prefix: str = "step"):
+    """Newest COMMITted step under ``directory``, or None (module-level
+    convenience over ``atomic_io.committed_steps``)."""
+    steps = committed_steps(directory, step_prefix)
+    return steps[-1] if steps else None
+
+
+def all_steps(directory: str, step_prefix: str = "step"):
+    return committed_steps(directory, step_prefix)

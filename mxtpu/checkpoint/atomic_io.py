@@ -1,0 +1,193 @@
+"""Atomic filesystem primitives for the checkpoint subsystem.
+
+The reference's ``save_checkpoint`` (python/mxnet/model.py:384) writes straight
+into the destination file — a SIGKILL mid-``nd.save`` leaves a torn ``.params``
+and the run is unrecoverable. Every byte the checkpoint subsystem persists goes
+through the two primitives here instead:
+
+* **file atomicity** — ``atomic_write``/``atomic_write_bytes``: write into a
+  tempfile in the destination directory, flush + ``fsync``, then ``os.replace``
+  (atomic on POSIX within a filesystem), then fsync the directory so the rename
+  itself is durable. A crash at ANY point leaves either the old file or the new
+  file, never a hybrid.
+
+* **directory commit protocol** — ``commit_dir``: a checkpoint is staged as
+  ``step-N.tmp/``, every file in it fsynced, the directory renamed to
+  ``step-N/``, and only then is a ``COMMIT`` marker dropped (itself atomically).
+  Readers (``committed_steps``) require the marker, so a crash before the
+  marker — including between the rename and the marker write — leaves a dir
+  that discovery ignores. Restore can never observe a torn checkpoint.
+
+This module deliberately has NO mxtpu imports so low layers (``ndarray.save``)
+can use it without an import cycle.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import tempfile
+from typing import Callable, Iterable, List, Optional
+
+COMMIT_MARKER = "COMMIT"
+TMP_SUFFIX = ".tmp"
+
+_STEP_RE = re.compile(r"^(?P<prefix>.+)-(?P<step>\d+)$")
+
+
+def fsync_path(path: str):
+    """fsync a file or directory by path (durability of the entry itself)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir_of(path: str):
+    """fsync the parent directory so a rename/create of ``path`` is durable."""
+    fsync_path(os.path.dirname(os.path.abspath(path)) or ".")
+
+
+def atomic_write(fname: str, write_fn: Callable, fsync: bool = True) -> int:
+    """Write via ``write_fn(file_obj)`` into a same-directory tempfile, fsync,
+    and ``os.replace`` over the destination. Returns bytes written.
+
+    Same-directory matters twice: ``os.replace`` must not cross filesystems,
+    and a crash leaves the debris next to the target where the next save's
+    stale-tmp sweep (or the operator) can see it.
+    """
+    fname = os.path.abspath(fname)
+    d = os.path.dirname(fname)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix="." + os.path.basename(fname) + ".",
+                               suffix=TMP_SUFFIX)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+            nbytes = f.tell()
+        os.replace(tmp, fname)
+        if fsync:
+            fsync_path(d)
+        return nbytes
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(fname: str, data: bytes, fsync: bool = True) -> int:
+    return atomic_write(fname, lambda f: f.write(data), fsync=fsync)
+
+
+# ---------------------------------------------------------------------------
+# directory commit protocol
+# ---------------------------------------------------------------------------
+
+
+def staging_dir(root: str, name: str) -> str:
+    """Create (or reuse) the staging directory ``root/name.tmp/``."""
+    path = os.path.join(root, name + TMP_SUFFIX)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def commit_dir(root: str, name: str, fsync: bool = True,
+               hooks: Optional[dict] = None) -> str:
+    """Promote ``root/name.tmp/`` to the committed ``root/name/``.
+
+    Protocol: fsync every file in the staging dir, fsync the staging dir,
+    rename to the final name, fsync the parent, then atomically drop the
+    ``COMMIT`` marker inside. ``hooks`` is a test seam: callables under
+    ``"before_rename"`` / ``"before_marker"`` run at the matching point so
+    crash-mid-save tests can kill the writer at either window.
+    """
+    hooks = hooks or {}
+    tmp = os.path.join(root, name + TMP_SUFFIX)
+    final = os.path.join(root, name)
+    if fsync:
+        for entry in os.scandir(tmp):
+            if entry.is_file():
+                fsync_path(entry.path)
+        fsync_path(tmp)
+    if "before_rename" in hooks:
+        hooks["before_rename"]()
+    if os.path.isdir(final):        # a previous torn commit of the same step
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+    if fsync:
+        fsync_path(root)
+    if "before_marker" in hooks:
+        hooks["before_marker"]()
+    atomic_write_bytes(os.path.join(final, COMMIT_MARKER), b"1\n", fsync=fsync)
+    return final
+
+
+def is_committed(root: str, name: str) -> bool:
+    return os.path.isfile(os.path.join(root, name, COMMIT_MARKER))
+
+
+def committed_steps(root: str, prefix: str = "step") -> List[int]:
+    """Sorted step numbers of COMMITted ``prefix-N/`` dirs under ``root``.
+
+    Uncommitted dirs — ``.tmp`` staging debris or a renamed dir whose writer
+    died before dropping the marker — are invisible here by construction.
+    """
+    steps = []
+    if not os.path.isdir(root):
+        return steps
+    for entry in os.listdir(root):
+        m = _STEP_RE.match(entry)
+        if not m or m.group("prefix") != prefix:
+            continue
+        if is_committed(root, entry):
+            steps.append(int(m.group("step")))
+    return sorted(steps)
+
+
+def remove_step(root: str, prefix: str, step: int):
+    """GC one committed step: drop the marker FIRST (atomic un-commit), then
+    the payload — a crash mid-delete leaves an uncommitted dir, not a
+    half-valid checkpoint."""
+    path = os.path.join(root, f"{prefix}-{step}")
+    marker = os.path.join(path, COMMIT_MARKER)
+    try:
+        os.unlink(marker)
+    except FileNotFoundError:
+        pass
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def sweep_stale_staging(root: str, prefix: str = "step",
+                        keep: Iterable[str] = ()) -> List[str]:
+    """Delete ``prefix-*.tmp`` staging debris left by dead writers."""
+    removed = []
+    keep = set(keep)
+    if not os.path.isdir(root):
+        return removed
+    for entry in os.listdir(root):
+        if not entry.endswith(TMP_SUFFIX):
+            continue
+        stem = entry[:-len(TMP_SUFFIX)]
+        m = _STEP_RE.match(stem)
+        if not m or m.group("prefix") != prefix or entry in keep:
+            continue
+        shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
+        removed.append(entry)
+    return removed
+
+
+def dir_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for fn in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, fn))
+            except OSError:
+                pass
+    return total

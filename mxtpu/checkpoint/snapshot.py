@@ -1,0 +1,297 @@
+"""Training-state snapshot: capture device state to host, and re-place it.
+
+``capture`` walks the full training state — params, aux (BN running stats),
+Trainer/optimizer slots, the framework RNG key, and the loop counters — and
+starts a NON-BLOCKING device→host copy of every array
+(``jax.Array.copy_to_host_async``). The training step resumes immediately; the
+background writer calls ``materialize()`` which waits on the already-in-flight
+copies. This is the async half of the Orbax/TF-CheckpointManager design: the
+only synchronous cost on the training thread is snapshotting *references* and
+kicking off DMA.
+
+``apply_*`` are the duals: they push host arrays back into a live module /
+trainer, re-placing each array with its saved ``NamedSharding`` spec through
+``parallel.data_parallel._place`` (the same host→mesh placement the training
+step uses), so a restored run resumes with identical layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _dtype_from_str(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def spec_of(x) -> Optional[list]:
+    """JSON-able partition spec of a NamedSharding-placed array, else None."""
+    from jax.sharding import NamedSharding
+    sh = getattr(x, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    spec = tuple(sh.spec)
+    if not any(s is not None for s in spec):
+        return None
+    return [list(s) if isinstance(s, tuple) else s for s in spec]
+
+
+def _spec_to_partition(spec: list):
+    from jax.sharding import PartitionSpec as P
+    return P(*[tuple(s) if isinstance(s, list) else s for s in spec])
+
+
+def _start_host_copy(x):
+    """Kick off the device→host DMA without waiting for it."""
+    try:
+        x.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass
+    return x
+
+
+def _to_host(x) -> np.ndarray:
+    """Materialize one array on the host. Multi-process arrays yield this
+    process's LOCAL data (deduped addressable shards, concatenated along the
+    sharded axis) — the inverse of ``_place``'s per-host-feed convention."""
+    import jax
+    if isinstance(x, np.ndarray):
+        return x
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    uniq: Dict[tuple, Any] = {}
+    for s in x.addressable_shards:
+        key = tuple((sl.start or 0, sl.stop) for sl in s.index)
+        uniq.setdefault(key, s)
+    shards = sorted(uniq.values(),
+                    key=lambda s: tuple(sl.start or 0 for sl in s.index))
+    if len(shards) == 1:
+        return np.asarray(jax.device_get(shards[0].data))
+    starts = [tuple(sl.start or 0 for sl in s.index) for s in shards]
+    axis = next((d for d in range(len(starts[0]))
+                 if len({st[d] for st in starts}) > 1), 0)
+    return np.concatenate(
+        [np.asarray(jax.device_get(s.data)) for s in shards], axis=axis)
+
+
+def _short_names(block):
+    """name -> Parameter with the block prefix stripped (Module.get_params
+    convention, so snapshots match the legacy arg/aux key space)."""
+    out = {}
+    for name, p in block.collect_params().items():
+        short = name[len(block.prefix):] if name.startswith(block.prefix) \
+            else name
+        out[short] = p
+    return out
+
+
+class TrainingSnapshot:
+    """One captured training state: ``arrays`` (key -> device handle or host
+    ndarray) plus JSON-able ``meta`` (counters, shardings, dtypes, rng)."""
+
+    def __init__(self, arrays: Dict[str, Any], meta: Dict[str, Any]):
+        self.arrays = arrays
+        self.meta = meta
+
+    def materialize(self) -> "TrainingSnapshot":
+        self.arrays = {k: _to_host(v) for k, v in self.arrays.items()}
+        return self
+
+    @property
+    def step(self) -> Optional[int]:
+        return self.meta.get("step")
+
+
+def capture(step: int, module=None, trainer=None, arg_params=None,
+            aux_params=None, epoch: Optional[int] = None,
+            nbatch: Optional[int] = None, include_rng: bool = True,
+            extra_meta: Optional[dict] = None) -> TrainingSnapshot:
+    """Snapshot the full training state (non-blocking on the device side)."""
+    import jax
+
+    arrays: Dict[str, Any] = {}
+    shardings: Dict[str, list] = {}
+
+    def _add(key, value):
+        raw = value.data if hasattr(value, "asnumpy") else value
+        spec = spec_of(raw)
+        if spec is not None:
+            shardings[key] = spec
+        if not isinstance(raw, np.ndarray):
+            raw = _start_host_copy(raw)
+        arrays[key] = raw
+
+    if module is not None:
+        arg, aux = module.get_params()
+        arg_params = arg if arg_params is None else arg_params
+        aux_params = aux if aux_params is None else aux_params
+        if trainer is None:
+            trainer = getattr(module, "_trainer", None)
+    for k, v in (arg_params or {}).items():
+        _add(f"arg:{k}", v)
+    for k, v in (aux_params or {}).items():
+        _add(f"aux:{k}", v)
+
+    trainer_meta = None
+    if trainer is not None:
+        trainer._init_kvstore()
+        opt = trainer._optimizer
+        state_slots: List[Optional[int]] = []
+        for i, st in enumerate(trainer._states):
+            if st is None:
+                state_slots.append(None)
+                continue
+            state_slots.append(len(st))
+            for j, s in enumerate(st):
+                _add(f"opt:{i}:{j}", s)
+        trainer_meta = {
+            "optimizer": type(opt).__name__,
+            "num_update": int(opt.num_update),
+            "counts": {str(k): int(v)
+                       for k, v in opt._index_update_count.items()},
+            "state_slots": state_slots,
+        }
+
+    rng_meta = None
+    if include_rng:
+        from .. import rng as rng_mod
+        blob = rng_mod.get_state_blob()
+        arrays["rng:key_data"] = blob["key_data"]
+        rng_meta = {"trace_counter": blob["trace_counter"]}
+
+    meta = {
+        "format": FORMAT_VERSION,
+        "step": int(step),
+        "epoch": None if epoch is None else int(epoch),
+        "nbatch": None if nbatch is None else int(nbatch),
+        "process_count": jax.process_count(),
+        "shardings": shardings,
+        "trainer": trainer_meta,
+        "rng": rng_meta,
+    }
+    if extra_meta:
+        meta["extra"] = dict(extra_meta)
+    return TrainingSnapshot(arrays, meta)
+
+
+# ---------------------------------------------------------------------------
+# restore duals
+# ---------------------------------------------------------------------------
+
+
+def _needs_mesh(snapshot: TrainingSnapshot) -> bool:
+    return bool(snapshot.meta.get("shardings"))
+
+
+def restored_array(snapshot: TrainingSnapshot, key: str, mesh=None):
+    """One array back on device, re-placed with its saved sharding spec
+    (via ``parallel.data_parallel._place``) when one was recorded."""
+    import jax.numpy as jnp
+    raw = snapshot.arrays[key]
+    spec = snapshot.meta.get("shardings", {}).get(key)
+    if spec is not None and mesh is not None:
+        from jax.sharding import NamedSharding
+        from ..parallel.data_parallel import _place
+        return _place(raw, NamedSharding(mesh, _spec_to_partition(spec)))
+    return jnp.asarray(raw)
+
+
+def default_mesh_for(snapshot: TrainingSnapshot):
+    if not _needs_mesh(snapshot):
+        return None
+    from ..parallel.mesh import get_default_mesh
+    return get_default_mesh()
+
+
+def apply_params(snapshot: TrainingSnapshot, module, mesh=None,
+                 allow_missing: bool = False):
+    """Push arg/aux arrays into an initialized Module's parameters.
+
+    Matching is by name first (the legacy arg/aux key space). Block names
+    carry per-process instance counters (``conv2d0_`` vs ``conv2d1_``), so a
+    same-process re-instantiation of the same architecture gets fresh names;
+    unmatched params fall back to POSITIONAL matching within the arg/aux
+    group (collect_params order is construction order), gated on exact shape
+    agreement."""
+    import warnings
+    from ..ndarray.ndarray import NDArray
+    mesh = mesh if mesh is not None else default_mesh_for(snapshot)
+    named = _short_names(module._block)
+    live = [(short, p) for short, p in named.items() if p._data is not None]
+    grouped = {"arg:": [(s, p) for s, p in live if p.grad_req != "null"],
+               "aux:": [(s, p) for s, p in live if p.grad_req == "null"]}
+    saved = {pre: [k for k in snapshot.arrays if k.startswith(pre)]
+             for pre in ("arg:", "aux:")}
+    missing = []
+    fell_back = False
+    for pre, group in grouped.items():
+        by_name = set(saved[pre])
+        positional_ok = len(group) == len(saved[pre]) and all(
+            tuple(snapshot.arrays[k].shape) == p._data.shape
+            for k, (_s, p) in zip(saved[pre], group))
+        for idx, (short, p) in enumerate(group):
+            key = pre + short
+            if key not in by_name:
+                if positional_ok:
+                    key = saved[pre][idx]
+                    fell_back = True
+                else:
+                    missing.append(short)
+                    continue
+            p.set_data(NDArray(restored_array(snapshot, key, mesh)))
+    if fell_back:
+        warnings.warn(
+            "checkpoint restore matched some parameters positionally (block "
+            "instance counters differ from save time); shapes agreed",
+            stacklevel=2)
+    if missing and not allow_missing:
+        raise KeyError(f"checkpoint is missing parameters {missing}; pass "
+                       "allow_missing=True to restore a partial state")
+    return missing
+
+
+def apply_trainer(snapshot: TrainingSnapshot, trainer, mesh=None):
+    """Push optimizer slots + update counters back into a Trainer."""
+    import warnings
+    tmeta = snapshot.meta.get("trainer")
+    if tmeta is None:
+        return
+    mesh = mesh if mesh is not None else default_mesh_for(snapshot)
+    trainer._init_kvstore()
+    opt = trainer._optimizer
+    if tmeta.get("optimizer") and tmeta["optimizer"] != type(opt).__name__:
+        warnings.warn(
+            f"checkpoint optimizer state was saved by {tmeta['optimizer']} "
+            f"but is being restored into {type(opt).__name__}; slots are "
+            "applied positionally", stacklevel=2)
+    slots = tmeta.get("state_slots", [])
+    states: List[Optional[tuple]] = []
+    for i in range(len(trainer._params)):
+        n = slots[i] if i < len(slots) else None
+        if n is None:
+            states.append(None)
+        else:
+            states.append(tuple(
+                restored_array(snapshot, f"opt:{i}:{j}", mesh)
+                for j in range(n)))
+    trainer._states = states
+    opt.num_update = int(tmeta.get("num_update", 0))
+    opt._index_update_count = {int(k): int(v)
+                               for k, v in tmeta.get("counts", {}).items()}
+
+
+def apply_rng(snapshot: TrainingSnapshot):
+    if snapshot.meta.get("rng") is None:
+        return
+    from .. import rng as rng_mod
+    rng_mod.set_state_blob({
+        "key_data": np.asarray(snapshot.arrays["rng:key_data"]),
+        "trace_counter": snapshot.meta["rng"].get("trace_counter", 0)})

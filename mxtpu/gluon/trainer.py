@@ -196,18 +196,36 @@ class Trainer:
                                                          self._states[i])
 
     # -- state io ----------------------------------------------------------
+    def states_dict(self) -> dict:
+        """Host-side optimizer state (slots + update counters) as a plain
+        picklable dict — the Trainer half of a checkpoint snapshot."""
+        import jax
+        import numpy as np
+        self._init_kvstore()
+        blob = {i: [np.asarray(jax.device_get(x)) for x in (s or ())]
+                for i, s in enumerate(self._states)}
+        return {"states": blob, "num_update": self._optimizer.num_update,
+                "counts": dict(self._optimizer._index_update_count)}
+
+    def load_states_dict(self, data: dict):
+        import jax.numpy as jnp
+        self._init_kvstore()
+        self._states = [tuple(jnp.asarray(x) for x in data["states"].get(i, ()))
+                        or None for i in range(len(self._params))]
+        self._optimizer.num_update = data["num_update"]
+        self._optimizer._index_update_count = dict(data["counts"])
+
     def save_states(self, fname: str):
+        """Atomic (tempfile + fsync + rename via checkpoint.atomic_io): a
+        kill mid-save leaves the previous states file, never a torn one."""
         self._init_kvstore()
         if self._kvstore is not None and self._update_on_kv:
             self._kvstore.save_optimizer_states(fname)
             return
         import pickle
-        import jax
-        blob = {i: [jax.device_get(x) for x in (s or ())]
-                for i, s in enumerate(self._states)}
-        with open(fname, "wb") as f:
-            pickle.dump({"states": blob, "num_update": self._optimizer.num_update,
-                         "counts": self._optimizer._index_update_count}, f)
+        from ..checkpoint import atomic_io
+        atomic_io.atomic_write(
+            fname, lambda f: pickle.dump(self.states_dict(), f))
 
     def load_states(self, fname: str):
         self._init_kvstore()
@@ -215,10 +233,5 @@ class Trainer:
             self._kvstore.load_optimizer_states(fname)
             return
         import pickle
-        import jax.numpy as jnp
         with open(fname, "rb") as f:
-            data = pickle.load(f)
-        self._states = [tuple(jnp.asarray(x) for x in data["states"].get(i, ()))
-                        or None for i in range(len(self._params))]
-        self._optimizer.num_update = data["num_update"]
-        self._optimizer._index_update_count = data["counts"]
+            self.load_states_dict(pickle.load(f))

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import json
 import os
+import warnings
 from typing import Dict, Optional, Tuple
 
 from . import ndarray as nd
@@ -20,20 +21,14 @@ def save_checkpoint(prefix: str, epoch: int, symbol=None, arg_params: Dict = Non
     A real Symbol serializes its graph (Symbol.tojson) and round-trips through
     ``load_checkpoint`` → ``Module(symbol)``; non-symbol blocks store a descriptor
     (their graph is re-traced from code; jit.export_stablehlo is the portable form).
+
+    Delegates to ``checkpoint.save_legacy`` — the one (atomic, fsynced) writer
+    for this layout; ``remove_amp_cast`` strips amp_cast/amp_multicast nodes
+    from the symbol graph before serialization, as the reference does.
     """
-    if symbol is not None:
-        with open(f"{prefix}-symbol.json", "w") as f:
-            if hasattr(symbol, "tojson"):
-                f.write(symbol.tojson())
-            else:
-                json.dump({"framework": "mxtpu", "block": type(symbol).__name__,
-                           "repr": repr(symbol)}, f)
-    payload = {}
-    for k, v in (arg_params or {}).items():
-        payload[f"arg:{k}"] = v
-    for k, v in (aux_params or {}).items():
-        payload[f"aux:{k}"] = v
-    nd.save(f"{prefix}-{epoch:04d}.params", payload)
+    from .checkpoint import save_legacy
+    save_legacy(prefix, epoch, symbol=symbol, arg_params=arg_params,
+                aux_params=aux_params, remove_amp_cast=remove_amp_cast)
 
 
 def load_checkpoint(prefix: str, epoch: int):
@@ -50,13 +45,20 @@ def load_checkpoint(prefix: str, epoch: int):
             symbol = json.loads(raw)  # legacy block descriptor
     loaded = nd.load(f"{prefix}-{epoch:04d}.params")
     arg_params, aux_params = {}, {}
+    unknown = []
     for k, v in loaded.items():
         if k.startswith("arg:"):
             arg_params[k[4:]] = v
         elif k.startswith("aux:"):
             aux_params[k[4:]] = v
         else:
+            unknown.append(k)
             arg_params[k] = v
+    if unknown:
+        warnings.warn(
+            f"load_checkpoint({prefix!r}, {epoch}): {len(unknown)} key(s) "
+            f"without an 'arg:'/'aux:' prefix (e.g. {unknown[0]!r}) were "
+            "classified as arg_params", stacklevel=2)
     return symbol, arg_params, aux_params
 
 

@@ -163,8 +163,17 @@ class BaseModule:
             kvstore="local", optimizer="sgd", optimizer_params=None,
             eval_end_callback=None, initializer=None, arg_params=None,
             aux_params=None, allow_missing=False, force_init=False, begin_epoch=0,
-            num_epoch=None, validation_metric=None, monitor=None):
-        """The canonical train loop (base_module.py:399)."""
+            num_epoch=None, validation_metric=None, monitor=None,
+            resume_from=None):
+        """The canonical train loop (base_module.py:399).
+
+        ``resume_from`` — a ``checkpoint.CheckpointManager`` or a checkpoint
+        directory path — auto-restores the latest committed step (params,
+        optimizer slots, RNG) after bind/init and continues the loop at the
+        saved epoch/nbatch. A checkpoint without a committed step is a no-op
+        (fresh start), so the same launch command works for both the first
+        run and every preemption restart.
+        """
         assert num_epoch is not None, "num_epoch required"
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True)
@@ -173,6 +182,21 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        resume_nbatch = None
+        if resume_from is not None:
+            from .checkpoint import CheckpointManager
+            mgr = resume_from if isinstance(resume_from, CheckpointManager) \
+                else CheckpointManager(resume_from)
+            snap = mgr.restore(module=self,
+                               trainer=getattr(self, "_trainer", None))
+            if snap is not None:
+                if snap.meta.get("epoch") is not None:
+                    begin_epoch = int(snap.meta["epoch"])
+                if snap.meta.get("nbatch") is not None:
+                    resume_nbatch = int(snap.meta["nbatch"])
+                self.logger.info(
+                    "fit: resumed from checkpoint step %s (epoch=%s nbatch=%s)",
+                    snap.step, begin_epoch, resume_nbatch)
         eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
 
@@ -185,6 +209,9 @@ class BaseModule:
             eval_metric.reset()
             train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
+                if resume_nbatch is not None and epoch == begin_epoch \
+                        and nbatch <= resume_nbatch:
+                    continue   # batches 0..nbatch of the saved epoch are done
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
@@ -490,7 +517,22 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels):
         eval_metric.update(labels, self.get_outputs())
 
-    def save_checkpoint(self, prefix: str, epoch: int, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch: int, save_optimizer_states=False,
+                        blocking: bool = True):
+        """Persist the module state. ``prefix`` is a path prefix (legacy
+        ``prefix-####.params`` layout, written atomically through the
+        checkpoint subsystem) or a ``checkpoint.CheckpointManager`` — then
+        the full state (params, optimizer slots, RNG) is saved through the
+        async atomic-commit path; ``blocking=False`` returns after the
+        device→host handoff."""
+        from .checkpoint import CheckpointManager
+        if isinstance(prefix, CheckpointManager):
+            # manager mode always captures the FULL resumable state — params,
+            # optimizer slots, RNG (save_optimizer_states exists for the
+            # legacy two-file layout, where optimizer state is a second file)
+            prefix.save(epoch, module=self, trainer=self._trainer,
+                        epoch=epoch, blocking=blocking)
+            return
         from .model import save_checkpoint
         arg, aux = self.get_params()
         save_checkpoint(prefix, epoch, self._symbol_obj, arg, aux)

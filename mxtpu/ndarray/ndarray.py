@@ -587,10 +587,12 @@ def save(fname: str, data, fmt: str = "npz"):
     (legacy_io.py; ndarray.cc:1532-1653) so the artifact loads in the
     reference framework and its other language bindings.
     """
+    # all writes are tempfile + fsync + os.replace (checkpoint.atomic_io):
+    # a mid-write SIGKILL leaves the previous file intact, never a torn one
+    from ..checkpoint import atomic_io
     if fmt == "reference":
         from . import legacy_io
-        with open(fname, "wb") as f:
-            f.write(legacy_io.save_bytes(data))
+        atomic_io.atomic_write_bytes(fname, legacy_io.save_bytes(data))
         return
     if fmt != "npz":
         raise ValueError(f"unknown save format {fmt!r}: use 'npz' or 'reference'")
@@ -617,8 +619,7 @@ def save(fname: str, data, fmt: str = "npz"):
     else:
         raise TypeError(f"cannot save {type(data)}")
     payload[_SAVE_FORMAT_KEY] = np.frombuffer(fmt.encode(), dtype=np.uint8)
-    with open(fname, "wb") as f:
-        np.savez(f, **payload)
+    atomic_io.atomic_write(fname, lambda f: np.savez(f, **payload))
 
 
 def _decode_entries(z, keys):

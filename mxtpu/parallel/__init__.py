@@ -15,7 +15,7 @@ from .collectives import (all_gather, all_to_all, all_to_all_array,
                           broadcast_processes, pmean, ppermute,
                           process_barrier, psum, reduce_scatter,
                           reduce_scatter_array)
-from .data_parallel import DataParallelTrainer, replicate, shard_batch
+from .data_parallel import DataParallelTrainer, place, replicate, shard_batch
 from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh,
                    force_virtual_cpu_devices, get_default_mesh, make_mesh,
                    set_default_mesh)

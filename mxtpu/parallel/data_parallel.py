@@ -22,7 +22,7 @@ from ..ndarray.ndarray import NDArray
 from ..step_cache import build_update_all, cache_stats
 from .mesh import Mesh, get_default_mesh
 
-__all__ = ["shard_batch", "replicate", "DataParallelTrainer"]
+__all__ = ["shard_batch", "replicate", "place", "DataParallelTrainer"]
 
 
 def _place(raw, sharding: NamedSharding):
@@ -41,6 +41,12 @@ def _place(raw, sharding: NamedSharding):
         return jax.make_array_from_process_local_data(
             sharding, np.asarray(jax.device_get(raw)))
     return jax.device_put(raw, sharding)
+
+
+# public alias: the checkpoint subsystem restores arrays through the SAME
+# placement path the training step feeds through (per-host local slices
+# assemble into the global array under jax.distributed)
+place = _place
 
 
 def shard_batch(array, mesh: Optional[Mesh] = None, axis: int = 0) -> NDArray:

@@ -110,10 +110,57 @@ def dumps(reset: bool = False) -> str:
         out = get_summary()
     else:
         out = json.dumps({"traceEvents": _state["events"],
-                          "compileCaches": get_compile_stats()})
+                          "compileCaches": get_compile_stats(),
+                          "checkpoint": get_checkpoint_stats()})
     if reset:
         _state["events"] = []
     return out
+
+
+# ---------------------------------------------------------------------------
+# checkpoint observability (mxtpu.checkpoint manager counters)
+# ---------------------------------------------------------------------------
+
+_CKPT_ZERO = {"saves": 0, "commits": 0, "restores": 0,
+              "committed_bytes": 0,
+              "blocked_step_ms_total": 0.0, "blocked_step_ms_last": 0.0,
+              "save_latency_ms_total": 0.0, "save_latency_ms_last": 0.0,
+              "write_ms_last": 0.0}
+_ckpt = dict(_CKPT_ZERO)
+
+
+def record_checkpoint_save(blocked_ms: float):
+    """Training-thread side of an async save: how long the step was blocked
+    on the snapshot handoff (device→host DMA start + enqueue)."""
+    _ckpt["saves"] += 1
+    _ckpt["blocked_step_ms_last"] = blocked_ms
+    _ckpt["blocked_step_ms_total"] += blocked_ms
+
+
+def record_checkpoint_commit(write_ms: float, latency_ms: float, nbytes: int):
+    """Writer-thread side: ``write_ms`` is the serialize+fsync+commit work,
+    ``latency_ms`` the enqueue→commit wall time (queueing included),
+    ``nbytes`` the committed payload size."""
+    _ckpt["commits"] += 1
+    _ckpt["write_ms_last"] = write_ms
+    _ckpt["save_latency_ms_last"] = latency_ms
+    _ckpt["save_latency_ms_total"] += latency_ms
+    _ckpt["committed_bytes"] += int(nbytes)
+
+
+def record_checkpoint_restore():
+    _ckpt["restores"] += 1
+
+
+def get_checkpoint_stats() -> dict:
+    """Checkpoint counters (saves/commits/restores, committed bytes, save
+    latency, blocked-step time) — the observability contract of the async
+    checkpoint subsystem; bench.py's `checkpoint` scenario reads these."""
+    return dict(_ckpt)
+
+
+def reset_checkpoint_stats():
+    _ckpt.update(_CKPT_ZERO)
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,24 @@ def seed(seed_state: int):
     st.trace_counter = 0     # seeded runs replay the foreign-jit stream too
 
 
+def get_state_blob() -> dict:
+    """Host-serializable PRNG state (checkpoint subsystem): the raw key data
+    plus the foreign-jit fold counter. Restoring via ``set_state_blob``
+    resumes the exact random stream — dropout/sampling after a restore match
+    an uninterrupted run bit-for-bit."""
+    import numpy as np
+    st = _global()
+    return {"key_data": np.asarray(jax.random.key_data(st.key)),
+            "trace_counter": int(getattr(st, "trace_counter", 0))}
+
+
+def set_state_blob(blob: dict):
+    import jax.numpy as jnp
+    st = _global()
+    st.key = jax.random.wrap_key_data(jnp.asarray(blob["key_data"]))
+    st.trace_counter = int(blob.get("trace_counter", 0))
+
+
 class _TraceProvider:
     """Splits keys deterministically from one traced base key."""
 
