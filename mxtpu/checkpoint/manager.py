@@ -8,11 +8,12 @@ mid-``nd.save`` leaves a torn ``.params`` and the run is unrecoverable. This
 module is the Orbax/TF-CheckpointManager-style answer: a manager that owns the
 full training-state lifecycle.
 
-* **async save** — ``save()`` snapshots device arrays (non-blocking
-  device→host DMA via ``snapshot.capture``) and hands the job to a background
-  writer thread; the training step resumes after microseconds-to-milliseconds
-  of handoff, not after the serialize+fsync. ``profiler`` counters record the
-  blocked-step time, save latency, and committed bytes.
+* **async save** — ``save()`` snapshots device arrays (overlapped device→host
+  DMA via ``snapshot.capture``, landed on the host before returning so buffer
+  donation by the next step can't invalidate the snapshot) and hands the job
+  to a background writer thread; the training step pays for the D2H copy, not
+  the serialize+fsync. ``profiler`` counters record the blocked-step time,
+  save latency, and committed bytes.
 * **atomic commit** — the writer stages ``step-N.tmp/``, fsyncs, renames to
   ``step-N/``, then drops a ``COMMIT`` marker (``atomic_io.commit_dir``).
   ``latest_step()``/``all_steps()`` only see committed steps, so restore can
@@ -123,7 +124,7 @@ class CheckpointManager:
         base = os.path.basename(self.legacy_prefix)
         d = os.path.dirname(os.path.abspath(self.legacy_prefix)) \
             or self.directory
-        pat = re.compile(re.escape(base) + r"-(\d{4})\.params$")
+        pat = re.compile(re.escape(base) + r"-(\d+)\.params$")
         out = []
         if os.path.isdir(d):
             for entry in os.listdir(d):
@@ -142,11 +143,15 @@ class CheckpointManager:
              include_rng: bool = True,
              extra_meta: Optional[dict] = None) -> _SaveJob:
         """Snapshot the training state and enqueue the write. Returns after
-        the device→host handoff (async DMA started, references captured) —
-        the blocked-step time is recorded in the profiler's checkpoint
-        counters. ``blocking=True`` additionally waits for the commit (and
-        re-raises any writer error)."""
+        the device→host handoff (all D2H copies overlapped and landed on the
+        host, so donated device buffers may die freely afterwards) — the
+        blocked-step time is recorded in the profiler's checkpoint counters.
+        ``blocking=True`` additionally waits for the commit. Writer errors
+        are never silent: a blocking save re-raises its own, and an async
+        save's error surfaces at the NEXT ``save()`` /
+        ``wait_until_finished()`` / ``close()``."""
         from .. import profiler
+        self._raise_pending_error()
         t0 = time.perf_counter()
         snapshot = capture(step, module=module, trainer=trainer,
                            arg_params=arg_params, aux_params=aux_params,
@@ -161,17 +166,23 @@ class CheckpointManager:
         if blocking:
             job.done.wait()
             if job.error is not None:
+                with self._lock:    # surfaced here — don't re-raise later
+                    if job.error in self._errors:
+                        self._errors.remove(job.error)
                 raise job.error
         return job
 
-    def wait_until_finished(self):
-        """Drain the writer queue; re-raise the first writer error."""
-        self._queue.join()
+    def _raise_pending_error(self):
         with self._lock:
             if self._errors:
                 err = self._errors[0]
                 self._errors.clear()
                 raise err
+
+    def wait_until_finished(self):
+        """Drain the writer queue; re-raise the first writer error."""
+        self._queue.join()
+        self._raise_pending_error()
 
     def close(self):
         """Drain pending saves and stop the writer thread."""
@@ -213,17 +224,24 @@ class CheckpointManager:
         import jax
         from .. import profiler
         t0 = time.perf_counter()
-        snap = job.snapshot.materialize()   # waits on the in-flight DMA
+        snap = job.snapshot.materialize()   # no-op: capture() landed on host
         step = snap.step
         name = f"{self.step_prefix}-{step}"
         rank = jax.process_index()
         if "before_write" in self._test_hooks:
             self._test_hooks["before_write"]()
-        atomic_io.sweep_stale_staging(
-            self.directory, self.step_prefix,
-            keep={name + atomic_io.TMP_SUFFIX})
+        if rank == 0:
+            # Only the committing rank may sweep: a non-zero rank returns
+            # from the barrier before rank 0 has renamed the PREVIOUS step's
+            # staging dir, so its sweep could rmtree a dir rank 0 is about to
+            # os.replace. Rank 0's writer is serial — by the time it starts
+            # step N, step N-1 is committed.
+            atomic_io.sweep_stale_staging(
+                self.directory, self.step_prefix,
+                keep={name + atomic_io.TMP_SUFFIX})
         stage = atomic_io.staging_dir(self.directory, name)
         self._write_arrays(stage, snap, rank)
+        shard_ms = (time.perf_counter() - t0) * 1e3
         self._barrier()                     # every rank's shard is on disk
         if rank == 0:
             with open(os.path.join(stage, _META_FILE), "w") as f:
@@ -231,10 +249,15 @@ class CheckpointManager:
             atomic_io.commit_dir(self.directory, name, fsync=self.fsync,
                                  hooks=self._test_hooks)
             self._gc()
-        nbytes = atomic_io.dir_bytes(self.step_path(step))
-        profiler.record_checkpoint_commit(
-            (time.perf_counter() - t0) * 1e3,
-            (time.perf_counter() - job.t_enqueued) * 1e3, nbytes)
+            # commit stats only on the rank that committed — other ranks
+            # would read dir_bytes of a not-yet-renamed staging dir (0) and
+            # inflate the commits counter
+            profiler.record_checkpoint_commit(
+                (time.perf_counter() - t0) * 1e3,
+                (time.perf_counter() - job.t_enqueued) * 1e3,
+                atomic_io.dir_bytes(self.step_path(step)))
+        else:
+            profiler.record_checkpoint_shard_write(shard_ms)
 
     @staticmethod
     def _write_arrays(stage: str, snap: TrainingSnapshot, rank: int):
@@ -348,9 +371,12 @@ class CheckpointManager:
                                    state_fn: Optional[Callable[[], dict]] = None,
                                    signals=(signal.SIGTERM,)):
         """Hook SIGTERM (TPU fleet preemption notice) to run ONE final
-        blocking save and drain the writer, then chain to the previous
-        handler. ``state_fn`` may supply the save kwargs (must include
-        ``step``); otherwise the last saved step + 1 is used with the given
+        blocking save and drain the writer, then hand the signal back: a
+        previous Python handler is chained; the default disposition
+        (SIG_DFL, i.e. terminate) is restored and the signal re-delivered so
+        the preemption notice still kills the job; SIG_IGN stays ignored.
+        ``state_fn`` may supply the save kwargs (must include ``step``);
+        otherwise the last saved step + 1 is used with the given
         module/trainer."""
         if self._preempt_installed:
             return
@@ -358,6 +384,12 @@ class CheckpointManager:
 
         def _handler(signum, frame):
             try:
+                try:
+                    self._raise_pending_error()
+                except BaseException as e:
+                    # a stale async-writer failure must not abort the final save
+                    self.logger.warning("CheckpointManager: pending writer "
+                                        "error at preemption: %s", e)
                 if state_fn is not None:
                     kwargs = dict(state_fn())
                 else:
@@ -373,6 +405,13 @@ class CheckpointManager:
                 p = prev.get(signum)
                 if callable(p):
                     p(signum, frame)
+                elif p == signal.SIG_DFL:
+                    # the common previous disposition is the default action
+                    # (terminate) — restore it and re-deliver so the
+                    # preemption notice still kills the job after the save
+                    signal.signal(signum, signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+                # SIG_IGN / unknown (None): nothing to chain to
 
         for sig in signals:
             prev[sig] = signal.signal(sig, _handler)

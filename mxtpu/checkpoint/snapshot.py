@@ -1,13 +1,16 @@
 """Training-state snapshot: capture device state to host, and re-place it.
 
 ``capture`` walks the full training state — params, aux (BN running stats),
-Trainer/optimizer slots, the framework RNG key, and the loop counters — and
-starts a NON-BLOCKING device→host copy of every array
-(``jax.Array.copy_to_host_async``). The training step resumes immediately; the
-background writer calls ``materialize()`` which waits on the already-in-flight
-copies. This is the async half of the Orbax/TF-CheckpointManager design: the
-only synchronous cost on the training thread is snapshotting *references* and
-kicking off DMA.
+Trainer/optimizer slots, the framework RNG key, and the loop counters — in two
+passes: it first kicks off a device→host copy of every array
+(``jax.Array.copy_to_host_async``) so all DMAs overlap, then waits for them
+and returns a fully HOST-RESIDENT snapshot. Blocking on the copies before
+returning is load-bearing, not a convenience: the fused step executor and the
+optimizer donate their input buffers (``step_cache``/``optimizer``
+``donate_argnums``), so the next training step deletes the device arrays a
+reference-only snapshot would still point at. The training thread therefore
+pays only for the overlapped DMA; serialize+fsync+commit still happen on the
+background writer (the Orbax/TF-CheckpointManager split).
 
 ``apply_*`` are the duals: they push host arrays back into a live module /
 trainer, re-placing each array with its saved ``NamedSharding`` spec through
@@ -102,6 +105,9 @@ class TrainingSnapshot:
         self.meta = meta
 
     def materialize(self) -> "TrainingSnapshot":
+        """Idempotent safety net: ``capture`` already lands every array on the
+        host, so this is a no-op for its snapshots; hand-built snapshots that
+        still hold device arrays get converted here."""
         self.arrays = {k: _to_host(v) for k, v in self.arrays.items()}
         return self
 
@@ -166,6 +172,12 @@ def capture(step: int, module=None, trainer=None, arg_params=None,
         blob = rng_mod.get_state_blob()
         arrays["rng:key_data"] = blob["key_data"]
         rng_meta = {"trace_counter": blob["trace_counter"]}
+
+    # Wait on the in-flight copies and land everything on the host before
+    # returning: the caller's next training step may donate (and delete) the
+    # device buffers these entries reference (step_cache/optimizer
+    # donate_argnums), so the snapshot must not outlive them on device.
+    arrays = {k: _to_host(v) for k, v in arrays.items()}
 
     meta = {
         "format": FORMAT_VERSION,

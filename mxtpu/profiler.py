@@ -125,7 +125,8 @@ _CKPT_ZERO = {"saves": 0, "commits": 0, "restores": 0,
               "committed_bytes": 0,
               "blocked_step_ms_total": 0.0, "blocked_step_ms_last": 0.0,
               "save_latency_ms_total": 0.0, "save_latency_ms_last": 0.0,
-              "write_ms_last": 0.0}
+              "write_ms_last": 0.0,
+              "shard_writes": 0, "shard_write_ms_last": 0.0}
 _ckpt = dict(_CKPT_ZERO)
 
 
@@ -146,6 +147,14 @@ def record_checkpoint_commit(write_ms: float, latency_ms: float, nbytes: int):
     _ckpt["save_latency_ms_last"] = latency_ms
     _ckpt["save_latency_ms_total"] += latency_ms
     _ckpt["committed_bytes"] += int(nbytes)
+
+
+def record_checkpoint_shard_write(write_ms: float):
+    """Writer-thread side on ranks != 0: only this rank's shard write is
+    measured — commit stats (count/bytes) belong to rank 0, which owns the
+    rename and is the only rank that can see the final dir."""
+    _ckpt["shard_writes"] += 1
+    _ckpt["shard_write_ms_last"] = write_ms
 
 
 def record_checkpoint_restore():

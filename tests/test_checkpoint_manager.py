@@ -147,6 +147,39 @@ def test_crash_mid_save_never_exposes_torn_checkpoint(tmp_path):
     mgr.close()
 
 
+def test_capture_survives_donated_buffer_deletion(tmp_path):
+    """The fused step executor and optimizer donate their input buffers
+    (donate_argnums), so the training step AFTER an async save may delete
+    the very device arrays the snapshot references. capture() must land
+    everything on the host before save() returns."""
+    import jax.numpy as jnp
+    mgr = CheckpointManager(tmp_path)
+    w = jnp.arange(8, dtype=jnp.float32)
+    mgr.save(1, arg_params={"w": w})
+    w.delete()                      # what donation does to the source buffer
+    mgr.wait_until_finished()       # would raise 'array deleted' pre-fix
+    assert mgr.latest_step() == 1
+    np.testing.assert_array_equal(mgr.restore().arrays["arg:w"],
+                                  np.arange(8, dtype=np.float32))
+    mgr.close()
+
+
+def test_async_writer_error_surfaces_on_next_save(tmp_path):
+    """A failed async write must not stay silent until wait_until_finished:
+    the next save() re-raises it, then clears it so saving can continue."""
+    mgr = CheckpointManager(tmp_path)
+    arrs = {"w": np.ones(2, np.float32)}
+    mgr._test_hooks = {"before_write": _boom}
+    mgr.save(1, arg_params=arrs)          # async; writer fails in background
+    mgr._queue.join()
+    mgr._test_hooks = {}
+    with pytest.raises(_Boom):
+        mgr.save(2, arg_params=arrs)
+    mgr.save(2, arg_params=arrs, blocking=True)   # error consumed; works
+    assert mgr.latest_step() == 2
+    mgr.close()
+
+
 def test_sigkill_mid_save_subprocess(tmp_path):
     """A real process death (SIGKILL, no cleanup handlers) between the
     staging write and the COMMIT marker: the next process restores the
@@ -271,21 +304,52 @@ def test_do_checkpoint_with_manager_and_fit_roundtrip(tmp_path):
 def test_preemption_handler_sigterm_final_save(tmp_path):
     mgr = CheckpointManager(tmp_path)
     arrs = {"w": np.full(4, 7.0, np.float32)}
-    mgr.install_preemption_handler(
-        state_fn=lambda: {"step": 5, "arg_params": arrs,
-                          "epoch": 1, "nbatch": 2})
-    prev = signal.getsignal(signal.SIGTERM)
+    chained = []
+    # a Python-level previous handler must be chained to (SIG_DFL would
+    # re-deliver and terminate — covered by the subprocess test below)
+    outer = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
     try:
+        mgr.install_preemption_handler(
+            state_fn=lambda: {"step": 5, "arg_params": arrs,
+                              "epoch": 1, "nbatch": 2})
         os.kill(os.getpid(), signal.SIGTERM)
         # handler runs at the next bytecode boundary; force it
         signal.raise_signal(signal.SIGTERM) if not mgr.all_steps() else None
     finally:
-        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.signal(signal.SIGTERM, outer)
     assert mgr.latest_step() == 5
+    assert chained and chained[0] == signal.SIGTERM
     snap = mgr.restore()
     assert snap.meta["epoch"] == 1 and snap.meta["nbatch"] == 2
     np.testing.assert_array_equal(snap.arrays["arg:w"], arrs["w"])
     mgr.close()
+
+
+def test_preemption_handler_preserves_default_termination(tmp_path):
+    """With SIG_DFL as the previous disposition, the handler must restore it
+    and re-deliver after the final save: the preemption notice still kills
+    the job, and the checkpoint it saved is committed."""
+    script = r"""
+import os, signal, sys, time
+import numpy as np
+from mxtpu.checkpoint import CheckpointManager
+mgr = CheckpointManager(sys.argv[1])
+mgr.install_preemption_handler(
+    state_fn=lambda: {"step": 1,
+                      "arg_params": {"w": np.ones(2, np.float32)}})
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(60)
+print("SURVIVED")
+"""
+    r = subprocess.run([sys.executable, "-c", script, str(tmp_path)],
+                       capture_output=True, text=True,
+                       env=subprocess_env(), timeout=180)
+    assert r.returncode == -signal.SIGTERM, (r.returncode, r.stderr[-2000:])
+    assert "SURVIVED" not in r.stdout
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.latest_step() == 1
+    np.testing.assert_array_equal(mgr.restore().arrays["arg:w"],
+                                  np.ones(2, np.float32))
 
 
 def test_legacy_layout_compat_roundtrip(tmp_path):
@@ -313,6 +377,20 @@ def test_legacy_layout_compat_roundtrip(tmp_path):
     # a newer native step shadows the legacy epoch
     mgr.save(3, arg_params={"fc_weight": arg["fc_weight"]}, blocking=True)
     assert mgr.all_steps() == [2, 3] and mgr.latest_step() == 3
+    mgr.close()
+
+
+def test_legacy_discovery_five_digit_epoch(tmp_path):
+    """save_legacy writes {epoch:04d}, which is 5+ digits for epoch >=
+    10000 — discovery must still find those files."""
+    prefix = str(tmp_path / "leg")
+    arg = {"w": nd.array(np.ones(2, np.float32))}
+    mx.model.save_checkpoint(prefix, 12345, None, arg, {})
+    mgr = CheckpointManager(tmp_path, legacy_prefix=prefix)
+    assert mgr.all_steps() == [12345]
+    snap = mgr.restore()
+    np.testing.assert_array_equal(snap.arrays["arg:w"],
+                                  np.ones(2, np.float32))
     mgr.close()
 
 
