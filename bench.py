@@ -31,6 +31,22 @@ import sys
 import time
 from typing import Optional
 
+# CPU-fallback child (re-exec'd by main()): the platform MUST be forced
+# before ANY jax import — the environment's sitecustomize boots the `axon`
+# TPU plugin at interpreter start and pins JAX_PLATFORMS=axon, overriding the
+# env var the parent passed, so the BENCH_r05 child crashed initializing the
+# very backend it was escaping. The config route below flips an
+# already-initialized process to cpu (same trick as
+# mxtpu.parallel.mesh.force_virtual_cpu_devices).
+if os.environ.get("MXTPU_BENCH_FALLBACK") == "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax as _jax_boot
+
+        _jax_boot.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # no jax at all: main() emits the error JSON line
+
 import numpy as np
 
 BASELINE_IMG_S = 109.0  # ResNet-50, 1x K80, batch 32 (BASELINE.md row 5)
@@ -859,18 +875,13 @@ def bench_comm():
     return out
 
 
-def bench_cpu_fallback():
-    """Reduced harness for hosts where the TPU backend won't initialize
-    (BENCH_r05 regression: rc=1 'Unable to initialize backend'). Emits the
-    single-line JSON with ``"fallback": "cpu"`` instead of crashing: a
-    LeNet-scale training loop through the Module API — which also exercises
-    the fused StepExecutor path — sized to finish in seconds on one core."""
-    import jax
+def _lenet_module(batch: int):
+    """LeNet-scale Module on the fused StepExecutor path — shared by the
+    cpu-fallback harness and the input_pipeline scenario."""
     import mxtpu as mx
-    from mxtpu import nd, profiler
     from mxtpu.gluon import nn
     from mxtpu.gluon.block import HybridBlock
-    from mxtpu.io import DataBatch, DataDesc
+    from mxtpu.io import DataDesc
 
     class LeNet(HybridBlock):
         def __init__(self):
@@ -888,10 +899,6 @@ def bench_cpu_fallback():
             x = self.p2(self.c2(x).relu())
             return self.fc2(self.fc1(self.flat(x)).relu())
 
-    batch, steps = 32, 20
-    rs = np.random.RandomState(0)
-    x = nd.array(rs.rand(batch, 1, 28, 28).astype(np.float32))
-    y = nd.array(rs.randint(0, 10, batch).astype(np.float32))
     mod = mx.Module(LeNet(), data_names=("data",),
                     label_names=("softmax_label",))
     mod.bind(data_shapes=[DataDesc("data", (batch, 1, 28, 28))],
@@ -900,6 +907,136 @@ def bench_cpu_fallback():
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.05,
                                          "momentum": 0.9})
+    return mod
+
+
+class _SyntheticDecodeIter:
+    """Input-bound synthetic loader: each batch costs ``decode_ms`` of host
+    work (the decode/augment stand-in) before it is placed — the workload
+    whose stall the device feed exists to hide."""
+
+    def __init__(self, n_batches: int, batch: int, decode_ms: float):
+        from mxtpu.io import DataDesc
+        self.batch_size = batch
+        self.n_batches = n_batches
+        self.decode_ms = decode_ms
+        self._rs = np.random.RandomState(0)
+        self._pool = [self._rs.rand(batch, 1, 28, 28).astype(np.float32)
+                      for _ in range(4)]
+        self._labels = self._rs.randint(0, 10, batch).astype(np.float32)
+        self._i = 0
+        self.provide_data = [DataDesc("data", (batch, 1, 28, 28))]
+        self.provide_label = [DataDesc("softmax_label", (batch,))]
+
+    def reset(self):
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from mxtpu import nd
+        from mxtpu.io import DataBatch
+        if self._i >= self.n_batches:
+            raise StopIteration
+        time.sleep(self.decode_ms / 1e3)          # the emulated decode
+        src = self._pool[self._i % len(self._pool)]
+        self._i += 1
+        return DataBatch(data=[nd.array(src)],
+                         label=[nd.array(self._labels)])
+
+
+def bench_input_pipeline(steps: int = 48, batch: int = 32,
+                         decode_ms: float = 6.0):
+    """Device-feed scenario: an input-bound synthetic loader driving the
+    fused LeNet step, sync per-batch placement vs the async DeviceFeed path.
+    Reports steps/sec and the input-stall fraction for both; the feed path
+    must show the LOWER stall fraction (producer decode overlaps the step).
+    Runs to completion on the cpu fallback — it is part of that harness."""
+    from mxtpu import profiler
+    from mxtpu.device_feed import DeviceFeed
+
+    mod = _lenet_module(batch)
+    loader = _SyntheticDecodeIter(steps, batch, decode_ms)
+    # warm the step compile outside both timed legs — BOTH input flavors:
+    # jax.jit specializes on committed-ness, so the fed (committed) batch
+    # compiles a second executable the sync (uncommitted) one doesn't cover
+    warm = _SyntheticDecodeIter(1, batch, 0.0)
+    b0 = warm.next()
+    mod.forward_backward(b0)
+    mod.update()
+    warm_feed = DeviceFeed(_SyntheticDecodeIter(1, batch, 0.0), depth=1)
+    for b in warm_feed:
+        mod.forward_backward(b)
+        mod.update()
+
+    # leg 1 — sync path (what MXTPU_DEVICE_FEED=0 training does): the step
+    # loop eats the full decode+transfer latency of every batch
+    loader.reset()
+    input_wait = 0.0
+    t0 = time.perf_counter()
+    it = iter(loader)
+    while True:
+        t1 = time.perf_counter()
+        try:
+            b = next(it)
+        except StopIteration:
+            break
+        input_wait += time.perf_counter() - t1
+        mod.forward_backward(b)
+        mod.update()
+    sync_wall = time.perf_counter() - t0
+    sync = {"steps_per_s": round(steps / sync_wall, 2),
+            "stall_frac": round(input_wait / sync_wall, 3)}
+
+    # leg 2 — device feed: producer decodes/places ahead, the loop's only
+    # input cost is the (ideally empty) queue wait
+    loader.reset()
+    profiler.reset_feed_stats()
+    feed = DeviceFeed(loader, depth=2)
+    t0 = time.perf_counter()
+    for b in feed:
+        mod.forward_backward(b)
+        mod.update()
+    feed_wall = time.perf_counter() - t0
+    fstats = profiler.get_feed_stats()
+    dfeed = {"steps_per_s": round(steps / feed_wall, 2),
+             "stall_frac": round(
+                 fstats["stall_ms_total"] / 1e3 / max(feed_wall, 1e-9), 3),
+             "transfer_mb": round(fstats["transfer_bytes"] / 1e6, 2),
+             "transfer_ms": round(fstats["transfer_ms_total"], 1),
+             "queue_depth_max": fstats["queue_depth_max"],
+             "batches_prefetched": fstats["batches_prefetched"]}
+
+    out = {"sync": sync, "device_feed": dfeed,
+           "decode_ms": decode_ms, "batch": batch, "steps": steps,
+           "speedup": round(dfeed["steps_per_s"] / max(sync["steps_per_s"],
+                                                       1e-9), 3)}
+    log(f"[input_pipeline] sync: {sync['steps_per_s']} steps/s "
+        f"(stall {sync['stall_frac']:.0%}) | device-feed: "
+        f"{dfeed['steps_per_s']} steps/s (stall {dfeed['stall_frac']:.0%}, "
+        f"queue hw {dfeed['queue_depth_max']}) -> {out['speedup']}x")
+    return out
+
+
+def bench_cpu_fallback():
+    """Reduced harness for hosts where the TPU backend won't initialize
+    (BENCH_r05 regression: rc=1 'Unable to initialize backend'). Emits the
+    single-line JSON with ``"fallback": "cpu"`` instead of crashing: a
+    LeNet-scale training loop through the Module API — which also exercises
+    the fused StepExecutor path — sized to finish in seconds on one core."""
+    import jax
+    from mxtpu import nd, profiler
+    from mxtpu.io import DataBatch
+
+    batch, steps = 32, 20
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(batch, 1, 28, 28).astype(np.float32))
+    y = nd.array(rs.randint(0, 10, batch).astype(np.float32))
+    mod = _lenet_module(batch)
     b = DataBatch(data=[x], label=[y])
     mod.forward_backward(b)       # compile + first step
     mod.update()
@@ -911,10 +1048,12 @@ def bench_cpu_fallback():
     loss_end = float(mod._loss_val.mean().data)
     dt = time.perf_counter() - t0
     img_s = steps * batch / dt
-    caches = profiler.get_compile_stats()
-    # the checkpoint scenario reuses the trained LeNet module — the fallback
-    # path must keep emitting the same keys as the full harness
+    # the checkpoint + input-pipeline scenarios reuse the trained LeNet
+    # module — the fallback path must keep emitting the same keys as the
+    # full harness
     ckpt = bench_checkpoint(module=mod)
+    pipe = bench_input_pipeline()
+    caches = profiler.get_compile_stats()
     log(f"[cpu-fallback] lenet b{batch}: {img_s:.0f} img/s, loss "
         f"{loss_start:.3f} -> {loss_end:.3f}, "
         f"step traces={caches.get('module_step', {}).get('traces')}")
@@ -927,6 +1066,7 @@ def bench_cpu_fallback():
         "loss_start": round(loss_start, 3),
         "loss_end": round(loss_end, 3),
         "checkpoint": ckpt,
+        "input_pipeline": pipe,
         "compile_caches": caches,
     }))
 
@@ -954,6 +1094,10 @@ def main():
         log(f"[bench] accelerator backend unavailable ({err}); "
             "re-executing with JAX_PLATFORMS=cpu")
         env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_BENCH_FALLBACK="1")
+        # the TPU-claim gate re-arms the axon plugin in every fresh
+        # interpreter — the child must never touch the backend that just
+        # failed (BENCH_r05: the re-exec'd child crashed initializing axon)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)], env)
     if os.environ.get("MXTPU_BENCH_FALLBACK") == "1" \
@@ -976,6 +1120,7 @@ def main():
     i8 = bench_int8()
     comm = bench_comm()
     ckpt = bench_checkpoint()
+    feed_pipe = bench_input_pipeline()
 
     best_tag = max(train, key=lambda t: train[t]["img_s"])
     best = train[best_tag]
@@ -996,6 +1141,7 @@ def main():
         "int8": i8,
         "comm": comm,
         "checkpoint": ckpt,
+        "input_pipeline": feed_pipe,
         "compile_caches": _compile_caches(),
     }))
 

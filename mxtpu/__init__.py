@@ -44,7 +44,8 @@ _SUBSYSTEMS = ["initializer", "optimizer", "lr_scheduler", "metric", "callback",
                "io", "recordio", "kvstore", "symbol", "gluon", "module", "parallel",
                "profiler", "test_utils", "model", "image", "visualization",
                "contrib", "operator", "monitor", "rtc", "capi", "rnn",
-               "attribute", "engine", "serving", "step_cache", "checkpoint"]
+               "attribute", "engine", "serving", "step_cache", "checkpoint",
+               "device_feed"]
 for _name in _SUBSYSTEMS:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)

@@ -16,7 +16,11 @@ class BatchEndParam(NamedTuple):
 
 
 class Speedometer:
-    """Throughput logger (callback.py Speedometer): samples/sec every ``frequent``."""
+    """Throughput logger (callback.py Speedometer): samples/sec every
+    ``frequent``. When the device-feed input pipeline is active, each line
+    also reports the input-stall per batch since the last print and the
+    prefetch queue high-water mark (``profiler.get_feed_stats()``) — the
+    at-a-glance "is training input-bound?" readout."""
 
     def __init__(self, batch_size: int, frequent: int = 50, auto_reset: bool = True):
         self.batch_size = batch_size
@@ -25,6 +29,21 @@ class Speedometer:
         self.init = False
         self.tic = 0.0
         self.last_count = 0
+        self._feed_consumed = 0
+        self._feed_stall_ms = 0.0
+
+    def _feed_msg(self) -> str:
+        """Δ input-stall per batch since the last print ('' if no feed ran)."""
+        from . import profiler
+        f = profiler.get_feed_stats()
+        consumed = f["batches_consumed"] - self._feed_consumed
+        stall = f["stall_ms_total"] - self._feed_stall_ms
+        self._feed_consumed = f["batches_consumed"]
+        self._feed_stall_ms = f["stall_ms_total"]
+        if consumed <= 0:
+            return ""
+        return (f"\tinput-stall: {stall / consumed:.2f} ms/batch "
+                f"(queue hw {f['queue_depth_max']}/{f['feed_depth']})")
 
     def __call__(self, param: BatchEndParam):
         count = param.nbatch
@@ -37,16 +56,17 @@ class Speedometer:
                 # (coarse clocks / fused fast steps) — never divide by zero
                 elapsed = max(time.time() - self.tic, 1e-9)
                 speed = self.frequent * self.batch_size / elapsed
+                feed = self._feed_msg()
                 if param.eval_metric is not None:
                     nv = param.eval_metric.get_name_value()
                     if self.auto_reset:
                         param.eval_metric.reset()
                     msg = "\t".join(f"{n}={v:.6f}" for n, v in nv)
-                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
-                                 param.epoch, count, speed, msg)
+                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s%s",
+                                 param.epoch, count, speed, msg, feed)
                 else:
-                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
+                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                                 param.epoch, count, speed, feed)
                 self.tic = time.time()
         else:
             self.init = True

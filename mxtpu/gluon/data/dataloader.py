@@ -36,12 +36,20 @@ def default_batchify_fn(data):
 
 
 class DataLoader:
+    """``ctx``/``sharding`` turn on the device boundary: when a target
+    device, mesh, or ``NamedSharding`` is given, ``__iter__`` routes batches
+    through a ``device_feed.DeviceFeed`` — a producer thread keeps the next
+    ``feed_depth`` batches resident on-device (sharded, committed,
+    non-blocking ``device_put``) so the training step never waits on the
+    host. Stall/transfer accounting: ``profiler.get_feed_stats()``."""
+
     def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
                  shuffle: bool = False, sampler: Optional[Sampler] = None,
                  last_batch: Optional[str] = None,
                  batch_sampler: Optional[BatchSampler] = None,
                  batchify_fn: Optional[Callable] = None, num_workers: int = 0,
-                 prefetch: Optional[int] = None):
+                 prefetch: Optional[int] = None, ctx=None, sharding=None,
+                 feed_depth: Optional[int] = None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -57,11 +65,13 @@ class DataLoader:
         self._num_workers = max(0, num_workers)
         self._prefetch = max(1, prefetch if prefetch is not None
                              else 2 * max(1, self._num_workers))
+        self._placement = ctx if ctx is not None else sharding
+        self._feed_depth = feed_depth
 
     def _load_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
-    def __iter__(self):
+    def _batches(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
@@ -81,6 +91,18 @@ class DataLoader:
                 except StopIteration:
                     pass
                 yield batch
+
+    def __iter__(self):
+        if self._placement is None:
+            yield from self._batches()
+            return
+        from ...device_feed import DeviceFeed
+        feed = DeviceFeed(self._batches(), depth=self._feed_depth,
+                          placement=self._placement)
+        try:
+            yield from feed
+        finally:
+            feed.close()  # early break: stop the producer, drop its queue
 
     def __len__(self):
         return len(self._batch_sampler)

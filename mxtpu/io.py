@@ -382,11 +382,42 @@ class ResizeIter(DataIter):
         return self.data_iter.provide_label
 
 
+class _PrefetchGen:
+    """One producer lifetime: the thread is handed THIS object's queue and
+    stop flag, so a straggler that outlives a ``reset()`` (join timeout while
+    blocked in the backing iterator) can only ever see its own abandoned
+    queue — it can neither hang on nor leak stale batches into the
+    replacement generation (the old implementation cleared the shared stop
+    flag and swapped ``self._queue``, so a timed-out producer woke up
+    pointing at the NEW queue)."""
+
+    __slots__ = ("queue", "stop", "thread", "error")
+
+    def __init__(self, prefetch: int):
+        self.queue: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self.stop = threading.Event()
+        self.thread = None
+        self.error: Optional[BaseException] = None
+
+    def put(self, item) -> bool:
+        """Stop-aware put: False once this generation is abandoned."""
+        while not self.stop.is_set():
+            try:
+                self.queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+
 class PrefetchingIter(DataIter):
     """Double-buffered producer thread (io.py PrefetchingIter ≈ iter_prefetcher.h).
 
     Exceptions in the producer are re-raised at next() — the reference's
     exception-propagation contract (docs/architecture/exception_handling.md).
+    The exception is additionally latched on the generation, so it surfaces
+    even when the queue handoff is lost (e.g. the producer died while its
+    queue was full and the consumer only polls afterwards).
     """
 
     def __init__(self, iters, rename_data=None, rename_label=None, prefetch: int = 2):
@@ -395,50 +426,62 @@ class PrefetchingIter(DataIter):
         super().__init__(iters[0].batch_size)
         self.iter = iters[0]
         self._prefetch = prefetch
-        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch)
-        self._stop = threading.Event()
-        self._started = False
+        self._gen: Optional[_PrefetchGen] = None
 
-    def _put(self, item) -> bool:
-        """Stop-aware put: returns False if reset() asked the producer to die."""
-        while not self._stop.is_set():
-            try:
-                self._queue.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def _producer(self):
+    def _producer(self, gen: _PrefetchGen):
         try:
-            for batch in self.iter:
-                if not self._put(("data", batch)):
+            src = iter(self.iter)  # adapters may reset in __iter__
+            while not gen.stop.is_set():
+                try:
+                    batch = next(src)
+                except StopIteration:
+                    break
+                if not gen.put(("data", batch)):
                     return
-        except Exception as e:  # propagate to consumer at next()
-            self._put(("error", e))
+        except Exception as e:  # latch + propagate to consumer at next()
+            gen.error = e
+            gen.put(("error", e))
             return
-        self._put(("end", None))
+        gen.put(("end", None))
 
-    def _ensure(self):
-        if not self._started:
-            self._thread = threading.Thread(target=self._producer, daemon=True)
-            self._thread.start()
-            self._started = True
+    def _ensure(self) -> _PrefetchGen:
+        if self._gen is None:
+            gen = _PrefetchGen(self._prefetch)
+            gen.thread = threading.Thread(target=self._producer, args=(gen,),
+                                          daemon=True)
+            gen.thread.start()
+            self._gen = gen
+        return self._gen
 
     def reset(self):
-        if self._started:
-            # kill the producer before touching the backing iterator, or a blocked
-            # put would keep draining the freshly-reset iter
-            self._stop.set()
-            self._thread.join(timeout=10)
-            self._stop.clear()
+        gen, self._gen = self._gen, None
+        if gen is not None:
+            # abandon the generation BEFORE touching the backing iterator, or
+            # a blocked put would keep draining the freshly-reset iter; the
+            # stop flag stays set forever, so even a join timeout cannot
+            # produce a straggler that touches the next generation
+            gen.stop.set()
+            try:  # wake a put blocked on a full queue
+                gen.queue.get_nowait()
+            except queue.Empty:
+                pass
+            if gen.thread is not None:
+                gen.thread.join(timeout=10)
         self.iter.reset()
-        self._queue = queue.Queue(maxsize=self._prefetch)
-        self._started = False
 
     def next(self):
-        self._ensure()
-        kind, payload = self._queue.get()
+        gen = self._ensure()
+        while True:
+            try:
+                kind, payload = gen.queue.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if gen.error is not None:
+                    raise gen.error
+                if gen.thread is not None and not gen.thread.is_alive():
+                    raise RuntimeError(
+                        "PrefetchingIter producer thread died without "
+                        "delivering a batch or an exception")
         if kind == "error":
             raise payload
         if kind == "end":
@@ -461,13 +504,21 @@ def ImageRecordIter(path_imgrec: str, data_shape, batch_size: int,
                     mean_r: float = 0, mean_g: float = 0, mean_b: float = 0,
                     std_r: float = 1, std_g: float = 1, std_b: float = 1,
                     resize: int = 0, dtype: str = "float32",
+                    ctx=None, device_feed: Optional[bool] = None,
                     **kwargs) -> DataIter:
     """ImageRecordIter parity (iter_image_recordio_2.cc): RecordIO → threaded decode/
     augment → NCHW batches, wrapped in a prefetcher.
 
     ``dtype='uint8'`` emits raw NCHW uint8 batches (no normalize) — the
     feed-to-accelerator layout where normalization runs on-device and the
-    wire carries 1 byte/px."""
+    wire carries 1 byte/px.
+
+    The reference's ``prefetch_buffer``/``preprocess_threads`` knobs also
+    parameterize the device boundary: the returned iterator advertises them
+    (``device_feed_depth``) so ``Module.fit``'s implicit ``DeviceFeed`` wrap
+    prefetches ``prefetch_buffer`` batches device-resident with no code
+    changes. Pass ``ctx=`` (a Context/device/mesh) or ``device_feed=True``
+    to get the wrapped pipeline directly."""
     from .image import ImageIter
     mean = None
     if mean_r or mean_g or mean_b:
@@ -479,8 +530,16 @@ def ImageRecordIter(path_imgrec: str, data_shape, batch_size: int,
                    shuffle=shuffle, resize=resize, rand_crop=rand_crop,
                    rand_mirror=rand_mirror, mean=mean, std=std,
                    preprocess_threads=preprocess_threads, dtype=dtype)
-    return PrefetchingIter(_ImageIterAdapter(it, batch_size),
-                           prefetch=prefetch_buffer)
+    out = PrefetchingIter(_ImageIterAdapter(it, batch_size),
+                          prefetch=prefetch_buffer)
+    # knob propagation into the DeviceFeed wrapper (maybe_device_feed reads
+    # device_feed_depth; preprocess_threads is advertised for introspection)
+    out.device_feed_depth = prefetch_buffer
+    out.preprocess_threads = preprocess_threads
+    if ctx is not None or device_feed:
+        from .device_feed import DeviceFeed
+        return DeviceFeed(out, depth=prefetch_buffer, placement=ctx)
+    return out
 
 
 class _ImageIterAdapter(DataIter):

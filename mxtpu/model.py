@@ -139,6 +139,11 @@ class FeedForward:
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
             eval_end_callback=None, eval_batch_end_callback=None):
+        """Delegates to ``Module.fit`` — which routes the train iterator
+        through the device-feed input pipeline (``device_feed.DeviceFeed``:
+        async prefetch of device-resident batches; opt-out
+        ``MXTPU_DEVICE_FEED=0``), so the legacy estimator surface gets the
+        overlapped host→device boundary for free."""
         assert self.num_epoch is not None, "num_epoch required"
         data = self._init_iter(X, y, is_train=True)
         if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:

@@ -173,8 +173,19 @@ class BaseModule:
         saved epoch/nbatch. A checkpoint without a committed step is a no-op
         (fresh start), so the same launch command works for both the first
         run and every preemption restart.
+
+        The train iterator is routed through a ``device_feed.DeviceFeed``
+        (opt-out: ``MXTPU_DEVICE_FEED=0``; depth: ``MXTPU_FEED_DEPTH``): a
+        producer thread keeps the next batches device-resident so the step
+        never waits on host decode + transfer. Input-stall and transfer
+        accounting land in ``profiler.get_feed_stats()`` and are logged per
+        epoch.
         """
         assert num_epoch is not None, "num_epoch required"
+        from . import profiler
+        from .device_feed import DeviceFeed, maybe_device_feed
+        train_data = maybe_device_feed(train_data)
+        feed_on = isinstance(train_data, DeviceFeed)
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True)
         self.init_params(initializer=initializer, arg_params=arg_params,
@@ -208,6 +219,7 @@ class BaseModule:
             tic = time.time()
             eval_metric.reset()
             train_data.reset()
+            feed0 = profiler.get_feed_stats() if feed_on else None
             for nbatch, data_batch in enumerate(train_data):
                 if resume_nbatch is not None and epoch == begin_epoch \
                         and nbatch <= resume_nbatch:
@@ -225,6 +237,19 @@ class BaseModule:
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            if feed0 is not None:
+                f = profiler.get_feed_stats()
+                consumed = f["batches_consumed"] - feed0["batches_consumed"]
+                if consumed:
+                    self.logger.info(
+                        "Epoch[%d] Input: stall=%.1f ms, h2d=%.2f MB in "
+                        "%.1f ms, prefetched=%d consumed=%d, queue hw=%d/%d",
+                        epoch,
+                        f["stall_ms_total"] - feed0["stall_ms_total"],
+                        (f["transfer_bytes"] - feed0["transfer_bytes"]) / 1e6,
+                        f["transfer_ms_total"] - feed0["transfer_ms_total"],
+                        f["batches_prefetched"] - feed0["batches_prefetched"],
+                        consumed, f["queue_depth_max"], f["feed_depth"])
             if epoch_end_callback is not None:
                 arg, aux = self.get_params()
                 for cb in _as_list(epoch_end_callback):

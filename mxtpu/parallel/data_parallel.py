@@ -53,12 +53,20 @@ def shard_batch(array, mesh: Optional[Mesh] = None, axis: int = 0) -> NDArray:
     """Place a host batch as a dp-sharded jax.Array (≈ decide_slices/_split_input_slice,
     executor_group.py:281-310 — but one logical array, no per-device copies).
 
-    Multi-process: ``array`` is this rank's LOCAL batch shard."""
+    Multi-process: ``array`` is this rank's LOCAL batch shard.
+
+    An array already committed with the target sharding (e.g. staged by a
+    ``device_feed.DeviceFeed`` ahead of the step) is returned as-is — the
+    step path never double-``device_put``s resident inputs."""
     mesh = mesh or get_default_mesh()
     spec = [None] * (array.ndim if hasattr(array, "ndim") else len(array.shape))
     spec[axis] = mesh.axis_names[0]
     raw = array.data if isinstance(array, NDArray) else jnp.asarray(array)
-    return NDArray(_place(raw, NamedSharding(mesh, P(*spec))))
+    target = NamedSharding(mesh, P(*spec))
+    if isinstance(raw, jax.Array) and getattr(raw, "committed", False) \
+            and raw.sharding == target:
+        return array if isinstance(array, NDArray) else NDArray(raw)
+    return NDArray(_place(raw, target))
 
 
 def replicate(array, mesh: Optional[Mesh] = None) -> NDArray:
@@ -303,6 +311,20 @@ class DataParallelTrainer:
 
     def step(self, x, y) -> float:
         return float(self.step_async(x, y).data)
+
+    def device_feed(self, batches, depth: Optional[int] = None):
+        """Wrap an iterable of ``(x, y)`` batches (or ``DataBatch``es) in a
+        ``device_feed.DeviceFeed`` committed to this trainer's dp batch
+        sharding: a producer thread keeps the next ``depth`` batches resident
+        across the mesh, and ``step_async``'s ``shard_batch`` recognizes them
+        as placed (no second ``device_put``). Multi-process: each rank feeds
+        its LOCAL shard, exactly like ``shard_batch``. ::
+
+            for x, y in dpt.device_feed(loader):
+                dpt.step_async(x, y)
+        """
+        from ..device_feed import DeviceFeed
+        return DeviceFeed(batches, depth=depth, placement=self.mesh)
 
     def cost_analysis(self) -> dict:
         """XLA's own cost model for the compiled step (flops, bytes accessed).

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -111,7 +112,8 @@ def dumps(reset: bool = False) -> str:
     else:
         out = json.dumps({"traceEvents": _state["events"],
                           "compileCaches": get_compile_stats(),
-                          "checkpoint": get_checkpoint_stats()})
+                          "checkpoint": get_checkpoint_stats(),
+                          "deviceFeed": get_feed_stats()})
     if reset:
         _state["events"] = []
     return out
@@ -170,6 +172,76 @@ def get_checkpoint_stats() -> dict:
 
 def reset_checkpoint_stats():
     _ckpt.update(_CKPT_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# device-feed observability (mxtpu.device_feed input-pipeline counters)
+# ---------------------------------------------------------------------------
+
+_FEED_ZERO = {"batches_prefetched": 0, "batches_consumed": 0,
+              "transfer_count": 0, "resident_skips": 0,
+              "transfer_bytes": 0, "transfer_ms_total": 0.0,
+              "stall_ms_total": 0.0, "stall_ms_last": 0.0,
+              "queue_depth_max": 0, "feed_depth": 0}
+_feed = dict(_FEED_ZERO)
+# the feed's producer thread and the training (consumer) thread both write
+_feed_lock = threading.Lock()
+
+
+def record_feed_transfer(nbytes: int, ms: float):
+    """Producer-thread side: one array dispatched through the host→device
+    boundary (``ms`` is the non-blocking dispatch wall time)."""
+    with _feed_lock:
+        _feed["transfer_count"] += 1
+        _feed["transfer_bytes"] += int(nbytes)
+        _feed["transfer_ms_total"] += ms
+
+
+def record_feed_resident():
+    """Producer-thread side: an array already committed with the target
+    sharding was NOT re-transferred — the double-``device_put`` guard
+    counter."""
+    with _feed_lock:
+        _feed["resident_skips"] += 1
+
+
+def record_feed_prefetch(queue_depth: int):
+    """Producer-thread side: one batch staged device-resident; samples the
+    queue-depth high-water mark."""
+    with _feed_lock:
+        _feed["batches_prefetched"] += 1
+        if queue_depth > _feed["queue_depth_max"]:
+            _feed["queue_depth_max"] = queue_depth
+
+
+def record_feed_consume(stall_ms: float):
+    """Consumer-thread side: one batch taken; ``stall_ms`` is how long the
+    step loop was blocked waiting on data (the input-stall metric)."""
+    with _feed_lock:
+        _feed["batches_consumed"] += 1
+        _feed["stall_ms_last"] = stall_ms
+        _feed["stall_ms_total"] += stall_ms
+
+
+def set_feed_depth(depth: int):
+    with _feed_lock:
+        _feed["feed_depth"] = int(depth)
+
+
+def get_feed_stats() -> dict:
+    """Input-pipeline counters (input-stall ms, transfer bytes/ms, queue-depth
+    high-water mark, batches prefetched vs consumed) — the observability
+    contract of the device-feed pipeline. ``Speedometer`` prints these;
+    ``bench.py input_pipeline`` reads them as the stall-fraction source of
+    truth. Counters are monotone until :func:`reset_feed_stats`."""
+    with _feed_lock:
+        return dict(_feed)
+
+
+def reset_feed_stats():
+    """Zero the feed counters (tests, per-epoch accounting, bench legs)."""
+    with _feed_lock:
+        _feed.update(_FEED_ZERO)
 
 
 # ---------------------------------------------------------------------------

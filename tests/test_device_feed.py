@@ -1,0 +1,465 @@
+"""Device-feed input pipeline (mxtpu/device_feed.py): async sharded
+host→device prefetch.
+
+Covers the ISSUE-3 contract: bit-exact fused-step parity with the feed on
+vs off (epoch boundaries, padded last batch, reset mid-epoch),
+donation-safety under ``donate_argnums`` (no feeder-held references, no
+re-enqueued buffers), producer-exception propagation, monotone stall
+counters zeroed on reset, and multi-device sharded placement on the CPU
+mesh — plus the PrefetchingIter lifecycle fixes that rode along (reset race,
+error latch)."""
+
+import gc
+import time
+import weakref
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxtpu as mx
+from mxtpu import nd, profiler
+from mxtpu.device_feed import DeviceFeed, default_depth, maybe_device_feed
+from mxtpu.gluon import nn
+from mxtpu.gluon.block import HybridBlock
+from mxtpu.io import DataBatch, DataIter, NDArrayIter, PrefetchingIter
+from mxtpu.parallel.mesh import data_parallel_mesh
+
+
+def _xy(n=30, feat=9, classes=4, seed=1):
+    X = np.random.RandomState(seed).rand(n, feat).astype(np.float32)
+    Y = np.random.RandomState(seed + 1).randint(0, classes, n).astype(np.float32)
+    return X, Y
+
+
+class LeNetish(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2D(4, kernel_size=3, in_channels=1)
+        self.p1 = nn.MaxPool2D(pool_size=2)
+        self.flat = nn.Flatten()
+        self.fc = nn.Dense(10, in_units=4 * 5 * 5)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.p1(self.c1(x).relu())))
+
+
+# ---------------------------------------------------------------------------
+# parity: feed on vs off must be bit-exact
+# ---------------------------------------------------------------------------
+
+
+def _fit_lenet(monkeypatch, feed_on: bool, num_epoch: int = 3, n: int = 30,
+               batch: int = 8):
+    """Fused-step LeNet fit; returns final params keyed by short suffix.
+    n=30/batch=8 exercises a padded last batch every epoch."""
+    monkeypatch.setenv("MXTPU_DEVICE_FEED", "1" if feed_on else "0")
+    mx.rng.seed(0)
+    rs = np.random.RandomState(3)
+    X = rs.rand(n, 1, 12, 12).astype(np.float32)
+    Y = rs.randint(0, 10, n).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=batch)
+    mod = mx.Module(LeNetish(), data_names=("data",),
+                    label_names=("softmax_label",))
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    arg, _ = mod.get_params()
+    return {k.split("_", 1)[1]: v.asnumpy() for k, v in arg.items()}
+
+
+def test_fused_fit_parity_feed_on_vs_off(monkeypatch):
+    a = _fit_lenet(monkeypatch, feed_on=True)
+    b = _fit_lenet(monkeypatch, feed_on=False)
+    assert sorted(a) == sorted(b)
+    for k in a:
+        assert np.array_equal(a[k], b[k]), f"param {k} diverged with feed on"
+
+
+def test_feed_values_and_epoch_boundaries():
+    X, Y = _xy(n=20)
+    ref = NDArrayIter(X, Y, batch_size=8)           # 20 → 3 batches, pad=4
+    feed = DeviceFeed(NDArrayIter(X, Y, batch_size=8), depth=2)
+    for _ in range(2):                              # two full epochs
+        ref.reset()
+        feed.reset()
+        got = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+               for b in feed]
+        want = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+                for b in ref]
+        assert len(got) == len(want) == 3
+        for (xg, yg, pg), (xw, yw, pw) in zip(got, want):
+            assert np.array_equal(xg, xw)
+            assert np.array_equal(yg, yw)
+            assert pg == pw
+
+
+def test_reset_mid_epoch_restarts_cleanly():
+    X, Y = _xy(n=32)
+    feed = DeviceFeed(NDArrayIter(X, Y, batch_size=8), depth=2)
+    first = feed.next().data[0].asnumpy()
+    feed.next()                                     # consume a second batch
+    feed.reset()                                    # mid-epoch
+    batches = list(feed)
+    assert len(batches) == 4                        # full epoch, no stale tail
+    assert np.array_equal(batches[0].data[0].asnumpy(), first)
+
+
+def test_delivered_batches_are_device_resident():
+    X, Y = _xy(n=16)
+    feed = DeviceFeed(NDArrayIter(X, Y, batch_size=8), depth=2)
+    b = feed.next()
+    for arr in (b.data[0], b.label[0]):
+        assert isinstance(arr.data, jax.Array)
+        assert arr.data.committed      # placed, not just default-device lazy
+    feed.close()
+
+
+# ---------------------------------------------------------------------------
+# donation safety
+# ---------------------------------------------------------------------------
+
+
+def test_donation_safety_no_feeder_refs_and_no_reenqueue():
+    """Once the consumer takes a batch, the feeder must hold NO reference to
+    its buffers (a donate_argnums step may invalidate them), and a buffer
+    must never be delivered twice."""
+    X, Y = _xy(n=48)
+    feed = DeviceFeed(NDArrayIter(X, Y, batch_size=8), depth=2)
+    b = feed.next()
+    first_refs = [weakref.ref(b.data[0]), weakref.ref(b.label[0])]
+    # let the producer run ahead, then simulate donation: delete the buffer
+    time.sleep(0.1)
+    b.data[0].data.delete()
+    del b
+    gc.collect()
+    assert all(r() is None for r in first_refs), \
+        "feeder (or queue) still references a delivered batch"
+    # pin every remaining delivered buffer alive so id() can't be recycled,
+    # then check uniqueness: a buffer must never be delivered twice
+    delivered = [bb.data[0].data for bb in feed]   # works past the deletion
+    assert len(delivered) == 5
+    assert len({id(a) for a in delivered}) == 5, "buffer re-enqueued"
+
+
+def test_donated_step_consumes_fed_batch():
+    """An actual donate_argnums program consuming fed batches: the pipeline
+    must never touch a delivered buffer again (donation on cpu is a no-op
+    warning, but the reference-dropping contract is what's under test)."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    def consume(x):                    # stand-in for the fused step
+        return jnp.sum(x * 2.0)
+
+    donating = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+    X, Y = _xy(n=24)
+    feed = DeviceFeed(NDArrayIter(X, Y, batch_size=8), depth=2)
+    total = 0.0
+    for b in feed:
+        total += float(consume(b.data[0].data))
+        donating(b.data[0].data)       # donates (or warns+copies on cpu)
+    assert np.isfinite(total)
+
+
+# ---------------------------------------------------------------------------
+# exception propagation + lifecycle
+# ---------------------------------------------------------------------------
+
+
+class _BoomIter(DataIter):
+    """Yields ``good`` batches then raises — producer-exception fixture."""
+
+    def __init__(self, good: int = 2, batch: int = 4):
+        super().__init__(batch)
+        self.good = good
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self.good:
+            raise ValueError("decode exploded")
+        self._i += 1
+        return DataBatch(data=[nd.array(np.ones((self.batch_size, 3),
+                                                np.float32))],
+                         label=[nd.array(np.zeros(self.batch_size,
+                                                  np.float32))])
+
+
+def test_producer_exception_reraised_in_consumer():
+    feed = DeviceFeed(_BoomIter(good=2), depth=2)
+    assert feed.next() is not None
+    assert feed.next() is not None
+    with pytest.raises(ValueError, match="decode exploded"):
+        feed.next()
+    # and again after reset (fresh generation hits the same error)
+    feed.reset()
+    feed.next()
+    feed.next()
+    with pytest.raises(ValueError, match="decode exploded"):
+        feed.next()
+
+
+def test_single_pass_iterable_refuses_reset():
+    feed = DeviceFeed(iter([np.ones(3, np.float32)]), depth=1)
+    assert isinstance(feed.next(), nd.NDArray)
+    with pytest.raises(RuntimeError, match="single-pass"):
+        feed.reset()
+    feed.close()
+
+
+def test_maybe_device_feed_env_gate(monkeypatch):
+    it = NDArrayIter(*_xy(n=16), batch_size=8)
+    monkeypatch.setenv("MXTPU_DEVICE_FEED", "0")
+    assert maybe_device_feed(it) is it
+    monkeypatch.setenv("MXTPU_DEVICE_FEED", "1")
+    wrapped = maybe_device_feed(it)
+    assert isinstance(wrapped, DeviceFeed)
+    assert maybe_device_feed(wrapped) is wrapped    # no double wrap
+    monkeypatch.setenv("MXTPU_FEED_DEPTH", "5")
+    assert default_depth() == 5
+    wrapped.close()
+
+
+def test_depth_knob_propagates_from_iterator_attr(monkeypatch):
+    monkeypatch.setenv("MXTPU_DEVICE_FEED", "1")
+    it = NDArrayIter(*_xy(n=16), batch_size=8)
+    it.device_feed_depth = 7                        # ImageRecordIter-style
+    wrapped = maybe_device_feed(it)
+    assert isinstance(wrapped, DeviceFeed) and wrapped.depth == 7
+    wrapped.close()
+
+
+# ---------------------------------------------------------------------------
+# stall accounting
+# ---------------------------------------------------------------------------
+
+
+class _SlowIter(DataIter):
+    def __init__(self, n: int = 6, batch: int = 4, delay_s: float = 0.02):
+        super().__init__(batch)
+        self.n, self.delay_s = n, delay_s
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self.n:
+            raise StopIteration
+        self._i += 1
+        time.sleep(self.delay_s)
+        return DataBatch(data=[nd.array(np.full((self.batch_size, 2),
+                                                self._i, np.float32))],
+                         label=[nd.array(np.zeros(self.batch_size,
+                                                  np.float32))])
+
+
+def test_stall_counters_monotone_and_zeroed_on_reset():
+    profiler.reset_feed_stats()
+    feed = DeviceFeed(_SlowIter(n=6), depth=2)
+    last_stall, last_consumed = -1.0, -1
+    for _ in feed:
+        s = profiler.get_feed_stats()
+        assert s["stall_ms_total"] >= last_stall          # monotone
+        assert s["batches_consumed"] > last_consumed
+        last_stall = s["stall_ms_total"]
+        last_consumed = s["batches_consumed"]
+    s = profiler.get_feed_stats()
+    assert s["batches_consumed"] == 6
+    assert s["batches_prefetched"] == 6
+    assert s["transfer_count"] == 12                      # data + label each
+    assert s["transfer_bytes"] > 0
+    assert s["stall_ms_total"] > 0                        # slow producer
+    assert 0 < s["queue_depth_max"] <= s["feed_depth"] == 2
+    profiler.reset_feed_stats()
+    z = profiler.get_feed_stats()
+    assert all(not v for v in z.values()), f"not zeroed: {z}"
+
+
+def test_feed_stats_in_profiler_dumps():
+    profiler.reset_feed_stats()
+    feed = DeviceFeed(_SlowIter(n=2, delay_s=0.0), depth=1)
+    list(feed)
+    import json
+    payload = json.loads(profiler.dumps())
+    assert payload["deviceFeed"]["batches_consumed"] == 2
+
+
+def test_speedometer_prints_input_stall(caplog):
+    import logging
+    from mxtpu.callback import BatchEndParam, Speedometer
+    profiler.reset_feed_stats()
+    feed = DeviceFeed(_SlowIter(n=4, delay_s=0.0), depth=2)
+    list(feed)
+    spd = Speedometer(batch_size=4, frequent=1)
+    with caplog.at_level(logging.INFO):
+        spd(BatchEndParam(0, 0, None))          # arms the meter
+        spd(BatchEndParam(0, 1, None))
+        spd(BatchEndParam(0, 2, None))
+    assert any("input-stall" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# sharded placement on the CPU mesh
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_placement_multi_device():
+    mesh = data_parallel_mesh()                     # 8 virtual cpu devices
+    n_dev = mesh.devices.size
+    X, Y = _xy(n=4 * n_dev)
+    feed = DeviceFeed(NDArrayIter(X, Y, batch_size=2 * n_dev),
+                      placement=mesh, depth=2)
+    seen = 0
+    for b in feed:
+        raw = b.data[0].data
+        assert raw.committed
+        assert raw.sharding == NamedSharding(mesh, P("dp", None))
+        lab = b.label[0].data
+        assert lab.sharding == NamedSharding(mesh, P("dp"))
+        assert np.array_equal(
+            b.data[0].asnumpy(),
+            X[seen * 2 * n_dev:(seen + 1) * 2 * n_dev])
+        seen += 1
+    assert seen == 2
+
+
+def test_sharded_placement_uneven_batch_replicates():
+    mesh = data_parallel_mesh()
+    if mesh.devices.size < 2:
+        pytest.skip("needs multi-device mesh")
+    X, Y = _xy(n=7)                                 # 7 % 8 != 0
+    feed = DeviceFeed(NDArrayIter(X, Y, batch_size=7), placement=mesh)
+    b = feed.next()
+    assert b.data[0].data.sharding == NamedSharding(mesh, P())
+    assert np.array_equal(b.data[0].asnumpy(), X)
+    feed.close()
+
+
+def test_dpt_device_feed_shard_batch_noop():
+    from mxtpu import optimizer as opt_mod
+    from mxtpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxtpu.parallel import DataParallelTrainer, shard_batch
+    mesh = data_parallel_mesh()
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    dpt = DataParallelTrainer(net, SoftmaxCrossEntropyLoss(),
+                              opt_mod.SGD(learning_rate=0.1), mesh)
+    rs = np.random.RandomState(0)
+    batches = [(rs.rand(16, 3).astype(np.float32),
+                rs.randint(0, 4, 16).astype(np.float32)) for _ in range(3)]
+    profiler.reset_feed_stats()
+    for x, y in dpt.device_feed(iter(batches)):
+        # the feed already placed it: shard_batch must hand back the SAME
+        # buffer (no double device_put of resident arrays)
+        assert shard_batch(x, mesh).data is x.data
+        loss = dpt.step(x, y)
+        assert np.isfinite(loss)
+    s = profiler.get_feed_stats()
+    assert s["transfer_count"] == 6 and s["batches_consumed"] == 3
+
+
+def test_dataloader_ctx_feeds_device(monkeypatch):
+    from mxtpu.gluon.data import ArrayDataset, DataLoader
+    X, Y = _xy(n=16)
+    ds = ArrayDataset(nd.array(X), nd.array(Y))
+    dev = jax.local_devices()[0]
+    profiler.reset_feed_stats()
+    loader = DataLoader(ds, batch_size=4, ctx=dev)
+    n = 0
+    for xb, yb in loader:
+        assert xb.data.committed
+        assert np.array_equal(xb.asnumpy(), X[n * 4:(n + 1) * 4])
+        n += 1
+    assert n == 4
+    assert profiler.get_feed_stats()["batches_consumed"] == 4
+    # plain loader (no ctx): unchanged path, no feed involvement
+    profiler.reset_feed_stats()
+    assert len(list(DataLoader(ds, batch_size=4))) == 4
+    assert profiler.get_feed_stats()["batches_consumed"] == 0
+
+
+def test_image_record_iter_device_feed_knobs(tmp_path):
+    import io as pyio
+    from PIL import Image
+    from mxtpu import recordio
+    from mxtpu.io import ImageRecordIter
+    path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rs = np.random.RandomState(0)
+    for i in range(8):
+        buf = pyio.BytesIO()
+        Image.fromarray(rs.randint(0, 255, (16, 16, 3)).astype(np.uint8)) \
+            .save(buf, format="JPEG")
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i % 2), i, 0),
+                                buf.getvalue()))
+    rec.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                         batch_size=4, prefetch_buffer=3)
+    # knob propagation: fit's implicit wrap reads these
+    assert it.device_feed_depth == 3
+    assert it.preprocess_threads == 4
+    wrapped = maybe_device_feed(it)
+    assert isinstance(wrapped, DeviceFeed) and wrapped.depth == 3
+    b = wrapped.next()
+    assert b.data[0].shape == (4, 3, 16, 16)
+    wrapped.close()
+    # direct device_feed=True construction
+    it2 = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                          batch_size=4, device_feed=True)
+    assert isinstance(it2, DeviceFeed)
+    assert it2.next().data[0].data.committed
+    it2.close()
+
+
+# ---------------------------------------------------------------------------
+# PrefetchingIter lifecycle fixes (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prefetching_iter_error_latched_and_reraised():
+    pf = PrefetchingIter(_BoomIter(good=1), prefetch=2)
+    assert pf.next() is not None
+    with pytest.raises(ValueError, match="decode exploded"):
+        pf.next()
+    pf.reset()                          # restarts cleanly after the error
+    assert pf.next() is not None
+    with pytest.raises(ValueError, match="decode exploded"):
+        pf.next()
+
+
+def test_prefetching_iter_reset_no_stale_batches():
+    """reset() mid-epoch must abandon in-flight batches: the next epoch
+    starts from batch 0 with exactly the full batch count (the old
+    implementation could leak a straggler's stale batch into the new
+    queue)."""
+    X = np.arange(64, dtype=np.float32).reshape(16, 4)
+    Y = np.zeros(16, np.float32)
+    pf = PrefetchingIter(NDArrayIter(X, Y, batch_size=4), prefetch=2)
+    for trial in range(3):
+        first = pf.next()
+        assert np.array_equal(first.data[0].asnumpy(), X[:4]), \
+            f"trial {trial}: stale batch after reset"
+        pf.reset()
+    batches = list(pf)
+    assert len(batches) == 4
+    assert np.array_equal(batches[0].data[0].asnumpy(), X[:4])
+
+
+def test_prefetching_iter_reset_while_producer_blocked():
+    """The producer blocked on a FULL queue at reset() time must die (or be
+    permanently fenced) instead of hanging the reset or draining the
+    freshly-reset iterator."""
+    slow = _SlowIter(n=50, delay_s=0.0)
+    pf = PrefetchingIter(slow, prefetch=1)
+    pf.next()
+    time.sleep(0.05)                    # queue fills; producer blocks on put
+    t0 = time.perf_counter()
+    pf.reset()
+    assert time.perf_counter() - t0 < 5.0, "reset hung on a blocked producer"
+    got = list(pf)
+    assert len(got) == 50               # complete fresh epoch

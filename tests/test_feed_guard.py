@@ -1,0 +1,67 @@
+"""Transfer-count regression guard (tier-1 CI) — style of
+test_compile_guard.py.
+
+The device-feed pipeline is only a win while each batch crosses the
+host→device boundary EXACTLY once. This guard runs a 3-epoch LeNet
+``Module.fit`` through the implicit DeviceFeed wrap and fails if the feed's
+transfer counters show a second ``device_put`` of an already-resident array
+(or a batch that bypassed accounting entirely) — so future PRs can't
+silently reintroduce per-batch re-placement in the step loop.
+"""
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import profiler
+from mxtpu.gluon import nn
+from mxtpu.gluon.block import HybridBlock
+from mxtpu.io import NDArrayIter
+
+
+class GuardNet(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2D(4, kernel_size=3, in_channels=1)
+        self.p1 = nn.MaxPool2D(pool_size=2)
+        self.flat = nn.Flatten()
+        self.fc = nn.Dense(10, in_units=4 * 5 * 5)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.p1(self.c1(x).relu())))
+
+
+def test_lenet_fit_one_transfer_per_batch(monkeypatch):
+    monkeypatch.setenv("MXTPU_DEVICE_FEED", "1")
+    batch, n, epochs = 8, 32, 3
+    batches_per_epoch = n // batch
+    profiler.reset_feed_stats()
+    profiler.reset_compile_stats()
+    mx.rng.seed(0)
+    rs = np.random.RandomState(0)
+    it = NDArrayIter(rs.rand(n, 1, 12, 12).astype(np.float32),
+                     rs.randint(0, 10, n).astype(np.float32), batch)
+    mod = mx.Module(GuardNet(), data_names=("data",),
+                    label_names=("softmax_label",))
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+
+    s = profiler.get_feed_stats()
+    total_batches = epochs * batches_per_epoch
+    assert s["batches_consumed"] == total_batches, s
+    # every batch is (data, label): at most ONE host→device transfer each —
+    # an array placed by the feed must never be device_put a second time
+    arrays = 2 * total_batches
+    assert s["transfer_count"] + s["resident_skips"] == arrays, s
+    assert s["transfer_count"] <= arrays, \
+        f"more transfers than arrays fed — double device_put: {s}"
+    assert s["resident_skips"] == 0, \
+        f"arrays arrived pre-placed yet were re-staged upstream: {s}"
+    assert s["transfer_bytes"] > 0 and s["queue_depth_max"] >= 1
+
+    # and the feed must not perturb the whole-step compile cache: one train
+    # signature for the fixed-shape loop (test_compile_guard contract)
+    step = profiler.get_compile_stats().get("module_step",
+                                            {"traces": 0, "hits": 0})
+    assert step["traces"] <= 1, \
+        f"device feed caused step retracing: {step}"
+    assert step["hits"] >= total_batches - 1
