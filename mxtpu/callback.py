@@ -31,6 +31,8 @@ class Speedometer:
         self.last_count = 0
         self._feed_consumed = 0
         self._feed_stall_ms = 0.0
+        self._comm_steps = 0
+        self._comm_bytes = 0
 
     def _feed_msg(self) -> str:
         """Δ input-stall per batch since the last print ('' if no feed ran)."""
@@ -45,6 +47,23 @@ class Speedometer:
         return (f"\tinput-stall: {stall / consumed:.2f} ms/batch "
                 f"(queue hw {f['queue_depth_max']}/{f['feed_depth']})")
 
+    def _comm_msg(self) -> str:
+        """Δ gradient-comm per step since the last print ('' when no ZeRO
+        steps ran) — the at-a-glance "what does a step ship over ICI?"
+        readout (``profiler.get_comm_stats()``)."""
+        from . import profiler
+        c = profiler.get_comm_stats()
+        steps = c["zero_steps"] - self._comm_steps
+        total = c["bytes_reduced"] + c["bytes_gathered"]
+        delta = total - self._comm_bytes
+        self._comm_steps = c["zero_steps"]
+        self._comm_bytes = total
+        if steps <= 0:
+            return ""
+        return (f"\tcomm: {delta / steps / 1e6:.2f} MB/step "
+                f"(ZeRO-1 dp={c['dp']}, {c['bucket_count']} bucket(s), "
+                f"shard {c['shard_bytes_per_device'] / 1e6:.2f} MB/dev)")
+
     def __call__(self, param: BatchEndParam):
         count = param.nbatch
         if self.last_count > count:
@@ -56,7 +75,7 @@ class Speedometer:
                 # (coarse clocks / fused fast steps) — never divide by zero
                 elapsed = max(time.time() - self.tic, 1e-9)
                 speed = self.frequent * self.batch_size / elapsed
-                feed = self._feed_msg()
+                feed = self._feed_msg() + self._comm_msg()
                 if param.eval_metric is not None:
                     nv = param.eval_metric.get_name_value()
                     if self.auto_reset:

@@ -158,12 +158,28 @@ def capture(step: int, module=None, trainer=None, arg_params=None,
             state_slots.append(len(st))
             for j, s in enumerate(st):
                 _add(f"opt:{i}:{j}", s)
+        # ZeRO-1 slots: per-bucket dp-sharded flat arrays. _add records the
+        # NamedSharding spec and _to_host lands the GLOBAL bucket (deduped
+        # shards), so restore can re-pad for a DIFFERENT dp degree.
+        zero_meta = None
+        if getattr(trainer, "_zero_layout", None) is not None:
+            zslots: List[int] = []
+            for b, st in enumerate(trainer._zero_states):
+                zslots.append(len(st))
+                for j, s in enumerate(st):
+                    _add(f"zopt:{b}:{j}", s)
+            for b, r in enumerate(trainer._zero_residuals or []):
+                if r is not None:
+                    _add(f"zres:{b}", r)
+            zero_meta = {"layout": trainer._zero_layout.describe(),
+                         "slots": zslots}
         trainer_meta = {
             "optimizer": type(opt).__name__,
             "num_update": int(opt.num_update),
             "counts": {str(k): int(v)
                        for k, v in opt._index_update_count.items()},
             "state_slots": state_slots,
+            "zero": zero_meta,
         }
 
     rng_meta = None
@@ -295,6 +311,19 @@ def apply_trainer(snapshot: TrainingSnapshot, trainer, mesh=None):
                 restored_array(snapshot, f"opt:{i}:{j}", mesh)
                 for j in range(n)))
     trainer._states = states
+    zmeta = tmeta.get("zero")
+    if zmeta is not None:
+        # the bucket layout is (re)built lazily by the fused step executor —
+        # stage the host arrays; StepExecutor._ensure_zero_states adopts them
+        # (stripping the saved padding and re-padding for the CURRENT dp
+        # degree, so a restore onto a different mesh size re-shards instead
+        # of crashing)
+        zarrays = {k: np.asarray(v) for k, v in snapshot.arrays.items()
+                   if k.startswith(("zopt:", "zres:"))}
+        trainer._zero_restore = (zmeta, zarrays)
+        trainer._zero_layout = None
+        trainer._zero_states = []
+        trainer._zero_residuals = []
     opt.num_update = int(tmeta.get("num_update", 0))
     opt._index_update_count = {int(k): int(v)
                                for k, v in tmeta.get("counts", {}).items()}

@@ -33,6 +33,13 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._compression_params = compression_params
         self._kv_initialized = False
+        # ZeRO-1 slots (owned by the fused StepExecutor when zero_requested():
+        # per-BUCKET dp-sharded flat arrays instead of per-param tuples);
+        # _zero_restore stages a checkpointed state until the layout exists
+        self._zero_layout = None
+        self._zero_states: List = []
+        self._zero_residuals: List = []
+        self._zero_restore = None
         # bulked update: ONE jitted program applying the optimizer to every
         # parameter (vs one dispatch per param), cached by signature
         self._bulk_cache: Dict[tuple, object] = {}
@@ -59,6 +66,25 @@ class Trainer:
             if update_on_kv:
                 kvs.set_optimizer(self._optimizer)
         self._kv_initialized = True
+
+    def zero_requested(self) -> bool:
+        """True when this trainer's kvstore type selects the ZeRO-1 sharded
+        gradient/update path (the fused step's dataflow: bucketed
+        reduce-scatter → 1/N-sharded optimizer slots → all-gather;
+        parallel/zero.py). The reference's ``device``/``dist_sync`` types map
+        here — exactly the types whose KVStore sharded state across
+        devices/servers. ``local`` kvstores, an explicit
+        ``update_on_kvstore=True`` (server-side updates), ``MXTPU_ZERO=0``,
+        and non-elementwise optimizers all keep the replicated-psum path."""
+        from ..parallel import zero as zero_mod
+        self._init_kvstore()
+        if self._kvstore is None or self._update_on_kvstore is True:
+            return False
+        if self._kvstore.type not in ("tpu", "dist", "dist_sync",
+                                      "dist_device_sync"):
+            return False
+        return zero_mod.zero_enabled() \
+            and zero_mod.supports_zero(self._optimizer)
 
     @property
     def learning_rate(self) -> float:

@@ -264,10 +264,17 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params: dict):
-        """2-bit gradient compression parity (gradient_compression.h:37): quantize to
-        {-threshold, 0, +threshold} with error-feedback residual before reduction."""
-        if compression_params.get("type", "2bit") != "2bit":
-            raise ValueError("only 2bit compression is supported (reference parity)")
+        """Gradient compression with error-feedback residual before reduction
+        (gradient_compression.h:37). Kinds: ``2bit`` quantizes to
+        {-threshold, 0, +threshold} (reference parity); ``fp16``/``bf16``
+        lower the comm-payload dtype (the wire/collective carries half-width
+        grads; the cast error re-enters the next push via the residual).
+        Unknown kinds are rejected up front — a silent ignore here would
+        train uncompressed while the user budgets wire bandwidth for
+        compressed. The same dict drives the ZeRO-1 fused step's bucket
+        payload (``parallel/zero.py``) when this store backs a Trainer."""
+        from .parallel import zero as zero_mod
+        zero_mod.comm_dtype_of(compression_params)   # validates the kind
         self._compression_params = dict(compression_params)
         self._residuals: Dict[Any, jnp.ndarray] = {}
 
@@ -291,24 +298,33 @@ class KVStore:
         return _sparse.RowSparseNDArray(rows, vals, red.shape)
 
     def _compress_encode(self, key, grad):
-        """2-bit quantization with error-feedback residual
-        (gradient_compression.h:37-134): returns int8 codes in {-1, 0, +1};
-        the decoded value is ``codes * threshold``. int8 (not 2-bit packed) is
-        the practical XLA-collective payload — still a 4x wire saving vs f32."""
-        thr = float(self._compression_params.get("threshold", 0.5))
+        """Worker-side encode with error-feedback residual
+        (gradient_compression.h:37-134). ``2bit``: int8 codes in {-1, 0, +1},
+        decoded as ``codes * threshold`` (int8, not 2-bit packed, is the
+        practical XLA-collective payload — still 4x vs f32). ``fp16``/
+        ``bf16``: the codes ARE the half-width gradient (2x wire saving);
+        either way the quantization error stays per-rank and re-enters the
+        next push."""
+        kind = self._compression_params.get("type", "2bit")
         res = self._residuals.get(key)
         if res is None:
             res = jnp.zeros_like(grad)
         g = grad + res
-        codes = (jnp.where(g >= thr, 1, 0) +
-                 jnp.where(g <= -thr, -1, 0)).astype(jnp.int8)
+        if kind == "2bit":
+            thr = float(self._compression_params.get("threshold", 0.5))
+            codes = (jnp.where(g >= thr, 1, 0) +
+                     jnp.where(g <= -thr, -1, 0)).astype(jnp.int8)
+        else:
+            codes = g.astype(jnp.float16 if kind == "fp16" else jnp.bfloat16)
         self._residuals[key] = g - self._decode(codes).astype(g.dtype)
         return codes
 
     def _decode(self, codes):
         """Inverse of _compress_encode (threshold lives in one place)."""
-        thr = float(self._compression_params.get("threshold", 0.5))
-        return codes.astype(jnp.float32) * thr
+        if self._compression_params.get("type", "2bit") == "2bit":
+            thr = float(self._compression_params.get("threshold", 0.5))
+            return codes.astype(jnp.float32) * thr
+        return codes.astype(jnp.float32)
 
     def save_optimizer_states(self, fname: str, dump_optimizer: bool = False):
         if self._async:

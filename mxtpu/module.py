@@ -184,8 +184,6 @@ class BaseModule:
         assert num_epoch is not None, "num_epoch required"
         from . import profiler
         from .device_feed import DeviceFeed, maybe_device_feed
-        train_data = maybe_device_feed(train_data)
-        feed_on = isinstance(train_data, DeviceFeed)
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True)
         self.init_params(initializer=initializer, arg_params=arg_params,
@@ -193,6 +191,21 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        # ZeRO-1 fits feed batches pre-sharded over the dp mesh so the fused
+        # step's shard_batch sees them resident (no second device_put)
+        feed_placement = None
+        zero_on = False
+        tr = getattr(self, "_trainer", None)
+        if tr is not None:
+            try:
+                zero_on = tr.zero_requested()
+            except Exception:
+                zero_on = False
+        if zero_on:
+            from .parallel.mesh import get_default_mesh
+            feed_placement = get_default_mesh()
+        train_data = maybe_device_feed(train_data, placement=feed_placement)
+        feed_on = isinstance(train_data, DeviceFeed)
         resume_nbatch = None
         if resume_from is not None:
             from .checkpoint import CheckpointManager
@@ -220,6 +233,7 @@ class BaseModule:
             eval_metric.reset()
             train_data.reset()
             feed0 = profiler.get_feed_stats() if feed_on else None
+            comm0 = profiler.get_comm_stats() if zero_on else None
             for nbatch, data_batch in enumerate(train_data):
                 if resume_nbatch is not None and epoch == begin_epoch \
                         and nbatch <= resume_nbatch:
@@ -250,6 +264,21 @@ class BaseModule:
                         f["transfer_ms_total"] - feed0["transfer_ms_total"],
                         f["batches_prefetched"] - feed0["batches_prefetched"],
                         consumed, f["queue_depth_max"], f["feed_depth"])
+            if comm0 is not None:
+                c = profiler.get_comm_stats()
+                zsteps = c["zero_steps"] - comm0["zero_steps"]
+                if zsteps:
+                    self.logger.info(
+                        "Epoch[%d] Comm (ZeRO-1, dp=%d): %.2f MB reduce-"
+                        "scatter + %.2f MB all-gather per step over %d "
+                        "bucket(s); %.2f MB optimizer shard per device",
+                        epoch, c["dp"],
+                        (c["bytes_reduced"] - comm0["bytes_reduced"])
+                        / max(zsteps, 1) / 1e6,
+                        (c["bytes_gathered"] - comm0["bytes_gathered"])
+                        / max(zsteps, 1) / 1e6,
+                        c["bucket_count"],
+                        c["shard_bytes_per_device"] / 1e6)
             if epoch_end_callback is not None:
                 arg, aux = self.get_params()
                 for cb in _as_list(epoch_end_callback):
@@ -457,8 +486,13 @@ class Module(BaseModule):
             tr._init_kvstore()
         except Exception:
             return False
-        if tr._kvstore is not None and getattr(tr, "_update_on_kv", False):
-            return False     # server-side updates can't fuse into the step
+        if tr._kvstore is not None and getattr(tr, "_update_on_kv", False) \
+                and not tr.zero_requested():
+            # server-side updates can't fuse into the step — EXCEPT when the
+            # ZeRO path takes over: its in-program reduce-scatter over the
+            # (process-spanning) dp mesh IS the dist_sync reduction, so the
+            # fused step replaces the push/pull round-trip entirely
+            return False
         opt = tr._optimizer
         if getattr(opt, "multi_precision", False):
             return False

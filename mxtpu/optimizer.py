@@ -33,6 +33,12 @@ def create(name, **kwargs) -> "Optimizer":
 
 
 class Optimizer:
+    # ZeRO-1 eligibility (parallel/zero.py): True when the update math is
+    # purely elementwise, so concatenating params into flat buckets and
+    # updating each device's shard is exact. Norm-coupled (LBSGD) or
+    # noise-injecting (SGLD) optimizers must opt out.
+    elementwise = True
+
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
         capture_init_spec(cls)
@@ -273,6 +279,8 @@ class Signum(Optimizer):
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (optimizer.py SGLD)."""
 
+    elementwise = False          # injects fresh noise per param (custom update)
+
     def update(self, index, weight, grad, state):
         from . import rng
         self._update_count(index)
@@ -482,6 +490,8 @@ class FTML(Optimizer):
 @register(name="lbsgd")
 class LBSGD(SGD):
     """Large-batch SGD with LARS-style layer-wise adaptive rate (optimizer.py LBSGD)."""
+
+    elementwise = False          # layer-wise norms couple the whole tensor
 
     def __init__(self, warmup_strategy: str = "linear", warmup_epochs: int = 5,
                  batch_scale: float = 1.0, updates_per_epoch: int = 32, **kwargs):

@@ -29,7 +29,22 @@ from .mesh import Mesh, get_default_mesh
 
 __all__ = ["allreduce", "allreduce_array", "allgather_array", "broadcast_array",
            "reduce_scatter_array", "all_to_all_array", "barrier", "psum",
-           "pmean", "all_gather", "reduce_scatter", "ppermute", "all_to_all"]
+           "pmean", "all_gather", "reduce_scatter", "ppermute", "all_to_all",
+           "shard_map_compat"]
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = False):
+    """Version-portable shard_map: jax ≥ 0.5 exposes top-level
+    ``jax.shard_map(..., check_vma=)``; 0.4.x ships
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)``. Every
+    shard_map in the framework (collectives, ring attention, MoE dispatch,
+    GPipe) routes through here so the dual-API dance lives in ONE place."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check)
 
 # -- in-program collectives (use inside shard_map/pjit bodies) --------------
 psum = lax.psum
@@ -47,7 +62,7 @@ def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0, tiled: bool = 
 # -- array-level collectives ------------------------------------------------
 
 def _shard_map_1d(fn, mesh: Mesh, in_spec, out_spec):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec)
+    return shard_map_compat(fn, mesh, in_spec, out_spec)
 
 
 def allreduce_array(x, mesh: Optional[Mesh] = None, op: str = "sum"):
@@ -65,8 +80,7 @@ def allreduce_array(x, mesh: Optional[Mesh] = None, op: str = "sum"):
         r = lax.psum(v, axis)
         return r / mesh.shape[axis] if op == "mean" else r
 
-    fn = jax.shard_map(_psum, mesh=mesh, in_specs=P(), out_specs=P(),
-                       check_vma=False)
+    fn = shard_map_compat(_psum, mesh, P(), P())
     return fn(jnp.asarray(x))
 
 
@@ -85,8 +99,7 @@ def allgather_array(x, mesh: Optional[Mesh] = None, axis: int = 0):
     def _ag(v):
         return lax.all_gather(v, ax_name, axis=axis, tiled=True)
 
-    fn = jax.shard_map(_ag, mesh=mesh, in_specs=P(*spec), out_specs=P(),
-                       check_vma=False)
+    fn = shard_map_compat(_ag, mesh, P(*spec), P())
     return fn(jnp.asarray(x))
 
 
@@ -101,8 +114,7 @@ def reduce_scatter_array(x, mesh: Optional[Mesh] = None, axis: int = 0):
     def _rs(v):
         return lax.psum_scatter(v, ax_name, scatter_dimension=axis, tiled=True)
 
-    fn = jax.shard_map(_rs, mesh=mesh, in_specs=P(), out_specs=P(*spec),
-                       check_vma=False)
+    fn = shard_map_compat(_rs, mesh, P(), P(*spec))
     return fn(jnp.asarray(x))
 
 
@@ -125,8 +137,7 @@ def all_to_all_array(x, mesh: Optional[Mesh] = None, split_axis: int = 1,
         return lax.all_to_all(v, ax_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
 
-    fn = jax.shard_map(_a2a, mesh=mesh, in_specs=P(*in_spec),
-                       out_specs=P(*out_spec), check_vma=False)
+    fn = shard_map_compat(_a2a, mesh, P(*in_spec), P(*out_spec))
     return fn(jnp.asarray(x))
 
 
@@ -164,16 +175,24 @@ def _process_exchange(x, body):
     """Shared cross-process plumbing: stack each rank's host value on a 'proc'
     axis, run `body` replicated, return the host-local result. Both
     allreduce_processes and allgather_processes ride this one path so
-    transport fixes land once."""
+    transport fixes land once. Wall time + payload bytes land in the
+    profiler's comm counters (``get_comm_stats().collective_*``) — the
+    measured half of the comm-accounting story (the in-program ZeRO
+    collectives are accounted analytically per step)."""
+    import time
     import numpy as np
+    from .. import profiler
+    t0 = time.perf_counter()
     mesh = _process_mesh()
     sh = NamedSharding(mesh, P("proc"))
-    arr = jax.make_array_from_process_local_data(
-        sh, np.asarray(jax.device_get(jnp.asarray(x)))[None])
+    local = np.asarray(jax.device_get(jnp.asarray(x)))[None]
+    arr = jax.make_array_from_process_local_data(sh, local)
     fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
     out = fn(arr)
     jax.block_until_ready(out)
-    return jnp.asarray(jax.device_get(out))
+    res = jnp.asarray(jax.device_get(out))
+    profiler.record_collective((time.perf_counter() - t0) * 1e3, local.nbytes)
+    return res
 
 
 def allreduce_processes(x, op: str = "sum"):

@@ -17,7 +17,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["Mesh", "NamedSharding", "P", "force_virtual_cpu_devices",
-           "make_mesh", "data_parallel_mesh",
+           "make_mesh", "data_parallel_mesh", "dp_axis_name", "dp_size",
            "get_default_mesh", "set_default_mesh"]
 
 _default_mesh: Optional[Mesh] = None
@@ -38,6 +38,17 @@ def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     n = num_devices or len(devs)
     return make_mesh((n,), ("dp",), devs[:n])
+
+
+def dp_axis_name(mesh: Mesh) -> str:
+    """The data-parallel axis by convention: the mesh's FIRST named axis
+    (batches shard over it; ZeRO-1 shards gradients/optimizer state over it)."""
+    return mesh.axis_names[0]
+
+
+def dp_size(mesh: Mesh) -> int:
+    """Degree of the data-parallel axis — the N in ZeRO's 1/N state shards."""
+    return int(mesh.shape[mesh.axis_names[0]])
 
 
 def get_default_mesh() -> Mesh:

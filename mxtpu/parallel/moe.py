@@ -79,8 +79,9 @@ def expert_parallel_ffn(router_w, w1, w2, x, mesh: Optional[Mesh] = None,
         y = jnp.where(keep[:, None], y * gate[:, None], 0.0)
         return y
 
-    fn = jax.shard_map(
-        spmd, mesh=mesh,
-        in_specs=(P(), P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=P(axis_name))
+    from .collectives import shard_map_compat
+    fn = shard_map_compat(
+        spmd, mesh,
+        (P(), P(axis_name), P(axis_name), P(axis_name)),
+        P(axis_name))
     return fn(router_w, w1, w2, x)

@@ -74,9 +74,10 @@ def gpipe(stage_fn: Callable, stacked_params, x, mesh: Optional[Mesh] = None,
         return ys                                        # (n_steps, B, ...)
 
     params_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    fn = jax.shard_map(spmd, mesh=mesh,
-                   in_specs=(params_spec, P()),          # stream replicated
-                   out_specs=P())
+    from .collectives import shard_map_compat
+    fn = shard_map_compat(spmd, mesh,
+                          (params_spec, P()),            # stream replicated
+                          P())
     ys = fn(stacked_params, stream)
     # outputs for microbatch m exit the last stage at step m + S - 1 and are
     # visible (after the rotation) on every rank at that step

@@ -130,10 +130,10 @@ def sharded_attention_entry(inner, q, k, v, mesh: Optional[Mesh],
         axis_name = mesh.axis_names[0]
     spec = P(None, None, axis_name, None)
 
-    fn = jax.shard_map(
+    from .collectives import shard_map_compat
+    fn = shard_map_compat(
         partial(inner, axis_name=axis_name, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh, (spec, spec, spec), spec)
     out = fn(q, k, v)
     if not wrap:
         return out

@@ -113,7 +113,8 @@ def dumps(reset: bool = False) -> str:
         out = json.dumps({"traceEvents": _state["events"],
                           "compileCaches": get_compile_stats(),
                           "checkpoint": get_checkpoint_stats(),
-                          "deviceFeed": get_feed_stats()})
+                          "deviceFeed": get_feed_stats(),
+                          "comm": get_comm_stats()})
     if reset:
         _state["events"] = []
     return out
@@ -242,6 +243,64 @@ def reset_feed_stats():
     """Zero the feed counters (tests, per-epoch accounting, bench legs)."""
     with _feed_lock:
         _feed.update(_FEED_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# distributed-comm observability (ZeRO-1 / collectives counters)
+# ---------------------------------------------------------------------------
+
+_COMM_ZERO = {"steps": 0, "zero_steps": 0,
+              "bytes_reduced": 0, "bytes_gathered": 0, "allreduce_bytes": 0,
+              "bucket_count": 0, "shard_bytes_per_device": 0, "dp": 1,
+              "collectives": 0, "collective_ms_total": 0.0,
+              "collective_bytes": 0}
+_comm = dict(_COMM_ZERO)
+_comm_lock = threading.Lock()
+
+
+def record_comm_step(bytes_reduced: int = 0, bytes_gathered: int = 0,
+                     bucket_count: int = 0, shard_bytes: int = 0,
+                     dp: int = 1, allreduce_bytes: int = 0,
+                     zero: bool = False):
+    """One training step's gradient-exchange accounting (per-device bytes,
+    analytic from the bucket layout and dp degree — ring collectives move
+    (N-1)/N of the payload per device). The ZeRO path records reduce-scatter
+    + all-gather legs; the replicated-psum path records the full all-reduce
+    equivalent, so the two are directly comparable in ``bench.py zero_dp``."""
+    with _comm_lock:
+        _comm["steps"] += 1
+        if zero:
+            _comm["zero_steps"] += 1
+        _comm["bytes_reduced"] += int(bytes_reduced)
+        _comm["bytes_gathered"] += int(bytes_gathered)
+        _comm["allreduce_bytes"] += int(allreduce_bytes)
+        _comm["bucket_count"] = int(bucket_count)
+        _comm["shard_bytes_per_device"] = int(shard_bytes)
+        _comm["dp"] = int(dp)
+
+
+def record_collective(ms: float, nbytes: int):
+    """One host-blocking array-level collective (``parallel.collectives``
+    cross-process exchange): measured wall ms + payload bytes."""
+    with _comm_lock:
+        _comm["collectives"] += 1
+        _comm["collective_ms_total"] += ms
+        _comm["collective_bytes"] += int(nbytes)
+
+
+def get_comm_stats() -> dict:
+    """Per-step comm counters (bytes reduced/gathered, bucket count, shard
+    bytes per device, dp degree, measured collective ms) — the observability
+    contract of the ZeRO-1 gradient path. ``Speedometer`` prints the per-step
+    deltas; ``Module.fit`` logs them per epoch; ``bench.py zero_dp`` compares
+    the ZeRO legs against the replicated all-reduce accounting."""
+    with _comm_lock:
+        return dict(_comm)
+
+
+def reset_comm_stats():
+    with _comm_lock:
+        _comm.update(_COMM_ZERO)
 
 
 # ---------------------------------------------------------------------------

@@ -217,6 +217,84 @@ class StepExecutor:
         self._param_handles = list(trainer._params)
         self._aux_handles = [p for p in trainer._all_params
                              if p.grad_req == "null" and p._data is not None]
+        # ZeRO-1 engagement, resolved ONCE (kvstore type device/dist_sync +
+        # MXTPU_ZERO + elementwise optimizer → trainer.zero_requested()):
+        # params go replicated on the dp mesh, the batch dp-shards, gradients
+        # bucket into reduce-scatters, and optimizer slots live 1/N-sharded
+        self._zero_mesh = None
+        if trainer.zero_requested():
+            from .parallel.mesh import get_default_mesh
+            mesh = get_default_mesh()
+            # single-axis meshes only (see DataParallelTrainer: multi-axis
+            # concat-of-partial-sum gradients mis-reduce on this jax version)
+            if len(mesh.axis_names) == 1:
+                self._zero_mesh = mesh
+
+    # -- ZeRO-1 plumbing ---------------------------------------------------
+    def _ensure_placed(self):
+        """Replicate params/aux across the dp mesh (idempotent; the committed
+        NamedSharding is part of the signature, so this runs BEFORE _sig)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .parallel.data_parallel import _place
+        repl = NamedSharding(self._zero_mesh, P())
+        for p in self._param_handles + self._aux_handles:
+            raw = p._data._data
+            if getattr(raw, "sharding", None) != repl:
+                p._data._set_data(_place(raw, repl))
+
+    def _ensure_zero_states(self):
+        """Create (or adopt from a checkpoint restore) the per-bucket sharded
+        optimizer slots, owned by the Trainer so snapshot capture sees them."""
+        from .parallel import zero as zero_mod
+        from .parallel.mesh import dp_size
+        tr = self.trainer
+        opt = tr._optimizer
+        if tr._zero_layout is not None:
+            return
+        raws = [p._data._data for p in self._param_handles]
+        comp = getattr(tr._kvstore, "_compression_params", None) \
+            if tr._kvstore is not None else None
+        layout = zero_mod.ZeroLayout(
+            raws,
+            [getattr(p, "lr_mult", 1.0) * opt.lr_mult.get(i, 1.0)
+             for i, p in enumerate(self._param_handles)],
+            [getattr(p, "wd_mult", 1.0) * opt.wd_mult.get(i, 1.0)
+             for i, p in enumerate(self._param_handles)],
+            dp_size(self._zero_mesh))
+        tr._zero_layout = layout
+        adopted = None
+        if tr._zero_restore is not None:
+            saved_meta, saved_arrays = tr._zero_restore
+            adopted = layout.adopt_states(saved_arrays,
+                                          saved_meta.get("layout", {}),
+                                          self._zero_mesh)
+            tr._zero_restore = None
+            if adopted is None:
+                import warnings
+                warnings.warn(
+                    "checkpointed ZeRO optimizer slots do not match the "
+                    "current bucket layout (params or MXTPU_ZERO_BUCKET_MB "
+                    "changed); starting with fresh optimizer state",
+                    stacklevel=3)
+        if adopted is not None:
+            tr._zero_states, tr._zero_residuals = adopted
+        else:
+            tr._zero_states, tr._zero_residuals = zero_mod.init_zero_states(
+                opt, layout, raws, self._zero_mesh,
+                with_residual=comp is not None)
+        # normalize residuals to the CURRENT compression setting: fresh zeros
+        # where compression wants one and none was saved; dropped when off
+        if comp is None:
+            tr._zero_residuals = [None] * len(layout.buckets)
+        else:
+            from .parallel.data_parallel import _place
+            shard = layout.shard_spec(self._zero_mesh)
+            tr._zero_residuals = [
+                r if r is not None
+                else _place(jnp.zeros((b.padded,), jnp.float32), shard)
+                for b, r in zip(layout.buckets, tr._zero_residuals)]
+        if donation_supported():
+            tr._zero_states = [unique_buffers(st) for st in tr._zero_states]
 
     # -- signature ---------------------------------------------------------
     def _ensure_states(self):
@@ -230,6 +308,15 @@ class StepExecutor:
 
     def _sig(self, data, label) -> tuple:
         tr = self.trainer
+        zero_sig = None
+        if self._zero_mesh is not None:
+            zero_sig = (
+                tr._zero_layout.fingerprint(),
+                tuple(tuple(_arr_sig(s) for s in st)
+                      for st in tr._zero_states),
+                tuple(None if r is None else _arr_sig(r)
+                      for r in tr._zero_residuals),
+            )
         return (
             tuple(_arr_sig(d.data) for d in data),
             _arr_sig(label.data) if label is not None else None,
@@ -239,6 +326,7 @@ class StepExecutor:
                   for st in tr._states),
             tuple(p.grad_req for p in self._param_handles),
             optimizer_fingerprint(tr._optimizer),
+            zero_sig,
         )
 
     # -- tracing -----------------------------------------------------------
@@ -257,11 +345,20 @@ class StepExecutor:
         wd_mults = [getattr(p, "wd_mult", 1.0) * opt.wd_mult.get(i, 1.0)
                     for i, p in enumerate(param_handles)]
         update_all = build_update_all(opt, lr_mults, wd_mults)
+        zero_update = None
+        if self._zero_mesh is not None:
+            from .parallel import zero as zero_mod
+            comp = getattr(self.trainer._kvstore, "_compression_params", None) \
+                if self.trainer._kvstore is not None else None
+            zero_update = zero_mod.build_zero_update(
+                opt, self.trainer._zero_layout, self._zero_mesh,
+                comm_dtype=zero_mod.comm_dtype_of(comp),
+                compression_params=comp)
         softmax_expose = isinstance(loss_fn, SoftmaxCrossEntropyLoss)
         struct: dict = {}
 
-        def pure(param_raws, aux_raws, state_raws, data_raws, label_raw,
-                 lr, wd, rescale, clip, t, key):
+        def pure(param_raws, aux_raws, state_raws, zstates, zres, data_raws,
+                 label_raw, lr, wd, rescale, clip, t, key):
             provider = rng.push_trace_provider(key)
             saved_p = [p._data._data for p in param_handles]
             saved_a = [p._data._data for p in aux_handles]
@@ -287,12 +384,25 @@ class StepExecutor:
 
                 (_, (new_aux, raw_outs, loss_arr)), grads = \
                     jax.value_and_grad(loss_on, has_aux=True)(list(param_raws))
-                new_params, new_states = update_all(
-                    param_raws, grads, state_raws, lr, wd, rescale, clip, t)
+                if zero_update is not None:
+                    # ZeRO-1: bucketed reduce-scatter → sharded slot update →
+                    # all-gather. Grads are NOT returned in this mode: a
+                    # replicated grad output would force the very all-reduce
+                    # the reduce-scatter exists to avoid.
+                    new_params, new_zstates, new_zres = zero_update(
+                        list(param_raws), list(grads), zstates, zres,
+                        lr, wd, rescale, clip, t)
+                    new_states, out_grads = list(state_raws), None
+                else:
+                    new_params, new_states = update_all(
+                        param_raws, grads, state_raws, lr, wd, rescale,
+                        clip, t)
+                    new_zstates, new_zres, out_grads = zstates, zres, \
+                        list(grads)
                 exposed0 = (jax.nn.softmax(raw_outs[0], axis=-1)
                             if softmax_expose else None)
-                return (new_params, new_aux, new_states, list(grads),
-                        loss_arr, raw_outs, exposed0)
+                return (new_params, new_aux, new_states, new_zstates,
+                        new_zres, out_grads, loss_arr, raw_outs, exposed0)
             finally:
                 for p, r in zip(param_handles, saved_p):
                     p._data._data = r
@@ -302,7 +412,7 @@ class StepExecutor:
                     p._data._version += 1
                 rng.pop_trace_provider()
 
-        donate = (0, 2) if donation_supported() else ()
+        donate = (0, 2, 3, 4) if donation_supported() else ()
         jitted = jax.jit(pure, donate_argnums=donate)
         return {"jitted": jitted, "struct": struct}
 
@@ -317,7 +427,18 @@ class StepExecutor:
         tr = self.trainer
         tr._init_kvstore()
         opt = tr._optimizer
-        self._ensure_states()
+        if self._zero_mesh is not None:
+            # ZeRO-1: replicate params over the dp mesh, dp-shard the batch,
+            # keep optimizer slots ONLY as 1/N bucket shards (tr._states
+            # stays None — snapshot capture reads tr._zero_states instead)
+            from .parallel.data_parallel import shard_batch
+            self._ensure_placed()
+            self._ensure_zero_states()
+            data = [shard_batch(d, self._zero_mesh) for d in data]
+            if label is not None:
+                label = shard_batch(label, self._zero_mesh)
+        else:
+            self._ensure_states()
         batch_size = batch_size if batch_size is not None else data[0].shape[0]
 
         sig = self._sig(data, label)
@@ -344,10 +465,12 @@ class StepExecutor:
             [p._data._data for p in self._param_handles],
             [p._data._data for p in self._aux_handles],
             list(tr._states),
+            list(tr._zero_states), list(tr._zero_residuals),
             [d.data for d in data],
             label.data if label is not None else None,
             lr, wd, rescale, clip, t, key)
-        new_params, new_aux, new_states, grads, loss_arr, raw_outs, exposed0 = out
+        (new_params, new_aux, new_states, new_zstates, new_zres, grads,
+         loss_arr, raw_outs, exposed0) = out
 
         # write-back: params/aux/state swap + eager-visible gradients
         for p, v in zip(self._param_handles, new_params):
@@ -355,16 +478,25 @@ class StepExecutor:
         for p, v in zip(self._aux_handles, new_aux):
             p._data._set_data(v)
         tr._states = list(new_states)
-        for p, g in zip(self._param_handles, grads):
-            h = p._data
-            if h._grad is not None and getattr(h._grad, "stype",
-                                               "default") == "default":
-                h._grad._set_data(g)
-            else:
-                h._grad = NDArray(g)
+        tr._zero_states = list(new_zstates)
+        tr._zero_residuals = list(new_zres)
+        if grads is not None:
+            # eager-visible gradients (param.grad()); the ZeRO path skips
+            # this — materializing the full grad would force an all-reduce
+            for p, g in zip(self._param_handles, grads):
+                h = p._data
+                if h._grad is not None and getattr(h._grad, "stype",
+                                                   "default") == "default":
+                    h._grad._set_data(g)
+                else:
+                    h._grad = NDArray(g)
         for i in range(len(self._param_handles)):
             opt._index_update_count[i] = t
         opt.num_update = max(opt.num_update, t)
+        if self._zero_mesh is not None:
+            from . import profiler
+            profiler.record_comm_step(zero=True,
+                                      **tr._zero_layout.step_comm())
 
         outputs = [NDArray(r) for r in raw_outs]
         return {

@@ -26,8 +26,33 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multi_device(n=8): needs an n-device mesh (the XLA "
+        "host-device-count spoof above provides 8 virtual CPU devices); "
+        "the dp_mesh fixture auto-skips when fewer devices exist")
+
+
+@pytest.fixture
+def dp_mesh(request):
+    """Shared (n,)-device ``("dp",)`` mesh for sharding/collective tests.
+
+    ``n`` comes from the test's ``@pytest.mark.multi_device(n)`` marker
+    (default 8 — the conftest spoof). Skips cleanly when the host exposes
+    fewer devices (e.g. a subprocess without the XLA_FLAGS spoof), so
+    ≥8-device tests never hard-fail on small hosts."""
+    marker = request.node.get_closest_marker("multi_device")
+    n = marker.args[0] if marker is not None and marker.args else 8
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
+    from mxtpu import parallel
+    return parallel.make_mesh((n,), ("dp",))
 
 
 def subprocess_env(virtual_devices: int = 0):

@@ -1,0 +1,46 @@
+"""Tier-1 bench guard (BENCH_r05 regression class: the cpu-fallback child
+crashed with rc=1 initializing the very backend it was escaping, and the
+broken bench rode along silently for a round).
+
+Contract: ``bench.py`` run as the CPU-fallback child (``MXTPU_BENCH_FALLBACK=1``
+— the exact re-exec environment ``main()`` builds) must exit 0 and emit ONE
+parseable JSON line on stdout with the fallback harness's full key set.
+``MXTPU_BENCH_SMOKE=1`` shrinks iteration counts so this runs in tier-1 time;
+the code path (imports, backend pin, every scenario, JSON emission) is the
+full one."""
+
+import json
+import os
+import subprocess
+import sys
+
+import conftest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_cpu_fallback_exits_zero_and_emits_json():
+    env = conftest.subprocess_env()
+    # the exact env main()'s re-exec builds for the fallback child
+    env["MXTPU_BENCH_FALLBACK"] = "1"
+    env["MXTPU_BENCH_SMOKE"] = "1"
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=480)
+    assert p.returncode == 0, (
+        f"bench.py cpu-fallback child exited rc={p.returncode}\n"
+        f"stderr tail:\n{p.stderr[-2000:]}")
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout from bench.py; stderr:\n{p.stderr[-2000:]}"
+    doc = json.loads(lines[-1])        # the single JSON line contract
+    assert doc["fallback"] == "cpu"
+    assert doc["metric"] == "lenet_train_imgs_per_sec"
+    assert doc["value"] > 0
+    assert doc["loss_end"] < doc["loss_start"]       # it actually trained
+    # every fallback scenario must keep emitting its keys
+    assert {"checkpoint", "input_pipeline", "zero_dp",
+            "compile_caches"} <= set(doc)
+    zdp = doc["zero_dp"]
+    assert zdp["dp"] >= 1
+    assert zdp["zero1"]["opt_state_bytes_per_device"] > 0
+    assert zdp["replicated"]["step_ms"] > 0 and zdp["zero1"]["step_ms"] > 0

@@ -62,3 +62,33 @@ def test_lenet_loop_traces_at_most_twice():
         # and the loop genuinely reused the cache, not silently eager
         assert step["traces"] >= 1
         assert step["hits"] >= steps - 1
+
+
+def test_zero_fit_traces_once_across_epochs():
+    """ZeRO-1 guard: a 3-epoch fit through the sharded-optimizer path
+    (kvstore='device' selects it) must compile the step program ONCE — the
+    bucket reduce-scatter/all-gather dataflow may not introduce per-batch or
+    per-epoch retraces (placement, shard_batch, and the sharded slots all
+    land in ONE stable signature)."""
+    import mxtpu as mx
+    from mxtpu.io import NDArrayIter
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(32, 1, 12, 12).astype(np.float32)
+    y = rs.randint(0, 10, 32).astype(np.float32)
+    with engine.bulk(engine.DEFAULT_BULK_SIZE):
+        profiler.reset_compile_stats()
+        mx.rng.seed(0)
+        mod = mx.Module(GuardNet(), data_names=("data",),
+                        label_names=("softmax_label",))
+        it = NDArrayIter(X, y, batch_size=8, shuffle=False)
+        mod.fit(it, num_epoch=3, kvstore="device", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+        assert mod._trainer._zero_layout is not None, \
+            "kvstore='device' fit did not engage the ZeRO path"
+        stats = profiler.get_compile_stats()
+        step = stats.get("module_step", {"traces": 0, "hits": 0})
+        assert step["traces"] <= 1, (
+            f"ZeRO fit step-traced {step['traces']} times across 3 epochs — "
+            f"the sharded path added retraces: {stats}")
+        assert step["hits"] >= 3 * 4 - 1

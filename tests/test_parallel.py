@@ -16,33 +16,34 @@ def test_eight_devices_present():
     assert len(jax.devices()) == 8
 
 
-def test_allreduce_array():
-    mesh = parallel.make_mesh((8,), ("dp",))
+@pytest.mark.multi_device(8)
+def test_allreduce_array(dp_mesh):
     x = jnp.ones((4,))
-    out = parallel.allreduce_array(x, mesh)
+    out = parallel.allreduce_array(x, dp_mesh)
     np.testing.assert_allclose(np.asarray(out), 8.0)
-    out_mean = parallel.allreduce_array(x, mesh, op="mean")
+    out_mean = parallel.allreduce_array(x, dp_mesh, op="mean")
     np.testing.assert_allclose(np.asarray(out_mean), 1.0)
 
 
-def test_allgather_and_reduce_scatter():
-    mesh = parallel.make_mesh((8,), ("dp",))
+@pytest.mark.multi_device(8)
+def test_allgather_and_reduce_scatter(dp_mesh):
     x = jnp.arange(16.0).reshape(16, 1)
     sharded = parallel.shard_batch(nd.array(np.arange(16, dtype=np.float32)
-                                            .reshape(16, 1)), mesh)
-    gathered = parallel.allgather_array(sharded.data, mesh)
+                                            .reshape(16, 1)), dp_mesh)
+    gathered = parallel.allgather_array(sharded.data, dp_mesh)
     np.testing.assert_allclose(np.asarray(gathered), np.asarray(x))
-    rs = parallel.reduce_scatter_array(jnp.ones((16, 1)), mesh)
+    rs = parallel.reduce_scatter_array(jnp.ones((16, 1)), dp_mesh)
     np.testing.assert_allclose(np.asarray(rs), 8.0)
 
 
-def test_barrier():
-    mesh = parallel.make_mesh((8,), ("dp",))
-    assert parallel.barrier(mesh) == 8.0
+@pytest.mark.multi_device(8)
+def test_barrier(dp_mesh):
+    assert parallel.barrier(dp_mesh) == 8.0
 
 
-def test_shard_batch_layout():
-    mesh = parallel.make_mesh((8,), ("dp",))
+@pytest.mark.multi_device(8)
+def test_shard_batch_layout(dp_mesh):
+    mesh = dp_mesh
     x = nd.array(np.random.rand(16, 3).astype(np.float32))
     sx = parallel.shard_batch(x, mesh)
     assert sx.shape == (16, 3)
@@ -52,10 +53,11 @@ def test_shard_batch_layout():
     assert len(shards) == 8 and shards[0].data.shape == (2, 3)
 
 
-def test_data_parallel_trainer_matches_serial():
+@pytest.mark.multi_device(8)
+def test_data_parallel_trainer_matches_serial(dp_mesh):
     """DP-sharded step ≈ serial large-batch step (the dist_sync consistency check,
     tests/nightly/dist_sync_kvstore.py re-imagined)."""
-    mesh = parallel.make_mesh((8,), ("dp",))
+    mesh = dp_mesh
 
     def build():
         mx.rng.seed(0)
@@ -95,8 +97,9 @@ def test_data_parallel_trainer_matches_serial():
                                    rtol=1e-4, atol=1e-5)
 
 
-def test_dp_trainer_loss_decreases():
-    mesh = parallel.make_mesh((8,), ("dp",))
+@pytest.mark.multi_device(8)
+def test_dp_trainer_loss_decreases(dp_mesh):
+    mesh = dp_mesh
     mx.rng.seed(1)
     net = nn.HybridSequential()
     net.add(nn.Dense(32, activation="relu", in_units=10), nn.Dense(2, in_units=32))
