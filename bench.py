@@ -1094,6 +1094,72 @@ def bench_zero_dp(steps: int = 16, batch: int = 64, hidden: int = 512):
     return out
 
 
+def _sanitize_requested() -> bool:
+    """``--sanitize`` flag (forwarded through the cpu-fallback re-exec)."""
+    return "--sanitize" in sys.argv
+
+
+def bench_sanitizer(smoke: bool = False):
+    """One sanitized leg per scenario (``--sanitize``): the LeNet fused-step
+    train loop, the checkpoint manager, and the device-feed input pipeline
+    re-run under ``MXTPU_SANITIZE=transfers,donation,retrace,threads``, with
+    ``profiler.get_sanitizer_stats()`` as the source of truth. Reports the
+    sanitizer's step overhead against an unsanitized twin leg and the
+    violation count — the contract (docs/static_analysis.md) is zero on the
+    committed tree. Runs inside the cpu-fallback harness too, so the tier-1
+    bench guard can assert the sanitized leg stays exit-0."""
+    from mxtpu import nd, profiler
+    from mxtpu.analysis import sanitize
+    from mxtpu.io import DataBatch
+
+    batch, steps = 32, (4 if smoke else 20)
+    rs = np.random.RandomState(7)
+    x = nd.array(rs.rand(batch, 1, 28, 28).astype(np.float32))
+    y = nd.array(rs.randint(0, 10, batch).astype(np.float32))
+    b = DataBatch(data=[x], label=[y])
+
+    def train_leg() -> float:
+        mod = _lenet_module(batch)
+        mod.forward_backward(b)     # compile outside the timed window
+        mod.update()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            mod.forward_backward(b)
+            mod.update()
+        float(mod._loss_val.mean().data)        # sync
+        return (time.perf_counter() - t0) * 1e3 / steps
+
+    plain_ms = train_leg()
+    profiler.reset_sanitizer_stats()
+    t0 = time.perf_counter()
+    with sanitize.scope("transfers,donation,retrace,threads"):
+        sanitized_ms = train_leg()
+        ckpt = bench_checkpoint(iters=1 if smoke else 2)
+        pipe = bench_input_pipeline(steps=4 if smoke else 16)
+    stats = profiler.get_sanitizer_stats()
+    violations = profiler.sanitizer_violations(stats)
+    out = {
+        "modes": ["transfers", "donation", "retrace", "threads"],
+        "scenarios": ["train", "checkpoint", "input_pipeline"],
+        "step_ms_plain": round(plain_ms, 3),
+        "step_ms_sanitized": round(sanitized_ms, 3),
+        "overhead_frac": round(sanitized_ms / max(plain_ms, 1e-9) - 1.0, 4),
+        "violations": violations,
+        "stats": stats,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "checkpoint": {"async_blocked_frac": ckpt["async_blocked_frac"]},
+        "input_pipeline": {"feed_stall_frac":
+                           pipe["device_feed"]["stall_frac"]},
+    }
+    log(f"[sanitizer] step {plain_ms:.2f} -> {sanitized_ms:.2f} ms "
+        f"({out['overhead_frac']*100:+.1f}%), "
+        f"guards={stats['transfer_guards']} "
+        f"poisons={stats['donation_poisons_armed']} "
+        f"ownership={stats['ownership_checks']} -> "
+        f"violations={violations}")
+    return out
+
+
 def bench_cpu_fallback():
     """Reduced harness for hosts where the TPU backend won't initialize
     (BENCH_r05 regression: rc=1 'Unable to initialize backend'). Emits the
@@ -1131,11 +1197,12 @@ def bench_cpu_fallback():
     pipe = bench_input_pipeline(steps=8 if smoke else 48)
     zdp = bench_zero_dp(steps=4 if smoke else 16,
                         hidden=128 if smoke else 512)
+    san = bench_sanitizer(smoke=smoke) if _sanitize_requested() else None
     caches = profiler.get_compile_stats()
     log(f"[cpu-fallback] lenet b{batch}: {img_s:.0f} img/s, loss "
         f"{loss_start:.3f} -> {loss_end:.3f}, "
         f"step traces={caches.get('module_step', {}).get('traces')}")
-    print(json.dumps({
+    doc = {
         "metric": "lenet_train_imgs_per_sec",
         "value": round(img_s, 1),
         "unit": "images/sec",
@@ -1147,7 +1214,10 @@ def bench_cpu_fallback():
         "input_pipeline": pipe,
         "zero_dp": zdp,
         "compile_caches": caches,
-    }))
+    }
+    if san is not None:
+        doc["sanitizer"] = san
+    print(json.dumps(doc))
 
 
 def main():
@@ -1177,8 +1247,10 @@ def main():
         # interpreter — the child must never touch the backend that just
         # failed (BENCH_r05: the re-exec'd child crashed initializing axon)
         env.pop("PALLAS_AXON_POOL_IPS", None)
+        # flags (--sanitize) ride along into the fallback child
         os.execve(sys.executable,
-                  [sys.executable, os.path.abspath(__file__)], env)
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
     if os.environ.get("MXTPU_BENCH_FALLBACK") == "1" \
             or jax.default_backend() == "cpu":
         bench_cpu_fallback()
@@ -1201,10 +1273,11 @@ def main():
     ckpt = bench_checkpoint()
     feed_pipe = bench_input_pipeline()
     zdp = bench_zero_dp()
+    san = bench_sanitizer() if _sanitize_requested() else None
 
     best_tag = max(train, key=lambda t: train[t]["img_s"])
     best = train[best_tag]
-    print(json.dumps({
+    doc = {
         "metric": "resnet50_train_imgs_per_sec",
         "value": best["img_s"],
         "unit": "images/sec",
@@ -1224,7 +1297,10 @@ def main():
         "input_pipeline": feed_pipe,
         "zero_dp": zdp,
         "compile_caches": _compile_caches(),
-    }))
+    }
+    if san is not None:
+        doc["sanitizer"] = san
+    print(json.dumps(doc))
 
 
 def _compile_caches():

@@ -1,0 +1,35 @@
+"""mxtpu.analysis — tpulint static checker + runtime sanitizer suite.
+
+The survey's core lesson from the reference is that a dependency-scheduling
+engine stays correct because every mutation is DECLARED to it
+(``docs/architecture/note_engine.md``).  This port replaced that engine with
+implicit contracts — ``donate_argnums`` buffer ownership
+(``step_cache.py``), producer-thread batch handoff (``device_feed.py``),
+rank-0-only checkpoint commit (``checkpoint/manager.py``) — and both of the
+hardest bugs so far (PR 2's donated-buffer/async-snapshot race, PR 4's
+multi-axis mis-reduction) were found by hand.  This package machine-enforces
+the contract layer, in the spirit of compiler sanitizers (ASan/TSan) and
+JAX's ``transfer_guard``, specialized to this codebase:
+
+* **Static half** (``lint.py`` + ``rules/``): an AST linter, runnable as
+  ``python -m mxtpu.analysis <path>``, with per-line suppression
+  (``# mxtpu: ignore[R001]``).  Rules R001–R005 cover host-sync-in-step,
+  donation-use-after-pass, untracked nondeterminism, thread-shared mutables
+  without a lock, and overbroad excepts.
+* **Runtime half** (``sanitize.py``): opt-in via
+  ``MXTPU_SANITIZE=transfers,donation,retrace,threads`` — transfer guards
+  around the fused step, donated-buffer poisoning, retrace escalation with
+  a signature diff, and thread-ownership assertions.  Counters land in
+  ``profiler.get_sanitizer_stats()``.
+
+See ``docs/static_analysis.md`` for the rule catalog and knob map.
+"""
+
+from .lint import Finding, lint_file, lint_paths, lint_source
+from . import sanitize
+from .sanitize import (DonationError, HostSyncError, RetraceError,
+                       SanitizerError, ThreadOwnershipError)
+
+__all__ = ["Finding", "lint_file", "lint_paths", "lint_source", "sanitize",
+           "SanitizerError", "HostSyncError", "DonationError", "RetraceError",
+           "ThreadOwnershipError"]

@@ -1,0 +1,62 @@
+"""CLI: ``python -m mxtpu.analysis <path>...`` — run tpulint.
+
+Exit status: 0 clean, 1 findings, 2 usage error.  ``--select``/``--ignore``
+filter rules; ``--list-rules`` prints the catalog; ``--stats`` appends a
+per-rule count summary.  The tier-1 guard
+(``tests/test_analysis_guard.py``) runs ``python -m mxtpu.analysis mxtpu/``
+and asserts exit 0 — the committed tree stays self-lint-clean.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .lint import lint_paths
+from . import rules as rules_pkg
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mxtpu.analysis",
+        description="tpulint: static checker for mxtpu's donation, "
+                    "host-sync, retrace, and thread-ownership contracts")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint")
+    parser.add_argument("--select", action="append", default=None,
+                        metavar="RULE", help="only run these rule ids")
+    parser.add_argument("--ignore", action="append", default=None,
+                        metavar="RULE", help="skip these rule ids")
+    parser.add_argument("--stats", action="store_true",
+                        help="append a per-rule finding count summary")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for mod in rules_pkg.RULES:
+            doc = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{mod.RULE_ID}  {mod.TITLE:<40s} {doc}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("error: no paths given", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(args.paths, select=args.select, ignore=args.ignore)
+    for f in findings:
+        print(f.format())
+    if args.stats:
+        counts = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        for rule in sorted(counts):
+            print(f"{rule}: {counts[rule]} finding(s)")
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

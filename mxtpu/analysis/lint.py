@@ -1,0 +1,345 @@
+"""tpulint core — AST linter for mxtpu's implicit runtime contracts.
+
+The reference framework stays correct because every mutation is DECLARED to
+its dependency engine (``docs/architecture/note_engine.md``); this port's
+equivalents — ``donate_argnums`` ownership transfer, producer-thread batch
+handoff, jit purity — are implicit conventions that nothing enforced.  PR 2's
+donated-buffer/async-snapshot race and PR 4's multi-axis mis-reduction were
+both found by hand.  ``tpulint`` machine-checks the convention layer: each
+rule in ``mxtpu/analysis/rules/`` is grounded in one of those real bugs.
+
+Usage (also via ``python -m mxtpu.analysis``)::
+
+    from mxtpu.analysis import lint_paths
+    findings = lint_paths(["mxtpu/"])
+
+Per-line suppression: append ``# mxtpu: ignore[R001]`` (or a comma list, or
+bare ``# mxtpu: ignore`` for all rules) to the flagged line.  Suppressions
+are honored only on the exact finding line, so they stay local and auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "ModuleContext", "lint_source", "lint_file",
+           "lint_paths", "dotted_name"]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxtpu:\s*ignore(?:\[([A-Za-z0-9_,\s]*)\])?")
+
+# calls that enter a jax trace: a function passed to (or decorated by) one of
+# these runs with tracer values, so host syncs / untracked randomness inside
+# it are per-step hazards, not one-off host work
+_TRACE_ENTRY_NAMES = {"jit", "pjit", "grad", "value_and_grad", "vjp",
+                      "linearize", "vmap", "pmap", "shard_map"}
+
+
+class Finding:
+    """One lint hit: ``path:line:col RULE message``."""
+
+    __slots__ = ("path", "line", "col", "rule", "message")
+
+    def __init__(self, path: str, line: int, col: int, rule: str,
+                 message: str):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.rule = rule
+        self.message = message
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def __repr__(self):  # test-failure readability
+        return f"Finding({self.format()!r})"
+
+    def _key(self):
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def __eq__(self, other):
+        return isinstance(other, Finding) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+
+def dotted_name(node) -> Optional[str]:
+    """``ast`` expression → dotted name string (``jax.random.normal``), or
+    None for anything that is not a plain Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_trace_entry(func) -> bool:
+    name = dotted_name(func)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in _TRACE_ENTRY_NAMES
+
+
+class ModuleContext:
+    """One parsed module plus the shared indexes the rules key off."""
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        self.lines = src.splitlines()
+        self._parents: Optional[Dict[int, ast.AST]] = None
+        self._suppress: Optional[Dict[int, Optional[Set[str]]]] = None
+        self._functions_by_name: Optional[Dict[str, List[ast.AST]]] = None
+        self._step_functions: Optional[List[ast.AST]] = None
+
+    # -- tree plumbing ------------------------------------------------------
+    def parent(self, node) -> Optional[ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for p in ast.walk(self.tree):
+                for c in ast.iter_child_nodes(p):
+                    self._parents[id(c)] = p
+        return self._parents.get(id(node))
+
+    def ancestors(self, node) -> Iterable[ast.AST]:
+        p = self.parent(node)
+        while p is not None:
+            yield p
+            p = self.parent(p)
+
+    # -- suppression --------------------------------------------------------
+    def suppressed(self, line: int, rule: str) -> bool:
+        if self._suppress is None:
+            table: Dict[int, Optional[Set[str]]] = {}
+            for i, text in enumerate(self.lines, start=1):
+                m = _SUPPRESS_RE.search(text)
+                if not m:
+                    continue
+                if m.group(1) is None:
+                    table[i] = None          # bare ignore: every rule
+                else:
+                    table[i] = {r.strip().upper()
+                                for r in m.group(1).split(",") if r.strip()}
+            self._suppress = table
+        if line not in self._suppress:
+            return False
+        rules = self._suppress[line]
+        return rules is None or rule.upper() in rules
+
+    # -- function indexes ---------------------------------------------------
+    def enclosing_scope(self, node) -> ast.AST:
+        """Nearest enclosing function scope (ClassDef bodies are not name
+        scopes for resolution purposes), else the module."""
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return a
+        return self.tree
+
+    def resolve_function(self, name: str, at_node) -> List[ast.AST]:
+        """Lexically resolve ``name`` at a reference site to function defs:
+        innermost visible scope wins (a nested traced ``def step`` must not
+        drag a same-named eager method into the traced set). Unresolvable
+        names (parameters, imports) resolve to nothing rather than to every
+        same-named def in the file."""
+        cands = self.functions_by_name.get(name, [])
+        if not cands:
+            return []
+        chain = [self.enclosing_scope(at_node)]
+        while chain[-1] is not self.tree:
+            chain.append(self.enclosing_scope(chain[-1]))
+        for scope in chain:
+            visible = [f for f in cands
+                       if f is not scope and self.enclosing_scope(f) is scope]
+            if visible:
+                return visible
+        return []
+
+    @property
+    def functions_by_name(self) -> Dict[str, List[ast.AST]]:
+        if self._functions_by_name is None:
+            idx: Dict[str, List[ast.AST]] = {}
+            for n in ast.walk(self.tree):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    idx.setdefault(n.name, []).append(n)
+            self._functions_by_name = idx
+        return self._functions_by_name
+
+    @property
+    def step_functions(self) -> List[ast.AST]:
+        """Functions that flow into a jax trace (jit/grad/vmap/… entry):
+
+        * decorated with ``@jax.jit`` / ``@partial(jax.jit, …)``;
+        * passed as the first argument of a trace-entry call
+          (``jax.jit(pure, donate_argnums=…)``, ``jax.value_and_grad(f)``);
+        * defined inside, or called by name from, one of the above
+          (fixpoint over same-module name resolution — ``pure`` calling a
+          local helper drags the helper into the traced set).
+        """
+        if self._step_functions is not None:
+            return self._step_functions
+        seeds: List[ast.AST] = []
+        for n in ast.walk(self.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in n.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _is_trace_entry(target):
+                        seeds.append(n)
+                    elif isinstance(dec, ast.Call) and dec.args \
+                            and _is_trace_entry(dec.args[0]):
+                        seeds.append(n)      # @partial(jax.jit, ...)
+            elif isinstance(n, ast.Call) and _is_trace_entry(n.func):
+                if n.args and isinstance(n.args[0], ast.Name):
+                    seeds.extend(self.resolve_function(n.args[0].id, n))
+        # fixpoint closure: nested defs + same-module callees of step fns
+        step: Dict[int, ast.AST] = {id(f): f for f in seeds}
+        changed = True
+        while changed:
+            changed = False
+            for f in list(step.values()):
+                for n in ast.walk(f):
+                    targets: List[ast.AST] = []
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                            and n is not f:
+                        targets = [n]
+                    elif isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Name):
+                        targets = self.resolve_function(n.func.id, n)
+                    for t in targets:
+                        if id(t) not in step:
+                            step[id(t)] = t
+                            changed = True
+        self._step_functions = list(step.values())
+        return self._step_functions
+
+    def in_step_function(self, node) -> bool:
+        ids = {id(f) for f in self.step_functions}
+        return any(id(a) in ids for a in self.ancestors(node)) \
+            or id(node) in ids
+
+    # -- threading/lock helpers (R004) --------------------------------------
+    def lock_names(self) -> Set[str]:
+        """Module-level names bound to threading.Lock/RLock and friends."""
+        names: Set[str] = set()
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                callee = dotted_name(stmt.value.func) or ""
+                if callee.rsplit(".", 1)[-1] in ("Lock", "RLock", "Semaphore",
+                                                 "BoundedSemaphore",
+                                                 "Condition"):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+        return names
+
+    def spawns_threads(self) -> bool:
+        """Evidence this module runs code on more than one thread: it
+        constructs Thread/Lock/Event/… from ``threading``."""
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Call):
+                callee = dotted_name(n.func) or ""
+                if callee.rsplit(".", 1)[-1] in (
+                        "Thread", "Timer", "Lock", "RLock", "Semaphore",
+                        "BoundedSemaphore", "Event", "Condition", "Barrier") \
+                        and ("threading" in callee or "." not in callee):
+                    return True
+        return False
+
+    def module_mutables(self) -> Set[str]:
+        """Module-level names bound to a mutable container literal/ctor."""
+        out: Set[str] = set()
+        ctors = {"dict", "list", "set", "defaultdict", "Counter", "deque",
+                 "OrderedDict", "WeakValueDictionary", "WeakSet"}
+        for stmt in self.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            v = stmt.value
+            mutable = isinstance(v, (ast.Dict, ast.List, ast.Set,
+                                     ast.DictComp, ast.ListComp, ast.SetComp))
+            if isinstance(v, ast.Call):
+                callee = dotted_name(v.func) or ""
+                mutable = callee.rsplit(".", 1)[-1] in ctors
+            if mutable:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+
+def base_name(node) -> Optional[str]:
+    """Peel Subscript/Attribute chains down to the root Name
+    (``_state["events"].append`` → ``_state``)."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def _rules(select: Optional[Sequence[str]] = None,
+           ignore: Optional[Sequence[str]] = None):
+    from . import rules as rules_pkg
+    active = []
+    for mod in rules_pkg.RULES:
+        rid = mod.RULE_ID
+        if select and rid not in {s.upper() for s in select}:
+            continue
+        if ignore and rid in {s.upper() for s in ignore}:
+            continue
+        active.append(mod)
+    return active
+
+
+def lint_source(src: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None,
+                ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one module's source; returns unsuppressed findings sorted by
+    position. A syntax error becomes a single E000 finding (the linter never
+    crashes on an unparseable input file)."""
+    try:
+        ctx = ModuleContext(path, src)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 0, "E000",
+                        f"syntax error: {e.msg}")]
+    findings: Set[Finding] = set()
+    for rule in _rules(select, ignore):
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f.line, f.rule):
+                findings.add(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def lint_file(path: str, **kw) -> List[Finding]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return lint_source(f.read(), path=path, **kw)
+
+
+def lint_paths(paths: Sequence[str], **kw) -> List[Finding]:
+    """Lint files and/or directory trees (``.py`` files, skipping
+    ``__pycache__``); paths are reported as given."""
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        findings.extend(
+                            lint_file(os.path.join(root, fname), **kw))
+        else:
+            findings.extend(lint_file(p, **kw))
+    return findings

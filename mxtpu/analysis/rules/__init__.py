@@ -1,0 +1,13 @@
+"""tpulint rule registry.
+
+Each rule module exposes ``RULE_ID``, ``TITLE``, and
+``check(ctx: ModuleContext) -> Iterable[Finding]``.  Rules are grounded in
+this repo's real bug history (see ``docs/static_analysis.md`` for the
+catalog and the PR 2 / PR 4 incidents each one would have caught).
+"""
+
+from . import host_sync, donation, nondeterminism, thread_shared, excepts
+
+RULES = [host_sync, donation, nondeterminism, thread_shared, excepts]
+
+__all__ = ["RULES"]

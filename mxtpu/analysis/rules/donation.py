@@ -1,0 +1,157 @@
+"""R002 donation-use-after-pass: reading a name after passing it at a
+donated argnum.
+
+``jax.jit(fn, donate_argnums=…)`` transfers buffer ownership: on accelerator
+backends the donated device array is DELETED when the compiled program runs,
+and any later read dies with XLA's opaque "Array has been deleted".  This is
+the exact shape of the PR 2 snapshot bug: the async checkpoint held device
+references that the next fused step's donation invalidated.  The runtime
+twin is ``MXTPU_SANITIZE=donation`` (poisoned donated references raise a
+named error on CPU too, where XLA silently skips donation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..lint import Finding, dotted_name
+
+RULE_ID = "R002"
+TITLE = "donation-use-after-pass"
+
+
+def _donated_indices(call: ast.Call) -> Optional[List[int]]:
+    """Constant donate_argnums of a jit-like call, else None."""
+    name = dotted_name(call.func) or ""
+    if name.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return [e.value for e in v.elts]
+        return None          # computed argnums: can't map positions
+    return None
+
+
+def _scopes(tree):
+    yield tree
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            yield n
+
+
+def _pos(node) -> Tuple[int, int]:
+    return (node.lineno, node.col_offset)
+
+
+def _end(node) -> Tuple[int, int]:
+    return (getattr(node, "end_lineno", node.lineno),
+            getattr(node, "end_col_offset", node.col_offset))
+
+
+def check(ctx):
+    # pass 1 (whole module): names bound to a donating jit program
+    donated_fns: Dict[str, List[int]] = {}
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            idxs = _donated_indices(n.value)
+            if idxs is not None:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        donated_fns[t.id] = idxs
+
+    # pass 2 (per scope): donated calls vs later loads of the passed names
+    for scope in _scopes(ctx.tree):
+        body = scope.body if not isinstance(scope, ast.Lambda) else [scope.body]
+        calls: List[Tuple[ast.Call, List[str]]] = []
+        loads: Dict[str, List[Tuple[int, int]]] = {}
+        stores: Dict[str, List[Tuple[int, int]]] = {}
+        own_funcs = set()
+
+        def walk_scope(nodes):
+            for stmt in nodes:
+                for n in ast.walk(stmt):
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)) and n is not stmt:
+                        own_funcs.add(id(n))
+                    if any(id(a) in own_funcs for a in ctx.ancestors(n)):
+                        continue          # nested scope: analyzed separately
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        own_funcs.add(id(n))
+                        continue
+                    if isinstance(n, ast.Call):
+                        idxs = None
+                        if isinstance(n.func, ast.Name) \
+                                and n.func.id in donated_fns:
+                            idxs = donated_fns[n.func.id]
+                        elif isinstance(n.func, ast.Call):
+                            idxs = _donated_indices(n.func)
+                        if idxs:
+                            names = [a.id for i, a in enumerate(n.args)
+                                     if i in idxs and isinstance(a, ast.Name)]
+                            if names:
+                                calls.append((n, names))
+                    if isinstance(n, ast.Name):
+                        tgt = loads if isinstance(n.ctx, ast.Load) else stores
+                        tgt.setdefault(n.id, []).append(_pos(n))
+
+        walk_scope(body)
+
+        for call, names in calls:
+            callpos = _end(call)
+            # the statement holding the call: its assign targets rebind the
+            # name at the call itself (x = f(x) is the blessed pattern)
+            stmt = ctx.parent(call)
+            while stmt is not None and not isinstance(stmt, ast.stmt):
+                stmt = ctx.parent(stmt)
+            rebound_here = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            rebound_here.add(n.id)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) \
+                    and isinstance(stmt.target, ast.Name):
+                rebound_here.add(stmt.target.id)
+
+            enclosing_loop = next(
+                (a for a in ctx.ancestors(call)
+                 if isinstance(a, (ast.For, ast.While, ast.AsyncFor))), None)
+
+            for name in names:
+                if name in rebound_here:
+                    continue
+                next_store = min(
+                    (p for p in stores.get(name, []) if p > callpos),
+                    default=(1 << 30, 0))
+                bad = [p for p in loads.get(name, [])
+                       if callpos < p < next_store
+                       and not (_pos(call) <= p <= callpos)]
+                if bad:
+                    line, col = bad[0]
+                    yield Finding(
+                        ctx.path, line, col, RULE_ID,
+                        f"{TITLE}: '{name}' was passed at a donated argnum "
+                        f"on line {call.lineno} — its buffer is deleted on "
+                        f"accelerators; rebind the name to the program's "
+                        f"output before reading it again")
+                elif enclosing_loop is not None:
+                    loop_stores = [
+                        n for n in ast.walk(enclosing_loop)
+                        if isinstance(n, ast.Name) and n.id == name
+                        and isinstance(n.ctx, ast.Store)
+                        and not any(id(a) in own_funcs
+                                    for a in ctx.ancestors(n))]
+                    if not loop_stores:
+                        yield Finding(
+                            ctx.path, call.lineno, call.col_offset, RULE_ID,
+                            f"{TITLE}: '{name}' is passed at a donated "
+                            f"argnum inside a loop but never rebound — the "
+                            f"next iteration re-passes a deleted buffer")

@@ -1,0 +1,71 @@
+"""R005 overbroad-except: a handler that swallows KeyboardInterrupt /
+latched producer errors.
+
+A bare ``except:`` (or ``except BaseException:`` that neither re-raises nor
+binds-and-uses the error) eats ``KeyboardInterrupt`` and ``SystemExit`` —
+and in this codebase's producer/writer threads it also eats the error the
+consumer is waiting to re-raise (DeviceFeed latches producer exceptions;
+the checkpoint writer queues them for the next ``save()``).  A swallowed
+producer error turns a crash into a silent hang.  Handlers that latch the
+exception (``except BaseException as e: job.error = e``) or re-raise
+(``raise``) are the blessed patterns and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, dotted_name
+
+RULE_ID = "R005"
+TITLE = "overbroad-except"
+
+
+def _catches_base(handler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in types:
+        name = dotted_name(e) or ""
+        if name.rsplit(".", 1)[-1] in ("BaseException", "KeyboardInterrupt",
+                                       "SystemExit", "GeneratorExit"):
+            # catching KeyboardInterrupt/SystemExit on purpose and dropping
+            # them is the same hazard as BaseException
+            return True
+    return False
+
+
+def _handler_reraises(handler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+    return False
+
+
+def _handler_uses_binding(handler) -> bool:
+    if handler.name is None:
+        return False
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Name) and n.id == handler.name \
+                and isinstance(n.ctx, ast.Load):
+            return True
+    return False
+
+
+def check(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if not _catches_base(handler):
+                continue
+            if _handler_reraises(handler) or _handler_uses_binding(handler):
+                continue
+            what = "bare except:" if handler.type is None else \
+                f"except {ast.unparse(handler.type)}:"
+            yield Finding(
+                ctx.path, handler.lineno, handler.col_offset, RULE_ID,
+                f"{TITLE}: {what} swallows KeyboardInterrupt/SystemExit (and "
+                f"any latched producer error) — catch Exception, re-raise, "
+                f"or latch the bound error for the consumer")

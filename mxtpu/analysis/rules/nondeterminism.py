@@ -1,0 +1,59 @@
+"""R003 untracked-nondeterminism: host randomness / wall-clock in a traced
+step.
+
+``np.random.*`` or ``time.time()`` inside a jit-traced function is baked in
+as a CONSTANT at trace time: every subsequent step replays the same "random"
+draw (silently wrong dropout/sampling), and a checkpoint-resumed run can
+never replay the stream.  The framework's answer is ``mxtpu.rng``: keys ride
+as traced arguments (``rng.next_key()`` inside the step splits from a traced
+base key), so stochastic ops differ per step AND resume bit-exactly
+(``rng.get/set_state_blob``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, dotted_name
+
+RULE_ID = "R003"
+TITLE = "untracked-nondeterminism"
+
+_CLOCK_FUNCS = {"time.time", "time.time_ns", "time.perf_counter",
+                "time.perf_counter_ns", "time.monotonic",
+                "datetime.now", "datetime.utcnow",
+                "datetime.datetime.now", "datetime.datetime.utcnow"}
+_RANDOM_MODULE_FUNCS = {"random", "randint", "randrange", "uniform", "gauss",
+                        "normalvariate", "choice", "choices", "sample",
+                        "shuffle", "betavariate", "expovariate"}
+
+
+def check(ctx):
+    seen = set()
+    for fn in ctx.step_functions:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            name = dotted_name(node.func) or ""
+            hit = None
+            if name.startswith(("np.random.", "numpy.random.")):
+                hit = name
+            elif name.startswith("random.") \
+                    and name.split(".", 1)[1] in _RANDOM_MODULE_FUNCS:
+                hit = name
+            elif name in _CLOCK_FUNCS:
+                hit = name
+            if hit:
+                seen.add(key)
+                fix = ("draw from mxtpu.rng (keys ride as traced args and "
+                       "resume bit-exactly)" if "random" in hit
+                       else "hoist the clock read out of the step and pass "
+                            "it as a traced argument")
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, RULE_ID,
+                    f"{TITLE}: {hit}() inside a traced step is baked in as a "
+                    f"constant at trace time (same value every step, not "
+                    f"replayable after checkpoint resume) — {fix}")

@@ -1,0 +1,94 @@
+"""R004 thread-shared-mutable-without-lock: module state raced by threads.
+
+This codebase runs real producer threads — the DeviceFeed feeder
+(``device_feed.py``), the checkpoint writer (``checkpoint/manager.py``) —
+that bump module-level stat dicts (``profiler``'s counters) concurrently
+with the main thread.  CPython's GIL makes single bytecodes atomic but NOT
+read-modify-write sequences (``d[k] += 1``, paired ``total``/``last``
+updates), so unlocked counters silently drop updates or tear.  The rule
+fires on mutation of a module-level dict/list/set inside any function of a
+module that demonstrably spawns threads (constructs ``threading.Thread`` /
+``Lock`` / ``Event`` …), unless the mutation happens under a ``with <lock>``
+whose context name looks like (or is module-bound to) a lock.  The runtime
+twin is ``MXTPU_SANITIZE=threads`` (ownership-transition assertions).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, base_name, dotted_name
+
+RULE_ID = "R004"
+TITLE = "thread-shared-mutable-without-lock"
+
+_MUTATORS = {"update", "setdefault", "pop", "popitem", "clear", "append",
+             "extend", "insert", "remove", "add", "discard", "appendleft",
+             "sort", "reverse"}
+
+
+def _under_lock(ctx, node, lock_names) -> bool:
+    for a in ctx.ancestors(node):
+        if isinstance(a, (ast.With, ast.AsyncWith)):
+            for item in a.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func      # with lock_factory(): …
+                name = dotted_name(expr) or ""
+                leaf = name.rsplit(".", 1)[-1].lower()
+                if "lock" in leaf or "mutex" in leaf \
+                        or name in lock_names:
+                    return True
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break                         # don't credit an outer scope's with
+    return False
+
+
+def check(ctx):
+    if not ctx.spawns_threads():
+        return
+    mutables = ctx.module_mutables()
+    if not mutables:
+        return
+    lock_names = ctx.lock_names()
+    seen = set()
+
+    def flag(node, name, how):
+        key = (node.lineno, node.col_offset)
+        if key in seen:
+            return None
+        seen.add(key)
+        return Finding(
+            ctx.path, node.lineno, node.col_offset, RULE_ID,
+            f"{TITLE}: module-level '{name}' {how} without holding a lock, "
+            f"in a module that spawns threads — wrap the mutation in the "
+            f"module's lock (producer threads race the main thread on it)")
+
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            name, how, anchor = None, None, node
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        n = base_name(t)
+                        if n in mutables:
+                            name, how = n, "is written"
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    n = base_name(t)
+                    if isinstance(t, ast.Subscript) and n in mutables:
+                        name, how = n, "has an entry deleted"
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                n = base_name(node.func.value)
+                if n in mutables:
+                    name, how = n, f"is mutated via .{node.func.attr}()"
+            if name and not _under_lock(ctx, anchor, lock_names):
+                f = flag(anchor, name, how)
+                if f:
+                    yield f

@@ -157,6 +157,14 @@ class CheckpointManager:
                            arg_params=arg_params, aux_params=aux_params,
                            epoch=epoch, nbatch=nbatch, include_rng=include_rng,
                            extra_meta=extra_meta)
+        from ..analysis import sanitize
+        if "threads" in sanitize.active():
+            # ownership transition: the snapshot must be host-landed BEFORE
+            # save() returns — the caller's next fused step donates (and on
+            # accelerators deletes) the device buffers it would otherwise
+            # still reference (the PR 2 race this subsystem closed)
+            sanitize.assert_host_landed(
+                snapshot.arrays, origin=f"CheckpointManager.save(step={step})")
         job = _SaveJob(snapshot)
         self._ensure_writer()
         self._queue.put(job)
@@ -223,6 +231,12 @@ class CheckpointManager:
     def _write(self, job: _SaveJob):
         import jax
         from .. import profiler
+        from ..analysis import sanitize
+        if "threads" in sanitize.active():
+            # serialization is owned by the writer thread (blocking saves
+            # wait on job.done rather than writing inline)
+            sanitize.assert_owner_thread(self._thread,
+                                         origin="CheckpointManager._write")
         t0 = time.perf_counter()
         snap = job.snapshot.materialize()   # no-op: capture() landed on host
         step = snap.step

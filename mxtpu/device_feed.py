@@ -241,6 +241,12 @@ class DeviceFeed(DataIter):
                     break
                 staged = self._stage(batch)
                 batch = None
+                from .analysis import sanitize
+                if "threads" in sanitize.active():
+                    # ownership transition: once delivered, the consumer owns
+                    # the batch (and may donate its buffers) — a re-enqueue
+                    # here is the hazard the contract above forbids
+                    sanitize.assert_fresh_delivery(staged, origin="DeviceFeed")
                 if not gen.put(("data", staged)):
                     return
                 # donation safety: once the consumer can take the batch, the

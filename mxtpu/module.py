@@ -234,6 +234,9 @@ class BaseModule:
             train_data.reset()
             feed0 = profiler.get_feed_stats() if feed_on else None
             comm0 = profiler.get_comm_stats() if zero_on else None
+            from .analysis import sanitize
+            san_modes = sanitize.active()
+            san0 = profiler.get_sanitizer_stats() if san_modes else None
             for nbatch, data_batch in enumerate(train_data):
                 if resume_nbatch is not None and epoch == begin_epoch \
                         and nbatch <= resume_nbatch:
@@ -279,6 +282,19 @@ class BaseModule:
                         / max(zsteps, 1) / 1e6,
                         c["bucket_count"],
                         c["shard_bytes_per_device"] / 1e6)
+            if san0 is not None:
+                s = profiler.get_sanitizer_stats()
+                self.logger.info(
+                    "Epoch[%d] Sanitizer[%s]: transfer-guards=%d poisons=%d "
+                    "ownership-checks=%d retrace-escalations=%d, trips=%d",
+                    epoch, ",".join(sorted(san_modes)),
+                    s["transfer_guards"] - san0["transfer_guards"],
+                    s["donation_poisons_armed"]
+                    - san0["donation_poisons_armed"],
+                    s["ownership_checks"] - san0["ownership_checks"],
+                    s["retrace_escalations"] - san0["retrace_escalations"],
+                    profiler.sanitizer_violations(s)
+                    - profiler.sanitizer_violations(san0))
             if epoch_end_callback is not None:
                 arg, aux = self.get_params()
                 for cb in _as_list(epoch_end_callback):
@@ -505,7 +521,12 @@ class Module(BaseModule):
             try:
                 self._fused_step(data_batch)
                 return
-            except Exception:
+            except Exception as e:
+                from .analysis.sanitize import SanitizerError
+                if isinstance(e, SanitizerError):
+                    # a sanitizer escalation is a deliberate failure — the
+                    # eager fallback would hide the very hazard it names
+                    raise
                 # trace/compile failure (unsupported optimizer kernel, exotic
                 # block): permanently fall back to the eager path — behavior
                 # is preserved, only the fusion speedup is lost

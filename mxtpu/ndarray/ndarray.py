@@ -63,6 +63,15 @@ def _pop_capture():
     _captures().pop()
 
 
+# Donation-sanitizer read hook: None (a single global-load + is-None check on
+# the hot path) unless MXTPU_SANITIZE=donation armed it, in which case
+# mxtpu.analysis.sanitize installs its poison check here — a read of a buffer
+# a donate_argnums step consumed raises a named DonationError instead of
+# XLA's opaque "Array has been deleted" (or, on CPU, silently reading stale
+# data because XLA skips donation there).
+_sanitize_data_hook = None
+
+
 class NDArray:
     """Mutable tensor handle over an immutable ``jax.Array``."""
 
@@ -92,6 +101,8 @@ class NDArray:
     def data(self):
         """Current buffer; views re-slice lazily if the base was mutated since."""
         self._sync()
+        if _sanitize_data_hook is not None:
+            _sanitize_data_hook(self._data)
         stack = getattr(_capture_tls, "stack", None)
         if stack:  # control-flow subgraph input discovery (see ops/control_flow.py)
             stack[-1].append(self)

@@ -22,25 +22,39 @@ import jax
 _state = {"config": {"filename": "profile.json", "profile_all": False},
           "running": False, "dir": None, "events": [], "paused": False}
 
+# THE module stats lock. Every stat dict here (_state events, _ckpt, _feed,
+# _comm, _san) is bumped from more than one thread — the DeviceFeed producer
+# (device_feed.py), the checkpoint writer (checkpoint/manager.py), and the
+# main training thread — and read-modify-write pairs (total+last) tear
+# without mutual exclusion. One lock, never held across a call that could
+# re-acquire it (tpulint R004 is the static guard for this contract).
+_stats_lock = threading.Lock()
+
 
 def set_config(**kwargs):
     """profiler.set_config parity (filename, profile_{symbolic,imperative,memory,api},
     aggregate_stats…); unknown knobs are accepted and recorded."""
-    _state["config"].update(kwargs)
+    with _stats_lock:
+        _state["config"].update(kwargs)
 
 
 def set_state(state: str = "stop", profile_process: str = "worker"):
     if state == "run" and not _state["running"]:
         out_dir = os.path.splitext(_state["config"].get("filename", "profile.json"))[0] \
             + "_trace"
-        _state["dir"] = out_dir
+        with _stats_lock:
+            _state["dir"] = out_dir
         jax.profiler.start_trace(out_dir)
-        _state["running"] = True
+        with _stats_lock:
+            _state["running"] = True
     elif state == "stop":
         if _state["running"]:
             jax.profiler.stop_trace()
-            _state["running"] = False
-        _state.pop("resume_running", None)  # explicit stop cancels pause-resume
+            with _stats_lock:
+                _state["running"] = False
+        with _stats_lock:
+            # explicit stop cancels pause-resume
+            _state.pop("resume_running", None)
 
 
 def pause(profile_process: str = "worker"):
@@ -48,42 +62,52 @@ def pause(profile_process: str = "worker"):
     recording and the device trace is closed until resume()."""
     if _state["paused"]:
         return
-    _state["paused"] = True
+    with _stats_lock:
+        _state["paused"] = True
     if _state["running"]:
         jax.profiler.stop_trace()
-        _state["running"] = False
-        _state["resume_running"] = True
+        with _stats_lock:
+            _state["running"] = False
+            _state["resume_running"] = True
 
 
 def resume(profile_process: str = "worker"):
     if not _state["paused"]:
         return
-    _state["paused"] = False
-    if _state.pop("resume_running", False):
-        _state["segment"] = _state.get("segment", 0) + 1
-        out_dir = f"{_state['dir']}_resume{_state['segment']}"
-        _state["dir"] = out_dir  # dump() must point at the live trace dir
+    with _stats_lock:
+        _state["paused"] = False
+        restart = _state.pop("resume_running", False)
+        if restart:
+            _state["segment"] = _state.get("segment", 0) + 1
+            out_dir = f"{_state['dir']}_resume{_state['segment']}"
+            _state["dir"] = out_dir  # dump() must point at the live trace dir
+    if restart:
         jax.profiler.start_trace(out_dir)
-        _state["running"] = True
+        with _stats_lock:
+            _state["running"] = True
 
 
 def dump(finished: bool = True, profile_process: str = "worker"):
     """Stop tracing and write the chrome-tracing-compatible summary json."""
     if _state["running"]:
         set_state("stop")
-    fname = _state["config"].get("filename", "profile.json")
-    with open(fname, "w") as f:
-        json.dump({"traceEvents": _state["events"],
+    with _stats_lock:
+        fname = _state["config"].get("filename", "profile.json")
+        payload = {"traceEvents": list(_state["events"]),
                    "xplane_dir": _state["dir"],
-                   "displayTimeUnit": "ms"}, f)
+                   "displayTimeUnit": "ms"}
+    with open(fname, "w") as f:
+        json.dump(payload, f)
     return fname
 
 
 def get_summary(sort_by: str = "total") -> str:
     """Aggregate-stats table (MXAggregateProfileStatsPrint / aggregate_stats.cc
     parity): per-name count, total/avg/min/max duration over recorded events."""
+    with _stats_lock:
+        events = list(_state["events"])
     stats = {}
-    for e in _state["events"]:
+    for e in events:
         if e.get("ph") != "X":
             continue
         s = stats.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
@@ -110,13 +134,17 @@ def dumps(reset: bool = False) -> str:
     if _state["config"].get("aggregate_stats"):
         out = get_summary()
     else:
-        out = json.dumps({"traceEvents": _state["events"],
+        with _stats_lock:
+            events = list(_state["events"])
+        out = json.dumps({"traceEvents": events,
                           "compileCaches": get_compile_stats(),
                           "checkpoint": get_checkpoint_stats(),
                           "deviceFeed": get_feed_stats(),
-                          "comm": get_comm_stats()})
+                          "comm": get_comm_stats(),
+                          "sanitizer": get_sanitizer_stats()})
     if reset:
-        _state["events"] = []
+        with _stats_lock:
+            _state["events"] = []
     return out
 
 
@@ -136,43 +164,49 @@ _ckpt = dict(_CKPT_ZERO)
 def record_checkpoint_save(blocked_ms: float):
     """Training-thread side of an async save: how long the step was blocked
     on the snapshot handoff (device→host DMA start + enqueue)."""
-    _ckpt["saves"] += 1
-    _ckpt["blocked_step_ms_last"] = blocked_ms
-    _ckpt["blocked_step_ms_total"] += blocked_ms
+    with _stats_lock:
+        _ckpt["saves"] += 1
+        _ckpt["blocked_step_ms_last"] = blocked_ms
+        _ckpt["blocked_step_ms_total"] += blocked_ms
 
 
 def record_checkpoint_commit(write_ms: float, latency_ms: float, nbytes: int):
     """Writer-thread side: ``write_ms`` is the serialize+fsync+commit work,
     ``latency_ms`` the enqueue→commit wall time (queueing included),
     ``nbytes`` the committed payload size."""
-    _ckpt["commits"] += 1
-    _ckpt["write_ms_last"] = write_ms
-    _ckpt["save_latency_ms_last"] = latency_ms
-    _ckpt["save_latency_ms_total"] += latency_ms
-    _ckpt["committed_bytes"] += int(nbytes)
+    with _stats_lock:
+        _ckpt["commits"] += 1
+        _ckpt["write_ms_last"] = write_ms
+        _ckpt["save_latency_ms_last"] = latency_ms
+        _ckpt["save_latency_ms_total"] += latency_ms
+        _ckpt["committed_bytes"] += int(nbytes)
 
 
 def record_checkpoint_shard_write(write_ms: float):
     """Writer-thread side on ranks != 0: only this rank's shard write is
     measured — commit stats (count/bytes) belong to rank 0, which owns the
     rename and is the only rank that can see the final dir."""
-    _ckpt["shard_writes"] += 1
-    _ckpt["shard_write_ms_last"] = write_ms
+    with _stats_lock:
+        _ckpt["shard_writes"] += 1
+        _ckpt["shard_write_ms_last"] = write_ms
 
 
 def record_checkpoint_restore():
-    _ckpt["restores"] += 1
+    with _stats_lock:
+        _ckpt["restores"] += 1
 
 
 def get_checkpoint_stats() -> dict:
     """Checkpoint counters (saves/commits/restores, committed bytes, save
     latency, blocked-step time) — the observability contract of the async
     checkpoint subsystem; bench.py's `checkpoint` scenario reads these."""
-    return dict(_ckpt)
+    with _stats_lock:
+        return dict(_ckpt)
 
 
 def reset_checkpoint_stats():
-    _ckpt.update(_CKPT_ZERO)
+    with _stats_lock:
+        _ckpt.update(_CKPT_ZERO)
 
 
 # ---------------------------------------------------------------------------
@@ -185,14 +219,12 @@ _FEED_ZERO = {"batches_prefetched": 0, "batches_consumed": 0,
               "stall_ms_total": 0.0, "stall_ms_last": 0.0,
               "queue_depth_max": 0, "feed_depth": 0}
 _feed = dict(_FEED_ZERO)
-# the feed's producer thread and the training (consumer) thread both write
-_feed_lock = threading.Lock()
 
 
 def record_feed_transfer(nbytes: int, ms: float):
     """Producer-thread side: one array dispatched through the host→device
     boundary (``ms`` is the non-blocking dispatch wall time)."""
-    with _feed_lock:
+    with _stats_lock:
         _feed["transfer_count"] += 1
         _feed["transfer_bytes"] += int(nbytes)
         _feed["transfer_ms_total"] += ms
@@ -202,14 +234,14 @@ def record_feed_resident():
     """Producer-thread side: an array already committed with the target
     sharding was NOT re-transferred — the double-``device_put`` guard
     counter."""
-    with _feed_lock:
+    with _stats_lock:
         _feed["resident_skips"] += 1
 
 
 def record_feed_prefetch(queue_depth: int):
     """Producer-thread side: one batch staged device-resident; samples the
     queue-depth high-water mark."""
-    with _feed_lock:
+    with _stats_lock:
         _feed["batches_prefetched"] += 1
         if queue_depth > _feed["queue_depth_max"]:
             _feed["queue_depth_max"] = queue_depth
@@ -218,14 +250,14 @@ def record_feed_prefetch(queue_depth: int):
 def record_feed_consume(stall_ms: float):
     """Consumer-thread side: one batch taken; ``stall_ms`` is how long the
     step loop was blocked waiting on data (the input-stall metric)."""
-    with _feed_lock:
+    with _stats_lock:
         _feed["batches_consumed"] += 1
         _feed["stall_ms_last"] = stall_ms
         _feed["stall_ms_total"] += stall_ms
 
 
 def set_feed_depth(depth: int):
-    with _feed_lock:
+    with _stats_lock:
         _feed["feed_depth"] = int(depth)
 
 
@@ -235,13 +267,13 @@ def get_feed_stats() -> dict:
     contract of the device-feed pipeline. ``Speedometer`` prints these;
     ``bench.py input_pipeline`` reads them as the stall-fraction source of
     truth. Counters are monotone until :func:`reset_feed_stats`."""
-    with _feed_lock:
+    with _stats_lock:
         return dict(_feed)
 
 
 def reset_feed_stats():
     """Zero the feed counters (tests, per-epoch accounting, bench legs)."""
-    with _feed_lock:
+    with _stats_lock:
         _feed.update(_FEED_ZERO)
 
 
@@ -255,7 +287,6 @@ _COMM_ZERO = {"steps": 0, "zero_steps": 0,
               "collectives": 0, "collective_ms_total": 0.0,
               "collective_bytes": 0}
 _comm = dict(_COMM_ZERO)
-_comm_lock = threading.Lock()
 
 
 def record_comm_step(bytes_reduced: int = 0, bytes_gathered: int = 0,
@@ -267,7 +298,7 @@ def record_comm_step(bytes_reduced: int = 0, bytes_gathered: int = 0,
     (N-1)/N of the payload per device). The ZeRO path records reduce-scatter
     + all-gather legs; the replicated-psum path records the full all-reduce
     equivalent, so the two are directly comparable in ``bench.py zero_dp``."""
-    with _comm_lock:
+    with _stats_lock:
         _comm["steps"] += 1
         if zero:
             _comm["zero_steps"] += 1
@@ -282,7 +313,7 @@ def record_comm_step(bytes_reduced: int = 0, bytes_gathered: int = 0,
 def record_collective(ms: float, nbytes: int):
     """One host-blocking array-level collective (``parallel.collectives``
     cross-process exchange): measured wall ms + payload bytes."""
-    with _comm_lock:
+    with _stats_lock:
         _comm["collectives"] += 1
         _comm["collective_ms_total"] += ms
         _comm["collective_bytes"] += int(nbytes)
@@ -294,13 +325,55 @@ def get_comm_stats() -> dict:
     contract of the ZeRO-1 gradient path. ``Speedometer`` prints the per-step
     deltas; ``Module.fit`` logs them per epoch; ``bench.py zero_dp`` compares
     the ZeRO legs against the replicated all-reduce accounting."""
-    with _comm_lock:
+    with _stats_lock:
         return dict(_comm)
 
 
 def reset_comm_stats():
-    with _comm_lock:
+    with _stats_lock:
         _comm.update(_COMM_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# sanitizer observability (mxtpu.analysis.sanitize counters)
+# ---------------------------------------------------------------------------
+
+_SAN_ZERO = {"transfer_guards": 0, "transfer_trips": 0,
+             "donation_poisons_armed": 0, "donation_trips": 0,
+             "retrace_escalations": 0,
+             "ownership_checks": 0, "ownership_trips": 0}
+_san = dict(_SAN_ZERO)
+
+
+def record_sanitizer(key: str, n: int = 1):
+    """One sanitizer event (``mxtpu.analysis.sanitize``): guards armed and
+    poisons planted count the coverage a sanitized run actually had; trips
+    and escalations count violations (a clean run reports zero)."""
+    with _stats_lock:
+        _san[key] += int(n)
+
+
+def get_sanitizer_stats() -> dict:
+    """Sanitizer counters (transfer-guard arms/trips, donation poisons
+    armed/tripped, retrace escalations, ownership assertions checked/
+    tripped) — the observability contract of ``MXTPU_SANITIZE``.
+    ``compile_cache_summary()`` prints them, ``Module.fit`` logs the
+    per-epoch deltas, and ``bench.py --sanitize`` emits them as the
+    ``"sanitizer"`` JSON block."""
+    with _stats_lock:
+        return dict(_san)
+
+
+def sanitizer_violations(stats: Optional[dict] = None) -> int:
+    """Total violations in a stats snapshot (0 for a clean sanitized run)."""
+    s = stats if stats is not None else get_sanitizer_stats()
+    return (s["transfer_trips"] + s["donation_trips"]
+            + s["retrace_escalations"] + s["ownership_trips"])
+
+
+def reset_sanitizer_stats():
+    with _stats_lock:
+        _san.update(_SAN_ZERO)
 
 
 # ---------------------------------------------------------------------------
@@ -326,7 +399,8 @@ def reset_compile_stats(name: Optional[str] = None):
 
 
 def compile_cache_summary() -> str:
-    """Human-readable compile-cache table (pairs with get_summary())."""
+    """Human-readable compile-cache table (pairs with get_summary()), plus
+    the sanitizer counter line when a sanitized run recorded anything."""
     stats = get_compile_stats()
     lines = [f"{'Cache':<24s}{'Hits':>10s}{'Traces':>10s}{'Retraces':>10s}"]
     lines.append("-" * len(lines[0]))
@@ -334,6 +408,16 @@ def compile_cache_summary() -> str:
         s = stats[name]
         lines.append(f"{name:<24s}{s['hits']:>10d}{s['traces']:>10d}"
                      f"{s['retraces']:>10d}")
+    san = get_sanitizer_stats()
+    if any(san.values()):
+        lines.append(
+            f"sanitizer: transfer-guards={san['transfer_guards']} "
+            f"(trips {san['transfer_trips']}), "
+            f"poisons={san['donation_poisons_armed']} "
+            f"(trips {san['donation_trips']}), "
+            f"retrace-escalations={san['retrace_escalations']}, "
+            f"ownership={san['ownership_checks']} "
+            f"(trips {san['ownership_trips']})")
     return "\n".join(lines)
 
 
@@ -367,11 +451,12 @@ class _Scoped:
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             if not _state["paused"]:
-                _state["events"].append({
-                    "name": self.name, "ph": "X", "ts": self._t0 / 1000,
-                    "dur": (time.perf_counter_ns() - self._t0) / 1000,
-                    "pid": 0, "tid": 0,
-                    "cat": self.domain.name if self.domain else "default"})
+                with _stats_lock:
+                    _state["events"].append({
+                        "name": self.name, "ph": "X", "ts": self._t0 / 1000,
+                        "dur": (time.perf_counter_ns() - self._t0) / 1000,
+                        "pid": 0, "tid": 0,
+                        "cat": self.domain.name if self.domain else "default"})
             self._ann = None
 
     def __enter__(self):
@@ -402,9 +487,11 @@ class Counter:
     def set_value(self, value):
         self.value = value
         if not _state["paused"]:
-            _state["events"].append({"name": self.name, "ph": "C",
-                                     "ts": time.perf_counter_ns() / 1000,
-                                     "pid": 0, "args": {self.name: value}})
+            with _stats_lock:
+                _state["events"].append({"name": self.name, "ph": "C",
+                                         "ts": time.perf_counter_ns() / 1000,
+                                         "pid": 0,
+                                         "args": {self.name: value}})
 
     def increment(self, delta=1):
         self.set_value(self.value + delta)
@@ -419,6 +506,7 @@ class Marker:
 
     def mark(self, scope: str = "process"):
         if not _state["paused"]:
-            _state["events"].append({"name": self.name, "ph": "i",
-                                     "ts": time.perf_counter_ns() / 1000,
-                                     "pid": 0, "s": scope[0]})
+            with _stats_lock:
+                _state["events"].append({"name": self.name, "ph": "i",
+                                         "ts": time.perf_counter_ns() / 1000,
+                                         "pid": 0, "s": scope[0]})

@@ -153,6 +153,12 @@ def build_update_all(opt, lr_mults: Sequence[float], wd_mults: Sequence[float]):
     return update_all
 
 
+# component names of the StepExecutor._sig tuple, in order — the retrace
+# sanitizer uses them to label its signature diff ("params[0].dtype changed")
+_SIG_LABELS = ("data", "label", "params", "aux", "opt_states", "grad_req",
+               "opt_hyperparams", "zero")
+
+
 def _sharding_of(raw):
     # sharding participates in the executable's contract (same rationale as
     # CachedOp._shard_key): re-placed arrays must retrace
@@ -213,6 +219,8 @@ class StepExecutor:
         self.loss_fn = loss_fn
         self.trainer = trainer
         self._cache: Dict[tuple, dict] = {}
+        self._cache_name = cache_name
+        self._last_sig: Optional[tuple] = None
         self._stats = cache_stats(cache_name)
         self._param_handles = list(trainer._params)
         self._aux_handles = [p for p in trainer._all_params
@@ -422,8 +430,10 @@ class StepExecutor:
         ``loss`` (per-sample array), ``outputs``, and ``exposed`` (softmaxed
         outputs when the loss is classification, else None)."""
         from . import rng
+        from .analysis import sanitize
         from .ndarray.ndarray import NDArray
 
+        san = sanitize.active()
         tr = self.trainer
         tr._init_kvstore()
         opt = tr._optimizer
@@ -443,11 +453,20 @@ class StepExecutor:
 
         sig = self._sig(data, label)
         entry = self._cache.get(sig)
-        if entry is None:
+        traced_now = entry is None
+        if traced_now:
+            if "retrace" in san and self._cache:
+                # raises RetraceError with a labeled signature diff BEFORE
+                # paying for the compile; the limit defaults to 2 (train +
+                # eval — the compile-guard contract)
+                sanitize.escalate_retrace(self._cache_name, len(self._cache),
+                                          self._last_sig, sig,
+                                          labels=_SIG_LABELS)
             self._stats.miss()
             entry = self._cache[sig] = self._build()
         else:
             self._stats.hit()
+        self._last_sig = sig
 
         t = max([opt._index_update_count.get(i, 0)
                  for i in range(len(self._param_handles))] or [0]) + 1
@@ -461,16 +480,45 @@ class StepExecutor:
                            if opt.clip_gradient is not None else 0.0)
         key = rng.next_key()
 
-        out = entry["jitted"](
-            [p._data._data for p in self._param_handles],
-            [p._data._data for p in self._aux_handles],
-            list(tr._states),
-            list(tr._zero_states), list(tr._zero_residuals),
-            [d.data for d in data],
-            label.data if label is not None else None,
-            lr, wd, rescale, clip, t, key)
+        # donated argument groups, held as locals so the donation sanitizer
+        # can poison exactly what the compiled program consumed. ``t`` goes
+        # in as int32 so the transfer guard sees no per-step host scalar.
+        param_raws = [p._data._data for p in self._param_handles]
+        aux_raws = [p._data._data for p in self._aux_handles]
+        state_raws = list(tr._states)
+        zstate_raws = list(tr._zero_states)
+        zres_raws = list(tr._zero_residuals)
+        data_raws = [d.data for d in data]
+        label_raw = label.data if label is not None else None
+        t_arr = jnp.int32(t)
+        with sanitize.step_guard(san, traced_now, where=self._cache_name):
+            out = entry["jitted"](
+                param_raws, aux_raws, state_raws, zstate_raws, zres_raws,
+                data_raws, label_raw, lr, wd, rescale, clip, t_arr, key)
         (new_params, new_aux, new_states, new_zstates, new_zres, grads,
          loss_arr, raw_outs, exposed0) = out
+
+        if "donation" in san:
+            # the program consumed argnums (0, 2, 3, 4): params, optimizer
+            # slots, ZeRO slots/residuals. Poison the old references (minus
+            # pass-throughs the program returned unchanged) so a stale read
+            # raises a NAMED error here on CPU too — where XLA skips
+            # donation and the PR 2 snapshot race was silent.
+            donated = list(param_raws)
+            for st in state_raws:
+                donated.extend(st or ())
+            for st in zstate_raws:
+                donated.extend(st or ())
+            donated.extend(r for r in zres_raws if r is not None)
+            returned = {id(v) for v in new_params}
+            for group in (new_states, new_zstates):
+                for st in group:
+                    returned.update(id(s) for s in (st or ()))
+            returned.update(id(r) for r in new_zres if r is not None)
+            sanitize.poison(
+                (a for a in donated if id(a) not in returned),
+                origin=f"the fused '{self._cache_name}' step "
+                       f"(donate_argnums params/opt-state)")
 
         # write-back: params/aux/state swap + eager-visible gradients
         for p, v in zip(self._param_handles, new_params):

@@ -1,0 +1,555 @@
+"""mxtpu.analysis — tpulint rules + runtime sanitizer suite.
+
+Per rule: one positive (a fixture the rule MUST flag — each is the shape of
+a real bug from this repo's history), one negative (the blessed pattern it
+must NOT flag), one suppressed (``# mxtpu: ignore[Rnnn]`` silences exactly
+that line).  Sanitizer side: each mode's trip raises its NAMED error (the
+acceptance contract: an injected donation-reuse / host-sync is caught with
+the rule name in the message), the retrace escalation's diff names the
+changed signature key, and the profiler counters record coverage.
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, profiler
+from mxtpu.analysis import (DonationError, HostSyncError, RetraceError,
+                            ThreadOwnershipError, lint_source, sanitize)
+from mxtpu.analysis.sanitize import sig_diff
+from mxtpu.gluon import nn
+from mxtpu.gluon.block import HybridBlock
+from mxtpu.io import DataBatch, DataDesc
+
+
+def _lint(src, **kw):
+    return lint_source(textwrap.dedent(src), path="fixture.py", **kw)
+
+
+def _rules_hit(src, **kw):
+    return {f.rule for f in _lint(src, **kw)}
+
+
+# ---------------------------------------------------------------------------
+# R001 host-sync-in-step
+# ---------------------------------------------------------------------------
+
+def test_r001_positive_flags_host_sync_in_jitted_fn():
+    findings = _lint("""
+        import jax, numpy as np
+        def pure(x):
+            y = float(x)
+            z = np.asarray(x)
+            return x.asnumpy()
+        f = jax.jit(pure, donate_argnums=())
+    """, select=["R001"])
+    assert len(findings) == 3
+    assert all(f.rule == "R001" for f in findings)
+    assert "host sync" in findings[0].message
+
+
+def test_r001_negative_host_sync_outside_step_and_static_int():
+    assert _rules_hit("""
+        import jax, numpy as np
+        def pure(x):
+            n = int(x.shape[0])        # static at trace time: fine
+            return x * n
+        f = jax.jit(pure)
+        def host_side(arr):
+            return float(arr.sum())    # not traced: fine
+    """, select=["R001"]) == set()
+
+
+def test_r001_suppressed():
+    findings = _lint("""
+        import jax
+        def pure(x):
+            return float(x)  # mxtpu: ignore[R001]
+        f = jax.jit(pure)
+    """, select=["R001"])
+    assert findings == []
+
+
+def test_r001_decorator_and_nested_helper():
+    # @jax.jit decoration and a local helper called from the traced body
+    # are both in the traced set
+    findings = _lint("""
+        import jax
+        def helper(x):
+            return x.item()
+        @jax.jit
+        def step(x):
+            return helper(x)
+    """, select=["R001"])
+    assert len(findings) == 1 and findings[0].rule == "R001"
+
+
+def test_r001_same_name_method_not_dragged_in():
+    # lexical resolution: a traced inner `def step` must not pull a
+    # same-named eager method into the traced set (data_parallel.py shape)
+    assert _rules_hit("""
+        import jax
+        class Trainer:
+            def build(self):
+                def step(params, x):
+                    return params * x
+                self._fn = jax.jit(step)
+            def step(self, x):
+                return float(self._fn(1.0, x))   # eager sync: fine
+    """, select=["R001"]) == set()
+
+
+# ---------------------------------------------------------------------------
+# R002 donation-use-after-pass
+# ---------------------------------------------------------------------------
+
+def test_r002_positive_read_after_donated_pass():
+    findings = _lint("""
+        import jax
+        g = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+        def run(x, y):
+            out = g(x, y)
+            return x + out
+    """, select=["R002"])
+    assert len(findings) == 1
+    assert "donated argnum" in findings[0].message
+
+
+def test_r002_positive_loop_without_rebind():
+    findings = _lint("""
+        import jax
+        g = jax.jit(lambda a: a * 2, donate_argnums=(0,))
+        def run(x, n):
+            for _ in range(n):
+                out = g(x)
+            return out
+    """, select=["R002"])
+    assert len(findings) == 1
+    assert "loop" in findings[0].message
+
+
+def test_r002_negative_rebind_is_blessed():
+    assert _rules_hit("""
+        import jax
+        g = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+        def run(x, y):
+            x = g(x, y)        # rebound at the call: the blessed pattern
+            return x + 1.0
+        def loop(x, y):
+            for _ in range(3):
+                x = g(x, y)
+            return x
+    """, select=["R002"]) == set()
+
+
+def test_r002_suppressed():
+    findings = _lint("""
+        import jax
+        g = jax.jit(lambda a: a * 2, donate_argnums=(0,))
+        def run(x):
+            out = g(x)
+            return x + out  # mxtpu: ignore[R002]
+    """, select=["R002"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R003 untracked-nondeterminism
+# ---------------------------------------------------------------------------
+
+def test_r003_positive_np_random_and_clock_in_step():
+    findings = _lint("""
+        import jax, numpy as np, time
+        def pure(x):
+            noise = np.random.rand(4)
+            t0 = time.time()
+            return x + noise + t0
+        f = jax.jit(pure)
+    """, select=["R003"])
+    assert len(findings) == 2
+    assert "mxtpu.rng" in findings[0].message        # the fix it points at
+
+
+def test_r003_negative_host_side_random():
+    assert _rules_hit("""
+        import numpy as np
+        def make_batch(rs):
+            return np.random.rand(32, 16)     # host-side data gen: fine
+    """, select=["R003"]) == set()
+
+
+def test_r003_suppressed():
+    findings = _lint("""
+        import jax, numpy as np
+        def pure(x):
+            return x + np.random.rand(4)  # mxtpu: ignore[R003]
+        f = jax.jit(pure)
+    """, select=["R003"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R004 thread-shared-mutable-without-lock
+# ---------------------------------------------------------------------------
+
+_R004_POSITIVE = """
+    import threading
+    _stats = {"n": 0}
+    def bump():
+        _stats["n"] += 1
+    def start():
+        threading.Thread(target=bump).start()
+"""
+
+
+def test_r004_positive_unlocked_module_dict():
+    findings = _lint(_R004_POSITIVE, select=["R004"])
+    assert len(findings) == 1
+    assert "_stats" in findings[0].message
+
+
+def test_r004_fires_on_the_pre_fix_profiler_shape():
+    # the exact satellite bug: _ckpt bumped from the checkpoint writer
+    # thread while _feed sits safely under its lock
+    findings = _lint("""
+        import threading
+        _lock = threading.Lock()
+        _ckpt = {"saves": 0}
+        _feed = {"n": 0}
+        def record_save():
+            _ckpt["saves"] += 1
+        def record_feed():
+            with _lock:
+                _feed["n"] += 1
+    """, select=["R004"])
+    assert len(findings) == 1
+    assert "_ckpt" in findings[0].message
+
+
+def test_r004_negative_under_lock_or_unthreaded():
+    assert _rules_hit("""
+        import threading
+        _lock = threading.Lock()
+        _stats = {"n": 0}
+        def bump():
+            with _lock:
+                _stats["n"] += 1
+        def start():
+            threading.Thread(target=bump).start()
+    """, select=["R004"]) == set()
+    # no thread evidence: a module-level cache mutated freely is fine
+    assert _rules_hit("""
+        _cache = {}
+        def put(k, v):
+            _cache[k] = v
+    """, select=["R004"]) == set()
+
+
+def test_r004_suppressed():
+    src = _R004_POSITIVE.replace('_stats["n"] += 1',
+                                 '_stats["n"] += 1  # mxtpu: ignore[R004]')
+    assert _lint(src, select=["R004"]) == []
+
+
+# ---------------------------------------------------------------------------
+# R005 overbroad-except
+# ---------------------------------------------------------------------------
+
+def test_r005_positive_bare_and_baseexception_swallow():
+    findings = _lint("""
+        def a():
+            try:
+                work()
+            except:
+                pass
+        def b():
+            try:
+                work()
+            except BaseException:
+                cleanup()
+    """, select=["R005"])
+    assert len(findings) == 2
+    assert "KeyboardInterrupt" in findings[0].message
+
+
+def test_r005_negative_reraise_and_latch():
+    # the two blessed shapes from this codebase: atomic_io re-raises,
+    # DeviceFeed/_writer_loop latch the bound error for the consumer
+    assert _rules_hit("""
+        def reraises():
+            try:
+                work()
+            except BaseException:
+                cleanup()
+                raise
+        def latches(job):
+            try:
+                work()
+            except BaseException as e:
+                job.error = e
+        def narrow():
+            try:
+                work()
+            except Exception:
+                pass
+    """, select=["R005"]) == set()
+
+
+def test_r005_suppressed():
+    findings = _lint("""
+        def a():
+            try:
+                work()
+            except:  # mxtpu: ignore[R005]
+                pass
+    """, select=["R005"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# linter plumbing
+# ---------------------------------------------------------------------------
+
+def test_bare_ignore_suppresses_all_rules():
+    findings = _lint("""
+        import jax
+        def pure(x):
+            return float(x)  # mxtpu: ignore
+        f = jax.jit(pure)
+    """)
+    assert findings == []
+
+
+def test_syntax_error_becomes_finding_not_crash():
+    findings = _lint("def broken(:\n")
+    assert len(findings) == 1 and findings[0].rule == "E000"
+
+
+def test_select_and_ignore_filters():
+    src = """
+        import jax, numpy as np
+        def pure(x):
+            return float(x) + np.random.rand(1)
+        f = jax.jit(pure)
+    """
+    assert _rules_hit(src) == {"R001", "R003"}
+    assert _rules_hit(src, ignore=["R003"]) == {"R001"}
+    assert _rules_hit(src, select=["R003"]) == {"R003"}
+
+
+def test_cli_list_rules_and_exit_codes(tmp_path):
+    from mxtpu.analysis.__main__ import main
+    assert main(["--list-rules"]) == 0
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert main([str(clean)]) == 0
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import jax\n"
+                     "def pure(x):\n"
+                     "    return float(x)\n"
+                     "f = jax.jit(pure)\n")
+    assert main([str(dirty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizers
+# ---------------------------------------------------------------------------
+
+class _Net(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Dense(10, in_units=16)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class _HostSyncNet(HybridBlock):
+    """Deliberately injected host-sync: np.asarray on the traced input."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Dense(10, in_units=16)
+
+    def forward(self, x):
+        np.asarray(x.data)                     # mxtpu: ignore[R001]
+        return self.fc(x)
+
+
+def _module(block=None, batch=8):
+    mx.rng.seed(0)
+    mod = mx.Module(block if block is not None else _Net(),
+                    data_names=("data",), label_names=("softmax_label",))
+    mod.bind(data_shapes=[DataDesc("data", (batch, 16))],
+             label_shapes=[DataDesc("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+def _batch(batch=8, dtype=np.float32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = nd.array(rs.rand(batch, 16).astype(dtype))
+    y = nd.array(rs.randint(0, 10, batch).astype(np.float32))
+    return DataBatch(data=[x], label=[y])
+
+
+def test_sanitize_configure_rejects_typos():
+    with pytest.raises(ValueError, match="unknown mode"):
+        sanitize.configure("donatoin")
+    sanitize.configure("")       # restore the default-off state
+
+
+def test_sanitize_scope_restores_previous_state():
+    before = sanitize.active()
+    with sanitize.scope("donation,threads") as modes:
+        assert modes == frozenset({"donation", "threads"})
+    assert sanitize.active() == before
+
+
+def test_donation_trip_named_error():
+    """Injected donation-reuse: a stale handle onto a param buffer read
+    AFTER the next fused (donating) step raises DonationError naming R002 —
+    the PR 2 snapshot race, caught by name instead of XLA's opaque error."""
+    profiler.reset_sanitizer_stats()
+    with sanitize.scope("donation"):
+        mod = _module()
+        b = _batch()
+        mod.forward_backward(b)
+        mod.update()
+        p = next(iter(mod._block.collect_params().values()))
+        stale = nd.NDArray(p._data._data)      # aliases the live buffer
+        mod.forward_backward(b)                # donates it
+        mod.update()
+        with pytest.raises(DonationError, match=r"R002"):
+            stale.asnumpy()
+    stats = profiler.get_sanitizer_stats()
+    assert stats["donation_poisons_armed"] > 0
+    assert stats["donation_trips"] == 1
+
+
+def test_donation_clean_reads_unaffected():
+    profiler.reset_sanitizer_stats()
+    with sanitize.scope("donation"):
+        mod = _module()
+        b = _batch()
+        for _ in range(3):
+            mod.forward_backward(b)
+            mod.update()
+        p = next(iter(mod._block.collect_params().values()))
+        assert np.isfinite(p.data().asnumpy()).all()   # live handle: fine
+    assert profiler.get_sanitizer_stats()["donation_trips"] == 0
+
+
+def test_hostsync_trip_named_error():
+    """Injected host-sync inside the step fn: caught as HostSyncError naming
+    R001 (instead of a raw 300-line tracer error, and instead of the eager
+    fallback silently absorbing it)."""
+    profiler.reset_sanitizer_stats()
+    with sanitize.scope("transfers"):
+        mod = _module(_HostSyncNet())
+        with pytest.raises(HostSyncError, match=r"R001"):
+            mod.forward_backward(_batch())
+    assert profiler.get_sanitizer_stats()["transfer_trips"] == 1
+
+
+def test_transfers_clean_run_arms_guards():
+    profiler.reset_sanitizer_stats()
+    with sanitize.scope("transfers"):
+        mod = _module()
+        b = _batch()
+        for _ in range(4):
+            mod.forward_backward(b)
+            mod.update()
+    stats = profiler.get_sanitizer_stats()
+    assert stats["transfer_guards"] >= 3       # every cache-hit step guarded
+    assert stats["transfer_trips"] == 0
+
+
+def test_retrace_escalation_diff_names_changed_key():
+    """A dtype flip mid-loop escalates into RetraceError whose message names
+    the changed signature component (data[0].dtype), not just 'retraced'."""
+    profiler.reset_sanitizer_stats()
+    with sanitize.scope("retrace", retrace_limit=1):
+        mod = _module()
+        mod.forward_backward(_batch())
+        mod.update()
+        with pytest.raises(RetraceError, match=r"data\[0\]\.dtype"):
+            mod.forward_backward(_batch(dtype=np.float16))
+    assert profiler.get_sanitizer_stats()["retrace_escalations"] == 1
+
+
+def test_retrace_limit_allows_train_eval_pair():
+    # default limit 2: a second signature (the eval pass) must NOT escalate
+    profiler.reset_sanitizer_stats()
+    with sanitize.scope("retrace"):
+        mod = _module()
+        mod.forward_backward(_batch())
+        mod.update()
+        mod.forward_backward(_batch(batch=4))     # second signature: allowed
+        mod.update()
+    assert profiler.get_sanitizer_stats()["retrace_escalations"] == 0
+
+
+def test_sig_diff_names_field_and_component():
+    old = ((( (8, 16), "float32", None),), ((), "x"))
+    new = ((( (8, 16), "float16", None),), ((), "x"))
+    d = sig_diff(old, new, labels=("data", "rest"))
+    assert "data[0].dtype" in d
+    assert "'float32' -> 'float16'" in d
+
+
+def test_ownership_fresh_delivery_trip():
+    with sanitize.scope("threads"):
+        b = _batch()
+        sanitize.assert_fresh_delivery(b, origin="test-feed")
+        with pytest.raises(ThreadOwnershipError, match="re-enqueued"):
+            sanitize.assert_fresh_delivery(b, origin="test-feed")
+
+
+def test_ownership_host_landed_trip():
+    import jax.numpy as jnp
+    with sanitize.scope("threads"):
+        sanitize.assert_host_landed({"arg:w": np.zeros(3)}, origin="t")
+        with pytest.raises(ThreadOwnershipError, match="host-landed"):
+            sanitize.assert_host_landed({"arg:w": jnp.zeros(3)}, origin="t")
+
+
+def test_device_feed_clean_under_threads_mode():
+    from mxtpu.device_feed import DeviceFeed
+    profiler.reset_sanitizer_stats()
+    with sanitize.scope("threads"):
+        rs = np.random.RandomState(0)
+        batches = [(rs.rand(4, 2).astype(np.float32),
+                    rs.rand(4).astype(np.float32)) for _ in range(5)]
+        feed = DeviceFeed(iter(batches), depth=2)
+        n = sum(1 for _ in feed)
+        assert n == 5
+    stats = profiler.get_sanitizer_stats()
+    assert stats["ownership_checks"] >= 5
+    assert stats["ownership_trips"] == 0
+
+
+def test_checkpoint_save_checked_under_threads_mode(tmp_path):
+    from mxtpu.checkpoint import CheckpointManager
+    profiler.reset_sanitizer_stats()
+    with sanitize.scope("threads"):
+        mod = _module()
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+        mgr.save(1, module=mod, blocking=True)
+        mgr.close()
+    stats = profiler.get_sanitizer_stats()
+    assert stats["ownership_checks"] >= 2      # host-landed + writer-owned
+    assert stats["ownership_trips"] == 0
+
+
+def test_sanitizer_stats_reset_and_summary_line():
+    profiler.reset_sanitizer_stats()
+    assert profiler.sanitizer_violations() == 0
+    profiler.record_sanitizer("transfer_guards")
+    assert "sanitizer:" in profiler.compile_cache_summary()
+    profiler.reset_sanitizer_stats()
+    assert not any(profiler.get_sanitizer_stats().values())

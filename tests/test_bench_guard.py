@@ -44,3 +44,30 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json():
     assert zdp["dp"] >= 1
     assert zdp["zero1"]["opt_state_bytes_per_device"] > 0
     assert zdp["replicated"]["step_ms"] > 0 and zdp["zero1"]["step_ms"] > 0
+
+
+def test_bench_sanitized_leg_exits_zero_with_no_violations():
+    """``bench.py --sanitize`` (ISSUE 5 satellite): the cpu-fallback child
+    must still exit 0 with the sanitizers armed, emit the ``"sanitizer"``
+    JSON block, and report ZERO violations — the committed training/
+    checkpoint/input-pipeline paths are sanitizer-clean by contract."""
+    env = conftest.subprocess_env()
+    env["MXTPU_BENCH_FALLBACK"] = "1"
+    env["MXTPU_BENCH_SMOKE"] = "1"
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--sanitize"],
+        env=env, capture_output=True, text=True, timeout=480)
+    assert p.returncode == 0, (
+        f"bench.py --sanitize child exited rc={p.returncode}\n"
+        f"stderr tail:\n{p.stderr[-2000:]}")
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    doc = json.loads(lines[-1])
+    san = doc["sanitizer"]
+    assert san["violations"] == 0, san
+    assert set(san["modes"]) == {"transfers", "donation", "retrace",
+                                 "threads"}
+    # the sanitized leg demonstrably ran its detectors
+    assert san["stats"]["transfer_guards"] > 0
+    assert san["stats"]["donation_poisons_armed"] > 0
+    assert san["stats"]["ownership_checks"] > 0
+    assert san["step_ms_sanitized"] > 0
