@@ -21,6 +21,16 @@ fp32 convolutions on TPU execute as bf16 passes on the MXU, so the bf16 peak
 is the denominator for both precisions.
 
 Prints ONE JSON line on stdout; the detailed report goes to stderr.
+
+Scoreboard contract (ROADMAP item 4): every scenario runs under ``run_leg``
+crash containment — one retry with backoff on transient backend errors
+(UNAVAILABLE / init failures), an ``{"error": ...}`` leg entry otherwise —
+so the JSON line always ships with rc=0 and every healthy leg populated.
+Headline metrics (img/s, MFU, steps/s) ratchet against
+``BENCH_BASELINE.json`` (``apply_ratchet``: baselines only move up;
+regressions beyond MXTPU_BENCH_RATCHET_TOL are reported, never fatal). The
+``"mfu"`` and ``"trace"`` blocks come from ``mxtpu.observability`` — see
+docs/observability.md.
 """
 
 from __future__ import annotations
@@ -51,17 +61,6 @@ import numpy as np
 
 BASELINE_IMG_S = 109.0  # ResNet-50, 1x K80, batch 32 (BASELINE.md row 5)
 
-# documented bf16 peak TFLOP/s per chip kind (public spec sheets)
-_PEAK_TFLOPS = {
-    "TPU v5 lite": 197.0,   # v5e
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,        # v5p
-    "TPU v5p": 459.0,
-    "TPU v4": 275.0,
-    "TPU v6 lite": 918.0,   # v6e (Trillium)
-    "TPU v6e": 918.0,
-}
-
 TRAIN_CONFIGS = [
     # (tag, dtype, batch, sync_steps, pipelined_steps, micro_batches)
     # mfu_probe (benchmark/python/mfu_probe.py, round 4): the step is
@@ -90,15 +89,99 @@ def log(msg):
 
 
 def _device_peak():
-    import jax
-    kind = jax.devices()[0].device_kind
-    peak = _PEAK_TFLOPS.get(kind)
-    if peak is None:
-        for k, v in _PEAK_TFLOPS.items():
-            if k in kind:
-                peak = v
-                break
-    return kind, peak
+    """Chip kind + documented peak TFLOP/s — the canonical table now lives in
+    ``mxtpu.observability.flops`` (cpu hosts get the nominal ratchet
+    heuristic documented there)."""
+    from mxtpu.observability import flops as flops_mod
+    return flops_mod.device_peak()
+
+
+# ---------------------------------------------------------------------------
+# scoreboard hardening (ROADMAP item 4: a transient backend UNAVAILABLE must
+# never erase the whole round again — BENCH_r05 rc=1 lost every leg)
+# ---------------------------------------------------------------------------
+
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",
+                      "ABORTED", "Unable to initialize", "failed to initialize",
+                      "Socket closed", "Connection reset", "handshake")
+
+
+def _is_transient(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(m.lower() in msg.lower() for m in _TRANSIENT_MARKERS)
+
+
+def _retry_backoff_s() -> float:
+    try:
+        return float(os.environ.get("MXTPU_BENCH_RETRY_BACKOFF_S", "2.0"))
+    except ValueError:
+        return 2.0
+
+
+def _parse_fail_spec() -> dict:
+    """Fault-injection seam (tests): ``MXTPU_BENCH_FAIL_LEG=leg[:n][,leg2…]``
+    makes the named leg raise a simulated transient backend error — ``n``
+    times (then succeed; exercises the retry path) or every time when ``n``
+    is omitted (exercises the error-JSON path)."""
+    spec = os.environ.get("MXTPU_BENCH_FAIL_LEG", "")
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, _, n = part.partition(":")
+            try:
+                out[name] = int(n)
+            except ValueError:
+                out[name] = -1
+        else:
+            out[part] = -1          # -1: fail every attempt
+    return out
+
+
+_FAIL_LEGS = _parse_fail_spec()
+
+
+def _maybe_inject_failure(name: str):
+    left = _FAIL_LEGS.get(name)
+    if left is None or left == 0:
+        return
+    if left > 0:
+        _FAIL_LEGS[name] = left - 1
+    raise RuntimeError(
+        f"UNAVAILABLE: injected transient backend error for leg {name!r} "
+        "(MXTPU_BENCH_FAIL_LEG test seam)")
+
+
+def run_leg(name: str, fn, *args, **kwargs):
+    """Run one scoreboard scenario under the crash containment contract:
+    transient backend errors get ONE retry with backoff; any failure becomes
+    a ``{"error": ...}`` leg result instead of killing the process, so the
+    JSON line always ships with every other leg populated (rc stays 0)."""
+    for attempt in (0, 1):
+        try:
+            _maybe_inject_failure(name)
+            return fn(*args, **kwargs)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            err = f"{type(e).__name__}: {e}"
+            if attempt == 0 and _is_transient(e):
+                backoff = _retry_backoff_s()
+                log(f"[bench] leg {name!r} hit a transient backend error "
+                    f"({err}); retrying once in {backoff:.1f}s")
+                time.sleep(backoff)
+                continue
+            import traceback
+            log(f"[bench] leg {name!r} FAILED ({'after retry' if attempt else 'non-transient'}):\n"
+                + traceback.format_exc())
+            return {"error": err, "leg": name, "retried": attempt == 1}
+    return {"error": "unreachable", "leg": name}
+
+
+def _leg_ok(res) -> bool:
+    return isinstance(res, dict) and "error" not in res
 
 
 def bench_train(tag, dtype, batch, sync_steps, pipelined_steps,
@@ -185,7 +268,12 @@ def bench_train(tag, dtype, batch, sync_steps, pipelined_steps,
     return {
         "img_s": round(img_s, 1),
         "step_ms": round(step_ms, 3),
+        "steps_per_sec": round(1e3 / step_ms, 3),
         "sync_step_ms_median": round(float(np.median(sync_times)) * 1e3, 3),
+        # per-step tail latency (sync distribution — includes one tunnel
+        # round-trip per sample, so an upper bound; see module docstring)
+        "p50_step_ms": round(float(np.percentile(sync_times, 50)) * 1e3, 3),
+        "p99_step_ms": round(float(np.percentile(sync_times, 99)) * 1e3, 3),
         "xla_gflops_per_step": round(xla_flops / 1e9, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
     }
@@ -1094,6 +1182,180 @@ def bench_zero_dp(steps: int = 16, batch: int = 64, hidden: int = 512):
     return out
 
 
+def bench_trace(steps: Optional[int] = None, batch: int = 32):
+    """Unified-tracing scenario: arms the span recorder over a fused-step
+    loop fed by the DeviceFeed producer plus one async checkpoint save, dumps
+    the chrome://tracing JSON, and reports what the dump contains (events,
+    span categories, named thread rows) — the machine-checkable form of the
+    tentpole contract. Also measures the SAME loop with tracing off, so the
+    JSON carries the tracing-on overhead and the off-path throughput the
+    <2%-regression acceptance compares against."""
+    import tempfile
+
+    from mxtpu import profiler
+    from mxtpu.checkpoint import CheckpointManager
+    from mxtpu.device_feed import DeviceFeed
+    from mxtpu.observability import tracer
+
+    smoke = os.environ.get("MXTPU_BENCH_SMOKE") == "1"
+    steps = steps if steps is not None else (6 if smoke else 24)
+    was_on = tracer.enabled()
+
+    mod = _lenet_module(batch)
+
+    def loop(traced: bool) -> float:
+        feed = DeviceFeed(_SyntheticDecodeIter(steps, batch, 0.0), depth=2)
+        if traced:
+            tracer.start()
+        try:
+            t0 = time.perf_counter()
+            for b in feed:
+                mod.forward_backward(b)
+                mod.update()
+            float(mod._loss_val.mean().data)    # sync
+            return time.perf_counter() - t0
+        finally:
+            if traced and not was_on:
+                tracer.stop()
+
+    # compile both input flavors outside the timed windows
+    warm = DeviceFeed(_SyntheticDecodeIter(1, batch, 0.0), depth=1)
+    for b in warm:
+        mod.forward_backward(b)
+        mod.update()
+
+    # alternate off/traced legs and take each side's best: a single ordering
+    # consistently charges the first timed loop with straggler warmup (feed
+    # thread spin-up, allocator steady-state) on loaded hosts
+    off_s = loop(traced=False)
+    tracer.reset()
+    on_s = loop(traced=True)
+    off_s = min(off_s, loop(traced=False))
+    tracer.reset()
+    on_s = min(on_s, loop(traced=True))
+
+    d = tempfile.mkdtemp(prefix="mxtpu-bench-trace-")
+    try:
+        # one traced async checkpoint save: ckpt/snapshot on the main thread,
+        # ckpt/write + ckpt/commit on the writer's own tid row
+        tracer.start()
+        mgr = CheckpointManager(d)
+        mgr.save(0, module=mod, blocking=True)
+        mgr.close()
+        if not was_on:
+            tracer.stop()
+        fname = os.path.join(d, "trace.json")
+        saved_filename = profiler._state["config"].get("filename")
+        profiler.set_config(filename=fname, xplane=False)
+        try:
+            profiler.dump(finished=False)   # live snapshot: no freeze
+        finally:
+            profiler.set_config(filename=saved_filename)
+        with open(fname) as f:
+            doc = json.load(f)
+        dump_bytes = os.path.getsize(fname)
+    finally:
+        import shutil
+        shutil.rmtree(d, ignore_errors=True)
+
+    evs = doc["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    cats = sorted({e.get("cat", "") for e in evs
+                   if e.get("ph") in ("X", "C")})
+    threads = sorted({e["args"]["name"] for e in evs
+                      if e.get("ph") == "M" and e.get("name") == "thread_name"})
+    out = {"steps": steps,
+           "events": len(evs),
+           "spans": len(spans),
+           "span_categories": cats,
+           "span_names": sorted({e["name"] for e in spans}),
+           "threads": threads,
+           "dump_bytes": dump_bytes,
+           "steps_per_s_off": round(steps / off_s, 2),
+           "steps_per_s_traced": round(steps / on_s, 2),
+           "overhead_frac_traced": round(on_s / max(off_s, 1e-9) - 1.0, 4)}
+    if not was_on:
+        profiler.reset_trace()              # leave no spans for later legs
+    log(f"[trace] {out['spans']} spans / {out['events']} events, "
+        f"categories={cats}, threads={threads}; traced overhead "
+        f"{out['overhead_frac_traced']*100:+.1f}% "
+        f"({out['steps_per_s_off']} -> {out['steps_per_s_traced']} steps/s)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MFU / steps-per-sec regression ratchet (ROADMAP item 5: "speed wins are
+# ratcheted, not re-lost")
+# ---------------------------------------------------------------------------
+
+
+def _ratchet_path() -> str:
+    return os.environ.get("MXTPU_BENCH_BASELINE_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+
+
+def apply_ratchet(doc: dict, harness: str):
+    """Compare this run's headline metrics against ``BENCH_BASELINE.json``
+    and write the new baseline CANDIDATE back (per-harness key; each metric
+    only ever moves UP — the ratchet). A drop beyond the tolerance
+    (``MXTPU_BENCH_RATCHET_TOL``, default 10%) is reported in the
+    ``"ratchet"`` JSON block and logged — never fatal: the ratchet is a
+    tripwire for the reviewer, not a gate that can erase a scoreboard.
+    Smoke runs ratchet under a separate ``<harness>-smoke`` key so shrunken
+    iteration counts never poison the real baseline."""
+    try:
+        if os.environ.get("MXTPU_BENCH_SMOKE") == "1":
+            harness += "-smoke"
+        mfu_field = doc.get("mfu")
+        block = mfu_field if isinstance(mfu_field, dict) \
+            else doc.get("mfu_stats") or {}
+        mfu_val = mfu_field if isinstance(mfu_field, (int, float)) \
+            else block.get("mfu")
+        metrics = {}
+        for key, val in (("img_s", doc.get("value")), ("mfu", mfu_val),
+                         ("steps_per_sec", block.get("steps_per_sec"))):
+            if isinstance(val, (int, float)) and val > 0:
+                metrics[key] = val
+        path = _ratchet_path()
+        data = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                data = {}
+        if not isinstance(data, dict):
+            data = {}
+        prev = dict(data.get(harness) or {})
+        try:
+            tol = float(os.environ.get("MXTPU_BENCH_RATCHET_TOL", "0.10"))
+        except ValueError:
+            tol = 0.10
+        regressions = {k: {"baseline": prev[k], "current": v,
+                           "ratio": round(v / prev[k], 4)}
+                       for k, v in metrics.items()
+                       if k in prev and v < prev[k] * (1 - tol)}
+        wrote = None
+        if metrics and os.environ.get("MXTPU_BENCH_NO_BASELINE") != "1":
+            new_base = dict(prev)
+            for k, v in metrics.items():
+                new_base[k] = max(prev.get(k, 0.0), v)   # only ever up
+            data[harness] = new_base
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+            wrote = path
+        doc["ratchet"] = {"harness": harness, "tolerance": tol,
+                          "current": metrics, "baseline": prev or None,
+                          "regressions": regressions, "baseline_file": wrote}
+        if regressions:
+            log(f"[ratchet] REGRESSION (> {tol:.0%} below baseline): "
+                f"{regressions}")
+    except Exception as e:   # the ratchet must never kill the scoreboard
+        doc["ratchet"] = {"error": f"{type(e).__name__}: {e}"}
+
+
 def _sanitize_requested() -> bool:
     """``--sanitize`` flag (forwarded through the cpu-fallback re-exec)."""
     return "--sanitize" in sys.argv
@@ -1136,11 +1398,30 @@ def bench_sanitizer(smoke: bool = False):
         sanitized_ms = train_leg()
         ckpt = bench_checkpoint(iters=1 if smoke else 2)
         pipe = bench_input_pipeline(steps=4 if smoke else 16)
+        # sanitizers + tracing must compose (the transfer guard wraps the
+        # same dispatch the span annotates): one TRACED leg inside the
+        # sanitized scope, counted into the same zero-violations contract
+        from mxtpu.observability import tracer as _tracer
+        from mxtpu.observability import export as _export
+        was_on = _tracer.enabled()
+        _tracer.start()
+        try:
+            traced_ms = train_leg()
+        finally:
+            if not was_on:
+                _tracer.stop()
+        traced_events = sum(len(evs) for _, _, evs, _
+                            in _tracer.snapshot_buffers())
+        traced_cats = sorted({e.get("cat", "") for e
+                              in _export.collect_events()
+                              if e.get("ph") in ("X", "C")})
+        if not was_on:
+            _tracer.reset()
     stats = profiler.get_sanitizer_stats()
     violations = profiler.sanitizer_violations(stats)
     out = {
         "modes": ["transfers", "donation", "retrace", "threads"],
-        "scenarios": ["train", "checkpoint", "input_pipeline"],
+        "scenarios": ["train", "checkpoint", "input_pipeline", "traced"],
         "step_ms_plain": round(plain_ms, 3),
         "step_ms_sanitized": round(sanitized_ms, 3),
         "overhead_frac": round(sanitized_ms / max(plain_ms, 1e-9) - 1.0, 4),
@@ -1150,6 +1431,9 @@ def bench_sanitizer(smoke: bool = False):
         "checkpoint": {"async_blocked_frac": ckpt["async_blocked_frac"]},
         "input_pipeline": {"feed_stall_frac":
                            pipe["device_feed"]["stall_frac"]},
+        "traced_leg": {"step_ms": round(traced_ms, 3),
+                       "events": traced_events,
+                       "span_categories": traced_cats},
     }
     log(f"[sanitizer] step {plain_ms:.2f} -> {sanitized_ms:.2f} ms "
         f"({out['overhead_frac']*100:+.1f}%), "
@@ -1160,20 +1444,15 @@ def bench_sanitizer(smoke: bool = False):
     return out
 
 
-def bench_cpu_fallback():
-    """Reduced harness for hosts where the TPU backend won't initialize
-    (BENCH_r05 regression: rc=1 'Unable to initialize backend'). Emits the
-    single-line JSON with ``"fallback": "cpu"`` instead of crashing: a
-    LeNet-scale training loop through the Module API — which also exercises
-    the fused StepExecutor path — sized to finish in seconds on one core.
-    ``MXTPU_BENCH_SMOKE=1`` shrinks every leg's iteration counts (same code
-    paths, same JSON keys) so the tier-1 bench guard can run this harness as
-    a fast regression test."""
-    import jax
-    from mxtpu import nd, profiler
+def _fallback_train_leg(smoke: bool) -> dict:
+    """The fallback harness's train leg: a LeNet loop through the fused
+    StepExecutor, measured three ways — a sync-per-step latency distribution
+    (p50/p99 via the observability step ring), a pipelined throughput run,
+    and the MFU roll-up from the compiled program's FLOP estimate."""
+    from mxtpu import nd
     from mxtpu.io import DataBatch
+    from mxtpu.observability import flops as flops_mod
 
-    smoke = os.environ.get("MXTPU_BENCH_SMOKE") == "1"
     batch, steps = 32, (4 if smoke else 20)
     rs = np.random.RandomState(0)
     x = nd.array(rs.rand(batch, 1, 28, 28).astype(np.float32))
@@ -1183,6 +1462,17 @@ def bench_cpu_fallback():
     mod.forward_backward(b)       # compile + first step
     mod.update()
     loss_start = float(mod._loss_val.mean().data)
+
+    # per-step latency distribution (each sample host-synced on the loss)
+    flops_mod.reset_steps()
+    for _ in range(3 if smoke else 8):
+        t1 = time.perf_counter()
+        mod.forward_backward(b)
+        mod.update()
+        float(mod._loss_val.mean().data)
+        flops_mod.record_step(time.perf_counter() - t1)
+
+    # pipelined throughput (one final readback syncs the chain)
     t0 = time.perf_counter()
     for _ in range(steps):
         mod.forward_backward(b)
@@ -1190,33 +1480,86 @@ def bench_cpu_fallback():
     loss_end = float(mod._loss_val.mean().data)
     dt = time.perf_counter() - t0
     img_s = steps * batch / dt
-    # the checkpoint + input-pipeline + zero_dp scenarios reuse the cpu
-    # backend — the fallback path must keep emitting the same keys as the
-    # full harness
-    ckpt = bench_checkpoint(module=mod, iters=2 if smoke else 5)
-    pipe = bench_input_pipeline(steps=8 if smoke else 48)
-    zdp = bench_zero_dp(steps=4 if smoke else 16,
-                        hidden=128 if smoke else 512)
-    san = bench_sanitizer(smoke=smoke) if _sanitize_requested() else None
+
+    pflops = mod._program_flops()
+    mstats = flops_mod.get_mfu_stats(flops_per_step=pflops)
+    steps_per_sec = round(steps / dt, 3)
+    mfu = None
+    if pflops and mstats["peak_tflops"]:
+        # throughput-based MFU (the pipelined run, not the synced samples)
+        mfu = round((pflops * steps / dt) / (mstats["peak_tflops"] * 1e12), 6)
+    return {
+        "module": mod,
+        "img_s": round(img_s, 1),
+        "loss_start": round(loss_start, 3),
+        "loss_end": round(loss_end, 3),
+        "mfu": {"mfu": mfu,
+                "steps_per_sec": steps_per_sec,
+                "p50_step_ms": mstats["p50_step_ms"],
+                "p99_step_ms": mstats["p99_step_ms"],
+                "flops_per_step": pflops,
+                "device_kind": mstats["device_kind"],
+                "peak_tflops": mstats["peak_tflops"],
+                "source": "lenet_fused_step"},
+    }
+
+
+def bench_cpu_fallback():
+    """Reduced harness for hosts where the TPU backend won't initialize
+    (BENCH_r05 regression: rc=1 'Unable to initialize backend'). Emits the
+    single-line JSON with ``"fallback": "cpu"`` instead of crashing: a
+    LeNet-scale training loop through the Module API — which also exercises
+    the fused StepExecutor path — sized to finish in seconds on one core.
+    Every leg runs under :func:`run_leg` crash containment (one retry on
+    transient backend errors, ``{"error": ...}`` otherwise), so a single bad
+    scenario can never erase the scoreboard again. ``MXTPU_BENCH_SMOKE=1``
+    shrinks every leg's iteration counts (same code paths, same JSON keys)
+    so the tier-1 bench guard can run this harness as a fast regression
+    test."""
+    import jax
+    from mxtpu import profiler
+
+    smoke = os.environ.get("MXTPU_BENCH_SMOKE") == "1"
+    train = run_leg("train", _fallback_train_leg, smoke)
+    mod = train.pop("module", None) if isinstance(train, dict) else None
+    # the checkpoint + input-pipeline + zero_dp + trace scenarios reuse the
+    # cpu backend — the fallback path must keep emitting the same keys as
+    # the full harness
+    ckpt = run_leg("checkpoint", bench_checkpoint, module=mod,
+                   iters=2 if smoke else 5)
+    pipe = run_leg("input_pipeline", bench_input_pipeline,
+                   steps=8 if smoke else 48)
+    zdp = run_leg("zero_dp", bench_zero_dp, steps=4 if smoke else 16,
+                  hidden=128 if smoke else 512)
+    trace = run_leg("trace", bench_trace)
+    san = run_leg("sanitizer", bench_sanitizer, smoke=smoke) \
+        if _sanitize_requested() else None
     caches = profiler.get_compile_stats()
-    log(f"[cpu-fallback] lenet b{batch}: {img_s:.0f} img/s, loss "
-        f"{loss_start:.3f} -> {loss_end:.3f}, "
-        f"step traces={caches.get('module_step', {}).get('traces')}")
+    if _leg_ok(train):
+        log(f"[cpu-fallback] lenet b32: {train['img_s']:.0f} img/s, loss "
+            f"{train['loss_start']:.3f} -> {train['loss_end']:.3f}, "
+            f"step traces={caches.get('module_step', {}).get('traces')}")
     doc = {
         "metric": "lenet_train_imgs_per_sec",
-        "value": round(img_s, 1),
+        "value": train.get("img_s", 0.0) if isinstance(train, dict) else 0.0,
         "unit": "images/sec",
         "fallback": "cpu",
         "platform": jax.default_backend(),
-        "loss_start": round(loss_start, 3),
-        "loss_end": round(loss_end, 3),
+        "loss_start": train.get("loss_start"),
+        "loss_end": train.get("loss_end"),
+        "mfu": train.get("mfu", {"error": "train leg failed"}),
         "checkpoint": ckpt,
         "input_pipeline": pipe,
         "zero_dp": zdp,
+        "trace": trace,
         "compile_caches": caches,
     }
+    if not _leg_ok(train):
+        doc["error_train"] = train.get("error") if isinstance(train, dict) \
+            else str(train)
     if san is not None:
         doc["sanitizer"] = san
+    apply_ratchet(doc, harness="cpu-fallback")
     print(json.dumps(doc))
 
 
@@ -1255,35 +1598,54 @@ def main():
             or jax.default_backend() == "cpu":
         bench_cpu_fallback()
         return
+    # every scenario runs under run_leg crash containment: one retry with
+    # backoff on transient backend errors (UNAVAILABLE / init failures), an
+    # {"error": ...} leg entry otherwise — the scoreboard always ships
     train = {}
     for cfg in TRAIN_CONFIGS:
-        train[cfg[0]] = bench_train(*cfg)
-    e2e = bench_train_e2e(train.get("bf16_b128", {}).get("step_ms"))
-    tlm = bench_transformer_lm()                       # d1024 L8 (flagship)
-    tlm_wide = bench_transformer_lm(preset="wide")     # d2048 L4: MXU ceiling
-    mfus = [m for m in (tlm["mfu"], tlm_wide["mfu"]) if m is not None]
+        train[cfg[0]] = run_leg(f"train_{cfg[0]}", bench_train, *cfg)
+    bf16 = train.get("bf16_b128", {})
+    e2e = run_leg("train_e2e", bench_train_e2e,
+                  bf16.get("step_ms") if isinstance(bf16, dict) else None)
+    tlm = run_leg("transformer_lm", bench_transformer_lm)
+    tlm_wide = run_leg("transformer_lm_wide", bench_transformer_lm,
+                       preset="wide")
+    mfus = [m.get("mfu") for m in (tlm, tlm_wide)
+            if _leg_ok(m) and m.get("mfu") is not None]
     tlm = {"flagship": tlm, "wide": tlm_wide,
            "best_mfu": max(mfus) if mfus else None}
-    lm = bench_word_lm()
-    score = bench_inference()
-    attn = bench_attention()
-    pipe = bench_pipeline()
-    i8 = bench_int8()
-    comm = bench_comm()
-    ckpt = bench_checkpoint()
-    feed_pipe = bench_input_pipeline()
-    zdp = bench_zero_dp()
-    san = bench_sanitizer() if _sanitize_requested() else None
+    lm = run_leg("word_lm", bench_word_lm)
+    score = run_leg("inference", bench_inference)
+    attn = run_leg("attention", bench_attention)
+    pipe = run_leg("pipeline", bench_pipeline)
+    i8 = run_leg("int8", bench_int8)
+    comm = run_leg("comm", bench_comm)
+    ckpt = run_leg("checkpoint", bench_checkpoint)
+    feed_pipe = run_leg("input_pipeline", bench_input_pipeline)
+    zdp = run_leg("zero_dp", bench_zero_dp)
+    trace = run_leg("trace", bench_trace)
+    san = run_leg("sanitizer", bench_sanitizer) \
+        if _sanitize_requested() else None
 
-    best_tag = max(train, key=lambda t: train[t]["img_s"])
-    best = train[best_tag]
+    ok_train = {t: r for t, r in train.items() if _leg_ok(r)}
+    if ok_train:
+        best_tag = max(ok_train, key=lambda t: ok_train[t]["img_s"])
+        best = ok_train[best_tag]
+    else:
+        best_tag, best = None, {}
     doc = {
         "metric": "resnet50_train_imgs_per_sec",
-        "value": best["img_s"],
+        "value": best.get("img_s", 0.0),
         "unit": "images/sec",
-        "vs_baseline": round(best["img_s"] / BASELINE_IMG_S, 3),
+        "vs_baseline": round(best.get("img_s", 0.0) / BASELINE_IMG_S, 3),
         "config": best_tag,
-        "mfu": best["mfu"],
+        "mfu": best.get("mfu"),
+        "mfu_stats": {"mfu": best.get("mfu"),
+                      "steps_per_sec": best.get("steps_per_sec"),
+                      "p50_step_ms": best.get("p50_step_ms"),
+                      "p99_step_ms": best.get("p99_step_ms"),
+                      "source": f"train_{best_tag}" if best_tag else None,
+                      "best_transformer_mfu": tlm["best_mfu"]},
         "train": train,
         "train_e2e": e2e,
         "transformer_lm": tlm,
@@ -1296,10 +1658,12 @@ def main():
         "checkpoint": ckpt,
         "input_pipeline": feed_pipe,
         "zero_dp": zdp,
+        "trace": trace,
         "compile_caches": _compile_caches(),
     }
     if san is not None:
         doc["sanitizer"] = san
+    apply_ratchet(doc, harness="accelerator")
     print(json.dumps(doc))
 
 

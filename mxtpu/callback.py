@@ -20,7 +20,10 @@ class Speedometer:
     ``frequent``. When the device-feed input pipeline is active, each line
     also reports the input-stall per batch since the last print and the
     prefetch queue high-water mark (``profiler.get_feed_stats()``) — the
-    at-a-glance "is training input-bound?" readout."""
+    at-a-glance "is training input-bound?" readout. When the fit loop has
+    been recording step latencies (``observability.flops`` ring), the line
+    also carries the rolling p50/p99 step time — the tail-latency readout
+    the MFU scoreboard ratchets on."""
 
     def __init__(self, batch_size: int, frequent: int = 50, auto_reset: bool = True):
         self.batch_size = batch_size
@@ -46,6 +49,16 @@ class Speedometer:
             return ""
         return (f"\tinput-stall: {stall / consumed:.2f} ms/batch "
                 f"(queue hw {f['queue_depth_max']}/{f['feed_depth']})")
+
+    def _step_msg(self) -> str:
+        """Rolling p50/p99 step latency from the observability step ring
+        ('' when nothing recorded a step — e.g. outside ``Module.fit``)."""
+        from .observability import flops
+        s = flops.get_mfu_stats()
+        if not s["steps"]:
+            return ""
+        return (f"\tstep: p50={s['p50_step_ms']:.2f} ms "
+                f"p99={s['p99_step_ms']:.2f} ms")
 
     def _comm_msg(self) -> str:
         """Δ gradient-comm per step since the last print ('' when no ZeRO
@@ -75,7 +88,7 @@ class Speedometer:
                 # (coarse clocks / fused fast steps) — never divide by zero
                 elapsed = max(time.time() - self.tic, 1e-9)
                 speed = self.frequent * self.batch_size / elapsed
-                feed = self._feed_msg() + self._comm_msg()
+                feed = self._feed_msg() + self._comm_msg() + self._step_msg()
                 if param.eval_metric is not None:
                     nv = param.eval_metric.get_name_value()
                     if self.auto_reset:

@@ -151,12 +151,14 @@ class CheckpointManager:
         save's error surfaces at the NEXT ``save()`` /
         ``wait_until_finished()`` / ``close()``."""
         from .. import profiler
+        from ..observability import tracer
         self._raise_pending_error()
         t0 = time.perf_counter()
-        snapshot = capture(step, module=module, trainer=trainer,
-                           arg_params=arg_params, aux_params=aux_params,
-                           epoch=epoch, nbatch=nbatch, include_rng=include_rng,
-                           extra_meta=extra_meta)
+        with tracer.span("ckpt/snapshot", cat="ckpt", args={"step": int(step)}):
+            snapshot = capture(step, module=module, trainer=trainer,
+                               arg_params=arg_params, aux_params=aux_params,
+                               epoch=epoch, nbatch=nbatch,
+                               include_rng=include_rng, extra_meta=extra_meta)
         from ..analysis import sanitize
         if "threads" in sanitize.active():
             # ownership transition: the snapshot must be host-landed BEFORE
@@ -232,6 +234,7 @@ class CheckpointManager:
         import jax
         from .. import profiler
         from ..analysis import sanitize
+        from ..observability import tracer
         if "threads" in sanitize.active():
             # serialization is owned by the writer thread (blocking saves
             # wait on job.done rather than writing inline)
@@ -244,24 +247,27 @@ class CheckpointManager:
         rank = jax.process_index()
         if "before_write" in self._test_hooks:
             self._test_hooks["before_write"]()
-        if rank == 0:
-            # Only the committing rank may sweep: a non-zero rank returns
-            # from the barrier before rank 0 has renamed the PREVIOUS step's
-            # staging dir, so its sweep could rmtree a dir rank 0 is about to
-            # os.replace. Rank 0's writer is serial — by the time it starts
-            # step N, step N-1 is committed.
-            atomic_io.sweep_stale_staging(
-                self.directory, self.step_prefix,
-                keep={name + atomic_io.TMP_SUFFIX})
-        stage = atomic_io.staging_dir(self.directory, name)
-        self._write_arrays(stage, snap, rank)
+        with tracer.span("ckpt/write", cat="ckpt", args={"step": int(step)}):
+            if rank == 0:
+                # Only the committing rank may sweep: a non-zero rank returns
+                # from the barrier before rank 0 has renamed the PREVIOUS
+                # step's staging dir, so its sweep could rmtree a dir rank 0
+                # is about to os.replace. Rank 0's writer is serial — by the
+                # time it starts step N, step N-1 is committed.
+                atomic_io.sweep_stale_staging(
+                    self.directory, self.step_prefix,
+                    keep={name + atomic_io.TMP_SUFFIX})
+            stage = atomic_io.staging_dir(self.directory, name)
+            self._write_arrays(stage, snap, rank)
         shard_ms = (time.perf_counter() - t0) * 1e3
         self._barrier()                     # every rank's shard is on disk
         if rank == 0:
-            with open(os.path.join(stage, _META_FILE), "w") as f:
-                json.dump(snap.meta, f)
-            atomic_io.commit_dir(self.directory, name, fsync=self.fsync,
-                                 hooks=self._test_hooks)
+            with tracer.span("ckpt/commit", cat="ckpt",
+                             args={"step": int(step)}):
+                with open(os.path.join(stage, _META_FILE), "w") as f:
+                    json.dump(snap.meta, f)
+                atomic_io.commit_dir(self.directory, name, fsync=self.fsync,
+                                     hooks=self._test_hooks)
             self._gc()
             # commit stats only on the rank that committed — other ranks
             # would read dir_bytes of a not-yet-renamed staging dir (0) and

@@ -50,6 +50,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P, SingleDeviceSharding
 from . import profiler
 from .io import DataBatch, DataIter
 from .ndarray.ndarray import NDArray
+from .observability import tracer
 
 __all__ = ["DeviceFeed", "feed_enabled", "default_depth", "maybe_device_feed"]
 
@@ -239,7 +240,11 @@ class DeviceFeed(DataIter):
                     batch = next(src)
                 except StopIteration:
                     break
-                staged = self._stage(batch)
+                # producer-thread span: one batch through the host→device
+                # boundary (its own tid row in the trace, overlapping the
+                # consumer's feed/stall spans when the pipeline is behind)
+                with tracer.span("feed/transfer", cat="feed"):
+                    staged = self._stage(batch)
                 batch = None
                 from .analysis import sanitize
                 if "threads" in sanitize.active():
@@ -253,7 +258,9 @@ class DeviceFeed(DataIter):
                 # feeder must hold NO reference a donate_argnums step could
                 # race against — and a batch is never re-enqueued
                 staged = None
-                profiler.record_feed_prefetch(gen.queue.qsize())
+                depth = gen.queue.qsize()
+                profiler.record_feed_prefetch(depth)
+                tracer.counter("feed/queue_depth", depth)
         except BaseException as e:  # latched: visible even if the put is lost
             gen.error = e
             gen.put(("error", e))
@@ -275,17 +282,20 @@ class DeviceFeed(DataIter):
     def next(self) -> DataBatch:
         gen = self._ensure()
         t0 = time.perf_counter()
-        while True:
-            try:
-                kind, payload = gen.queue.get(timeout=0.1)
-                break
-            except queue.Empty:
-                if gen.error is not None:
-                    raise gen.error
-                if gen.thread is not None and not gen.thread.is_alive():
-                    raise RuntimeError(
-                        "DeviceFeed producer thread died without delivering "
-                        "a batch or an exception")
+        # consumer-side span: how long the step loop waited on the queue —
+        # the input-stall metric as a timeline interval
+        with tracer.span("feed/stall", cat="feed"):
+            while True:
+                try:
+                    kind, payload = gen.queue.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    if gen.error is not None:
+                        raise gen.error
+                    if gen.thread is not None and not gen.thread.is_alive():
+                        raise RuntimeError(
+                            "DeviceFeed producer thread died without "
+                            "delivering a batch or an exception")
         stall_ms = (time.perf_counter() - t0) * 1e3
         if kind == "error":
             raise payload

@@ -143,7 +143,13 @@ class FeedForward:
         through the device-feed input pipeline (``device_feed.DeviceFeed``:
         async prefetch of device-resident batches; opt-out
         ``MXTPU_DEVICE_FEED=0``), so the legacy estimator surface gets the
-        overlapped host→device boundary for free."""
+        overlapped host→device boundary for free — along with the unified
+        step timeline: with ``MXTPU_TRACE=1`` (or
+        ``profiler.set_state('run')``) every epoch's fused steps, feed
+        transfers/stalls, and checkpoint writes land as spans in
+        ``profiler.dump()``'s chrome-trace JSON, and the per-epoch log
+        carries steps/s, p50/p99 step latency, and MFU
+        (``profiler.get_mfu_stats()``)."""
         assert self.num_epoch is not None, "num_epoch required"
         data = self._init_iter(X, y, is_train=True)
         if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:

@@ -68,6 +68,12 @@ class BaseModule:
         """Blocks a Monitor should hook (valid after bind/init_params)."""
         return []
 
+    def _program_flops(self):
+        """FLOPs of one execution of the current compiled step program, when
+        the subclass runs the fused StepExecutor path (None otherwise) — the
+        numerator of the fit loop's per-epoch MFU roll-up."""
+        return None
+
     # shared loop ----------------------------------------------------------
     def forward_backward(self, data_batch: DataBatch):
         self.forward(data_batch, is_train=True)
@@ -228,10 +234,12 @@ class BaseModule:
             for b in self._monitor_blocks():
                 monitor.install(b)
 
+        from .observability import flops as flops_mod
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             train_data.reset()
+            flops_mod.reset_steps()   # per-epoch step-latency/MFU window
             feed0 = profiler.get_feed_stats() if feed_on else None
             comm0 = profiler.get_comm_stats() if zero_on else None
             from .analysis import sanitize
@@ -243,9 +251,13 @@ class BaseModule:
                     continue   # batches 0..nbatch of the saved epoch are done
                 if monitor is not None:
                     monitor.tic()
+                t_step = time.perf_counter()
                 self.forward_backward(data_batch)
                 self.update()
+                # update_metric reads the outputs back, so the sample below
+                # is a host-synced step wall time, not just dispatch
                 self.update_metric(eval_metric, data_batch.label)
+                flops_mod.record_step(time.perf_counter() - t_step)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -254,6 +266,15 @@ class BaseModule:
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            mstats = flops_mod.get_mfu_stats(
+                flops_per_step=self._program_flops())
+            if mstats["steps"]:
+                mfu_msg = (", MFU=%.1f%%" % (100 * mstats["mfu"])
+                           if mstats["mfu"] is not None else "")
+                self.logger.info(
+                    "Epoch[%d] Speed: %.2f steps/s, step p50=%.2f ms "
+                    "p99=%.2f ms%s", epoch, mstats["steps_per_sec"],
+                    mstats["p50_step_ms"], mstats["p99_step_ms"], mfu_msg)
             if feed0 is not None:
                 f = profiler.get_feed_stats()
                 consumed = f["batches_consumed"] - feed0["batches_consumed"]
@@ -355,6 +376,11 @@ class Module(BaseModule):
 
     def _monitor_blocks(self):
         return [self._block]
+
+    def _program_flops(self):
+        if self._step_exec is None:
+            return None
+        return self._step_exec.program_flops()
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -724,6 +750,10 @@ class BucketingModule(BaseModule):
 
     def _monitor_blocks(self):
         return self._curr._monitor_blocks() if self._curr else []
+
+    def _program_flops(self):
+        # per-bucket programs differ in shape; report the current bucket's
+        return self._curr._program_flops() if self._curr else None
 
 
 class SequentialModule(BaseModule):

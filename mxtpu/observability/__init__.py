@@ -1,0 +1,42 @@
+"""mxtpu.observability — unified step-timeline tracing + MFU accounting.
+
+The reference ships profiling as a first-class subsystem (``src/profiler/``:
+chrome://tracing export, aggregate stats, Domain/Task/Counter/Marker
+objects). This package is that subsystem TPU-natively, unifying every
+instrumentation point the framework already had — the fused-step cache, the
+DeviceFeed producer, the ZeRO comm path, the async checkpoint writer — into
+**spans on one step timeline**:
+
+* :mod:`.tracer` — per-thread span recorder (lock-free-ish bounded rings;
+  near-zero cost when off; ``MXTPU_TRACE=1`` or ``profiler.set_state('run')``
+  arms it; spans mirror into ``jax.profiler.TraceAnnotation``).
+* :mod:`.export` — chrome-trace JSON serialization (pid/tid rows per thread,
+  metadata names, the ``profiler.dump()``/``dumps()`` body).
+* :mod:`.flops` — MFU accounting (XLA cost-analysis FLOPs with an analytic
+  conv/matmul fallback, bounded step-time ring → steps/s + p50/p99 + MFU).
+* :mod:`.metrics` — the subsystem counter stores (checkpoint / feed / comm /
+  sanitizer), moved here from ``profiler.py``; the profiler re-exports them.
+
+``mxtpu.profiler`` remains the user-facing facade — importing this package
+directly is for framework internals and tests.
+
+Span catalog (see docs/observability.md):
+
+====================  =======================================================
+``step/compile``      trace+lower+compile of a fused step (args: signature)
+``step/execute``      one cache-hit fused-step dispatch
+``feed/transfer``     DeviceFeed producer staging one batch host→device
+``feed/stall``        consumer blocked waiting on the feed queue
+``comm/exchange``     cross-process collective (``_process_exchange``)
+``ckpt/snapshot``     device→host state capture (training thread)
+``ckpt/write``        serialize+fsync of one step (writer thread)
+``ckpt/commit``       atomic rename+COMMIT marker (writer thread)
+``feed/queue_depth``  counter: prefetch queue occupancy
+====================  =======================================================
+"""
+
+from . import export, flops, metrics, tracer
+from .tracer import counter, enabled, instant, span
+
+__all__ = ["tracer", "export", "flops", "metrics",
+           "span", "instant", "counter", "enabled"]

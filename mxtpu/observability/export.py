@@ -1,0 +1,98 @@
+"""Chrome-trace serialization of the span recorder (``profiler.dump`` body).
+
+Produces the JSON Trace Event Format that chrome://tracing and Perfetto's
+legacy importer open directly (the reference CLI surface:
+``mx.profiler.dump()`` writes ``profile.json`` next to the run). Every
+registered thread buffer becomes its own ``tid`` row under this process's
+``pid``, with ``thread_name`` metadata events so the viewer labels the rows
+("MainThread", "mxtpu-device-feed", "mxtpu-ckpt-writer") instead of showing
+bare ids.
+
+Events carry the recorder's monotonic ``perf_counter_ns``-derived
+microsecond timestamps — a single clock across threads, so producer spans
+visibly overlap the consumer's stall spans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from . import tracer
+
+__all__ = ["collect_events", "chrome_trace", "write_chrome_trace",
+           "aggregate", "REQUIRED_SPAN_KEYS"]
+
+# the schema contract tests validate exported "X" events against
+REQUIRED_SPAN_KEYS = ("name", "ph", "ts", "dur", "pid", "tid")
+
+
+def collect_events(legacy_events: Optional[List[dict]] = None) -> List[dict]:
+    """Snapshot every thread ring + the legacy Domain/Task/Counter/Marker
+    event list into one flat chrome-trace event array (metadata rows first).
+    Read-only: repeated calls over an unchanged recorder return identical
+    output (the ``dump(finished=True)`` idempotency contract builds on
+    this)."""
+    pid = os.getpid()
+    events: List[dict] = [{"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0, "args": {"name": "mxtpu"}}]
+    for tid, tname, evs, dropped in tracer.snapshot_buffers():
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": tname}})
+        if dropped:
+            events.append({"ph": "i", "name": "trace/dropped_events",
+                           "cat": "trace", "pid": pid, "tid": tid,
+                           "ts": evs[0]["ts"] if evs else 0, "s": "t",
+                           "args": {"dropped": dropped}})
+        for ev in evs:
+            e = dict(ev)
+            e["pid"] = pid
+            e["tid"] = tid
+            events.append(e)
+    for ev in legacy_events or []:
+        e = dict(ev)
+        e.setdefault("pid", pid)
+        e.setdefault("tid", 0)
+        events.append(e)
+    return events
+
+
+def chrome_trace(legacy_events: Optional[List[dict]] = None,
+                 xplane_dir: Optional[str] = None,
+                 events: Optional[List[dict]] = None) -> dict:
+    """The full dump payload. ``events`` short-circuits collection (used by
+    the profiler's frozen final snapshot)."""
+    if events is None:
+        events = collect_events(legacy_events)
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if xplane_dir:
+        # the paired XLA device trace (jax.profiler XPlane dir, open in
+        # Perfetto/TensorBoard); span names match via TraceAnnotation
+        payload["otherData"] = {"xplane_dir": xplane_dir}
+    return payload
+
+
+def write_chrome_trace(fname: str, payload: dict) -> str:
+    tmp = f"{fname}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, fname)   # readers never observe a torn dump
+    return fname
+
+
+def aggregate(events: List[dict]) -> dict:
+    """Per-name duration stats over "X" spans:
+    ``{name: [count, total_ms, min_ms, max_ms]}`` — the data behind the
+    reference's aggregate-stats table (``profiler.get_summary()``)."""
+    stats: dict = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        s = stats.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
+        dur = e.get("dur", 0.0) / 1000.0  # us -> ms
+        s[0] += 1
+        s[1] += dur
+        s[2] = min(s[2], dur)
+        s[3] = max(s[3], dur)
+    return stats

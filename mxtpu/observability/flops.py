@@ -1,0 +1,249 @@
+"""MFU accounting: per-program FLOP estimates + a bounded step-time ring.
+
+Two halves:
+
+* **FLOPs per compiled program** — :func:`estimate_step_flops` asks XLA's own
+  cost model first (``lowered.compile().cost_analysis()['flops']`` — the same
+  source ``bench.py`` has always used for honest MFU) and falls back to an
+  analytic jaxpr walk counting ``dot_general``/``conv_general_dilated`` MACs
+  (``scan`` bodies × trip count) when the AOT path is unavailable. The
+  estimate is cached per step-cache entry by the caller; it is never computed
+  on the step hot path.
+* **Step-time ring** — :func:`record_step` appends one wall-clock step sample
+  into a bounded ring (default 4096; ``MXTPU_STEP_RING``), from which
+  :func:`get_mfu_stats` derives ``steps_per_sec``, ``p50_step_ms``,
+  ``p99_step_ms``, and ``mfu`` against the detected chip's documented peak.
+  ``Module.fit`` records every batch and logs the epoch roll-up;
+  ``Speedometer`` prints the rolling p50/p99; ``bench.py`` emits the ``"mfu"``
+  JSON block from the same source of truth.
+
+Peak FLOP/s: the documented bf16 peak of the detected TPU generation
+(public spec sheets — fp32 convs execute as bf16 MXU passes, so bf16 is the
+denominator for both precisions). On CPU hosts there is no meaningful
+"documented peak"; a nominal per-core heuristic (``MXTPU_CPU_PEAK_TFLOPS``
+overridable, default 0.05 TF/core) keeps the MFU field *defined* so the bench
+regression ratchet can track it round-over-round — its absolute value on a
+host backend is a ratchet coordinate, not a hardware-utilization claim.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from collections import deque
+from typing import Optional, Tuple
+
+__all__ = ["device_peak", "estimate_step_flops", "jaxpr_flops",
+           "record_step", "set_step_flops", "get_step_flops",
+           "get_mfu_stats", "reset_steps", "step_count", "PEAK_TFLOPS"]
+
+# documented bf16 peak TFLOP/s per chip kind (public spec sheets); the
+# canonical copy — bench.py imports this table
+PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,        # v5p
+    "TPU v5p": 459.0,
+    "TPU v4": 275.0,
+    "TPU v6 lite": 918.0,   # v6e (Trillium)
+    "TPU v6e": 918.0,
+}
+
+
+def _cpu_peak_tflops() -> float:
+    try:
+        per_core = float(os.environ.get("MXTPU_CPU_PEAK_TFLOPS", "0.05"))
+    except ValueError:
+        per_core = 0.05
+    return per_core * (os.cpu_count() or 1)
+
+
+def device_peak() -> Tuple[str, Optional[float]]:
+    """``(device_kind, peak_tflops_or_None)`` for device 0. TPU kinds map
+    through :data:`PEAK_TFLOPS`; cpu gets the nominal ratchet heuristic
+    (see module docstring); anything else returns ``None`` (MFU undefined)."""
+    import jax
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_TFLOPS.get(kind)
+    if peak is None:
+        for k, v in PEAK_TFLOPS.items():
+            if k in kind:
+                peak = v
+                break
+    if peak is None and "cpu" in kind.lower():
+        peak = _cpu_peak_tflops()
+    return kind, peak
+
+
+# ---------------------------------------------------------------------------
+# FLOP estimation
+# ---------------------------------------------------------------------------
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def _dot_general_flops(eqn) -> float:
+    ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    batch = _prod(lhs[i] for i in lb)
+    k = _prod(lhs[i] for i in lc)
+    m = _prod(lhs[i] for i in range(len(lhs)) if i not in set(lb) | set(lc))
+    n = _prod(rhs[i] for i in range(len(rhs)) if i not in set(rb) | set(rc))
+    return 2.0 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval
+    dn = eqn.params["dimension_numbers"]
+    out_features = rhs.shape[dn.rhs_spec[0]]
+    # MACs per output element = kernel elements feeding it (in_ch/group ×
+    # spatial window) = rhs_elems / out_features — feature groups cancel
+    return 2.0 * _prod(out.shape) * (_prod(rhs.shape) / max(out_features, 1))
+
+
+def jaxpr_flops(jaxpr) -> float:
+    """Analytic matmul/conv FLOP count over a (Closed)Jaxpr: 2·MACs for every
+    ``dot_general`` and ``conv_general_dilated``, recursing into sub-jaxprs
+    (``pjit`` bodies, custom-derivative calls; ``scan`` bodies × trip count).
+    Elementwise/reduction work is excluded — on matmul-dominated training
+    steps it is noise, and XLA's own model is preferred when available."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0.0
+    for eqn in inner.eqns:
+        prim = eqn.primitive.name
+        if prim == "dot_general":
+            total += _dot_general_flops(eqn)
+        elif prim == "conv_general_dilated":
+            total += _conv_flops(eqn)
+        else:
+            mult = int(eqn.params.get("length", 1)) if prim == "scan" else 1
+            for v in eqn.params.values():
+                if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+                    total += mult * jaxpr_flops(v)
+    return total
+
+
+def estimate_step_flops(jitted, avals) -> Optional[float]:
+    """FLOPs of one execution of ``jitted(*avals)``.
+
+    Primary: XLA cost analysis on the AOT-lowered program (exact fusion-aware
+    accounting; pays one extra lower+compile per unique signature, which is
+    why callers cache the result per step-cache entry and compute it OFF the
+    step path). Fallback: the analytic jaxpr walk. ``MXTPU_FLOPS_MODE``
+    selects ``xla`` (default), ``analytic``, or ``off``."""
+    mode = os.environ.get("MXTPU_FLOPS_MODE", "xla").lower()
+    if mode in ("off", "0", "none"):
+        return None
+    if mode != "analytic":
+        try:
+            ca = jitted.lower(*avals).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            flops = float(dict(ca or {}).get("flops", 0.0))
+            if flops > 0:
+                return flops
+        except Exception:
+            pass  # AOT path unavailable on this backend: analytic below
+    try:
+        import jax
+        return jaxpr_flops(jax.make_jaxpr(jitted)(*avals))
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# step-time ring
+# ---------------------------------------------------------------------------
+
+_ring_lock = threading.Lock()
+
+
+def _ring_cap() -> int:
+    try:
+        return max(64, int(os.environ.get("MXTPU_STEP_RING", "4096")))
+    except ValueError:
+        return 4096
+
+
+_ring: "deque" = deque(maxlen=_ring_cap())
+_state = {"flops_per_step": None, "total_steps": 0}
+
+
+def record_step(seconds: float, flops: Optional[float] = None):
+    """One training step's wall time (and, optionally, its FLOP count — when
+    omitted the last :func:`set_step_flops` value applies at read time)."""
+    with _ring_lock:
+        _ring.append((float(seconds), flops))
+        _state["total_steps"] += 1
+
+
+def set_step_flops(flops: Optional[float]):
+    """Register the FLOPs of the CURRENT compiled step program (called by the
+    fit loop / bench once per traced signature, off the hot path)."""
+    with _ring_lock:
+        _state["flops_per_step"] = flops
+
+
+def get_step_flops() -> Optional[float]:
+    with _ring_lock:
+        return _state["flops_per_step"]
+
+
+def step_count() -> int:
+    with _ring_lock:
+        return _state["total_steps"]
+
+
+def reset_steps():
+    """Clear the ring (epoch boundaries, bench legs, tests)."""
+    with _ring_lock:
+        _ring.clear()
+        _state["total_steps"] = 0
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = (len(sorted_vals) - 1) * q
+    lo = math.floor(idx)
+    hi = math.ceil(idx)
+    if lo == hi:
+        return sorted_vals[lo]
+    frac = idx - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def get_mfu_stats(flops_per_step: Optional[float] = None) -> dict:
+    """Roll up the step-time ring: ``steps``, ``steps_per_sec``,
+    ``p50_step_ms``/``p99_step_ms``, ``flops_per_step``, and ``mfu`` against
+    the detected chip peak (None when FLOPs or peak are unknown)."""
+    with _ring_lock:
+        samples = list(_ring)
+        default_flops = _state["flops_per_step"]
+    if flops_per_step is None:
+        flops_per_step = default_flops
+    times = sorted(s for s, _ in samples)
+    n = len(times)
+    wall = sum(times)
+    out = {"steps": n,
+           "steps_per_sec": round(n / wall, 3) if wall > 0 else 0.0,
+           "p50_step_ms": round(_percentile(times, 0.50) * 1e3, 3),
+           "p99_step_ms": round(_percentile(times, 0.99) * 1e3, 3),
+           "flops_per_step": flops_per_step,
+           "mfu": None, "device_kind": None, "peak_tflops": None}
+    try:
+        kind, peak = device_peak()
+        out["device_kind"], out["peak_tflops"] = kind, peak
+    except Exception:
+        peak = None
+    if n and wall > 0 and flops_per_step and peak:
+        out["mfu"] = round((n * flops_per_step / wall) / (peak * 1e12), 6)
+    return out

@@ -1,0 +1,249 @@
+"""Subsystem counter stores (checkpoint / device-feed / comm / sanitizer).
+
+Moved here from ``mxtpu/profiler.py`` when the profiler became a facade over
+``mxtpu.observability`` — the public surface is unchanged and re-exported
+from ``mxtpu.profiler`` (``record_*`` / ``get_*_stats`` / ``reset_*``), so
+every existing call site and test keeps working.
+
+THE module stats lock: every stat dict here is bumped from more than one
+thread — the DeviceFeed producer (``device_feed.py``), the checkpoint writer
+(``checkpoint/manager.py``), and the main training thread — and
+read-modify-write pairs (total+last) tear without mutual exclusion. One lock,
+never held across a call that could re-acquire it (tpulint R004 is the static
+guard for this contract).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_stats_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint observability (mxtpu.checkpoint manager counters)
+# ---------------------------------------------------------------------------
+
+_CKPT_ZERO = {"saves": 0, "commits": 0, "restores": 0,
+              "committed_bytes": 0,
+              "blocked_step_ms_total": 0.0, "blocked_step_ms_last": 0.0,
+              "save_latency_ms_total": 0.0, "save_latency_ms_last": 0.0,
+              "write_ms_last": 0.0,
+              "shard_writes": 0, "shard_write_ms_last": 0.0}
+_ckpt = dict(_CKPT_ZERO)
+
+
+def record_checkpoint_save(blocked_ms: float):
+    """Training-thread side of an async save: how long the step was blocked
+    on the snapshot handoff (device→host DMA start + enqueue)."""
+    with _stats_lock:
+        _ckpt["saves"] += 1
+        _ckpt["blocked_step_ms_last"] = blocked_ms
+        _ckpt["blocked_step_ms_total"] += blocked_ms
+
+
+def record_checkpoint_commit(write_ms: float, latency_ms: float, nbytes: int):
+    """Writer-thread side: ``write_ms`` is the serialize+fsync+commit work,
+    ``latency_ms`` the enqueue→commit wall time (queueing included),
+    ``nbytes`` the committed payload size."""
+    with _stats_lock:
+        _ckpt["commits"] += 1
+        _ckpt["write_ms_last"] = write_ms
+        _ckpt["save_latency_ms_last"] = latency_ms
+        _ckpt["save_latency_ms_total"] += latency_ms
+        _ckpt["committed_bytes"] += int(nbytes)
+
+
+def record_checkpoint_shard_write(write_ms: float):
+    """Writer-thread side on ranks != 0: only this rank's shard write is
+    measured — commit stats (count/bytes) belong to rank 0, which owns the
+    rename and is the only rank that can see the final dir."""
+    with _stats_lock:
+        _ckpt["shard_writes"] += 1
+        _ckpt["shard_write_ms_last"] = write_ms
+
+
+def record_checkpoint_restore():
+    with _stats_lock:
+        _ckpt["restores"] += 1
+
+
+def get_checkpoint_stats() -> dict:
+    """Checkpoint counters (saves/commits/restores, committed bytes, save
+    latency, blocked-step time) — the observability contract of the async
+    checkpoint subsystem; bench.py's `checkpoint` scenario reads these."""
+    with _stats_lock:
+        return dict(_ckpt)
+
+
+def reset_checkpoint_stats():
+    with _stats_lock:
+        _ckpt.update(_CKPT_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# device-feed observability (mxtpu.device_feed input-pipeline counters)
+# ---------------------------------------------------------------------------
+
+_FEED_ZERO = {"batches_prefetched": 0, "batches_consumed": 0,
+              "transfer_count": 0, "resident_skips": 0,
+              "transfer_bytes": 0, "transfer_ms_total": 0.0,
+              "stall_ms_total": 0.0, "stall_ms_last": 0.0,
+              "queue_depth_max": 0, "feed_depth": 0}
+_feed = dict(_FEED_ZERO)
+
+
+def record_feed_transfer(nbytes: int, ms: float):
+    """Producer-thread side: one array dispatched through the host→device
+    boundary (``ms`` is the non-blocking dispatch wall time)."""
+    with _stats_lock:
+        _feed["transfer_count"] += 1
+        _feed["transfer_bytes"] += int(nbytes)
+        _feed["transfer_ms_total"] += ms
+
+
+def record_feed_resident():
+    """Producer-thread side: an array already committed with the target
+    sharding was NOT re-transferred — the double-``device_put`` guard
+    counter."""
+    with _stats_lock:
+        _feed["resident_skips"] += 1
+
+
+def record_feed_prefetch(queue_depth: int):
+    """Producer-thread side: one batch staged device-resident; samples the
+    queue-depth high-water mark."""
+    with _stats_lock:
+        _feed["batches_prefetched"] += 1
+        if queue_depth > _feed["queue_depth_max"]:
+            _feed["queue_depth_max"] = queue_depth
+
+
+def record_feed_consume(stall_ms: float):
+    """Consumer-thread side: one batch taken; ``stall_ms`` is how long the
+    step loop was blocked waiting on data (the input-stall metric)."""
+    with _stats_lock:
+        _feed["batches_consumed"] += 1
+        _feed["stall_ms_last"] = stall_ms
+        _feed["stall_ms_total"] += stall_ms
+
+
+def set_feed_depth(depth: int):
+    with _stats_lock:
+        _feed["feed_depth"] = int(depth)
+
+
+def get_feed_stats() -> dict:
+    """Input-pipeline counters (input-stall ms, transfer bytes/ms, queue-depth
+    high-water mark, batches prefetched vs consumed) — the observability
+    contract of the device-feed pipeline. ``Speedometer`` prints these;
+    ``bench.py input_pipeline`` reads them as the stall-fraction source of
+    truth. Counters are monotone until :func:`reset_feed_stats`."""
+    with _stats_lock:
+        return dict(_feed)
+
+
+def reset_feed_stats():
+    """Zero the feed counters (tests, per-epoch accounting, bench legs)."""
+    with _stats_lock:
+        _feed.update(_FEED_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# distributed-comm observability (ZeRO-1 / collectives counters)
+# ---------------------------------------------------------------------------
+
+_COMM_ZERO = {"steps": 0, "zero_steps": 0,
+              "bytes_reduced": 0, "bytes_gathered": 0, "allreduce_bytes": 0,
+              "bucket_count": 0, "shard_bytes_per_device": 0, "dp": 1,
+              "collectives": 0, "collective_ms_total": 0.0,
+              "collective_bytes": 0}
+_comm = dict(_COMM_ZERO)
+
+
+def record_comm_step(bytes_reduced: int = 0, bytes_gathered: int = 0,
+                     bucket_count: int = 0, shard_bytes: int = 0,
+                     dp: int = 1, allreduce_bytes: int = 0,
+                     zero: bool = False):
+    """One training step's gradient-exchange accounting (per-device bytes,
+    analytic from the bucket layout and dp degree — ring collectives move
+    (N-1)/N of the payload per device). The ZeRO path records reduce-scatter
+    + all-gather legs; the replicated-psum path records the full all-reduce
+    equivalent, so the two are directly comparable in ``bench.py zero_dp``."""
+    with _stats_lock:
+        _comm["steps"] += 1
+        if zero:
+            _comm["zero_steps"] += 1
+        _comm["bytes_reduced"] += int(bytes_reduced)
+        _comm["bytes_gathered"] += int(bytes_gathered)
+        _comm["allreduce_bytes"] += int(allreduce_bytes)
+        _comm["bucket_count"] = int(bucket_count)
+        _comm["shard_bytes_per_device"] = int(shard_bytes)
+        _comm["dp"] = int(dp)
+
+
+def record_collective(ms: float, nbytes: int):
+    """One host-blocking array-level collective (``parallel.collectives``
+    cross-process exchange): measured wall ms + payload bytes."""
+    with _stats_lock:
+        _comm["collectives"] += 1
+        _comm["collective_ms_total"] += ms
+        _comm["collective_bytes"] += int(nbytes)
+
+
+def get_comm_stats() -> dict:
+    """Per-step comm counters (bytes reduced/gathered, bucket count, shard
+    bytes per device, dp degree, measured collective ms) — the observability
+    contract of the ZeRO-1 gradient path. ``Speedometer`` prints the per-step
+    deltas; ``Module.fit`` logs them per epoch; ``bench.py zero_dp`` compares
+    the ZeRO legs against the replicated all-reduce accounting."""
+    with _stats_lock:
+        return dict(_comm)
+
+
+def reset_comm_stats():
+    with _stats_lock:
+        _comm.update(_COMM_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# sanitizer observability (mxtpu.analysis.sanitize counters)
+# ---------------------------------------------------------------------------
+
+_SAN_ZERO = {"transfer_guards": 0, "transfer_trips": 0,
+             "donation_poisons_armed": 0, "donation_trips": 0,
+             "retrace_escalations": 0,
+             "ownership_checks": 0, "ownership_trips": 0}
+_san = dict(_SAN_ZERO)
+
+
+def record_sanitizer(key: str, n: int = 1):
+    """One sanitizer event (``mxtpu.analysis.sanitize``): guards armed and
+    poisons planted count the coverage a sanitized run actually had; trips
+    and escalations count violations (a clean run reports zero)."""
+    with _stats_lock:
+        _san[key] += int(n)
+
+
+def get_sanitizer_stats() -> dict:
+    """Sanitizer counters (transfer-guard arms/trips, donation poisons
+    armed/tripped, retrace escalations, ownership assertions checked/
+    tripped) — the observability contract of ``MXTPU_SANITIZE``.
+    ``compile_cache_summary()`` prints them, ``Module.fit`` logs the
+    per-epoch deltas, and ``bench.py --sanitize`` emits them as the
+    ``"sanitizer"`` JSON block."""
+    with _stats_lock:
+        return dict(_san)
+
+
+def sanitizer_violations(stats: Optional[dict] = None) -> int:
+    """Total violations in a stats snapshot (0 for a clean sanitized run)."""
+    s = stats if stats is not None else get_sanitizer_stats()
+    return (s["transfer_trips"] + s["donation_trips"]
+            + s["retrace_escalations"] + s["ownership_trips"])
+
+
+def reset_sanitizer_stats():
+    with _stats_lock:
+        _san.update(_SAN_ZERO)

@@ -1,0 +1,246 @@
+"""Per-thread span recorder — the unified step timeline.
+
+The reference's profiler (``src/profiler/profiler.h:87,437``) records every
+engine op into per-device ``ProfileStat`` ring buffers and serializes them to
+chrome://tracing JSON. Here the interesting "ops" are framework-level phases —
+``step/compile``, ``step/execute``, ``feed/transfer``, ``feed/stall``,
+``comm/exchange``, ``ckpt/snapshot``/``write``/``commit`` — each recorded as a
+duration span on the thread that ran it, so one trace shows the main step
+loop, the DeviceFeed producer, and the checkpoint writer as separate timeline
+rows (pid/tid lanes in the viewer).
+
+Design (lock-free-ish): every thread owns a private bounded ring buffer,
+created on first use and registered once under the module lock. Appends touch
+only the owning thread's buffer (no lock on the hot path); readers
+(``export.py``) snapshot the registered buffers under the lock. The only
+module-level mutations are the registration list and the enable/pause flags —
+all lock-guarded (tpulint R004 contract for thread-spawning modules).
+
+Cost when off: ``span()`` is one module-global bool test returning a shared
+no-op context manager — measured in ``bench.py``'s trace block as <2% of a
+LeNet fused step. Opt in with ``MXTPU_TRACE=1`` (read at import) or
+``profiler.set_state('run')``; each span is also mirrored into
+``jax.profiler.TraceAnnotation`` so XLA device traces (Perfetto/XPlane) line
+up with the framework spans.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["span", "instant", "counter", "record_span", "enabled", "start",
+           "stop", "pause", "resume", "reset", "snapshot_buffers",
+           "buffer_capacity"]
+
+# ring capacity per thread (events); a 2-epoch traced fit generates a few
+# thousand spans, so the default keeps hours of steps without growing
+_DEFAULT_CAP = 65536
+
+_reg_lock = threading.Lock()
+_buffers: list = []          # [_ThreadBuf] — append/clear under _reg_lock only
+_tls = threading.local()
+
+_enabled = False             # flipped by start()/stop() (scalar rebind: atomic)
+_paused = False
+
+
+def buffer_capacity() -> int:
+    try:
+        return max(1024, int(os.environ.get("MXTPU_TRACE_BUFFER",
+                                            str(_DEFAULT_CAP))))
+    except ValueError:
+        return _DEFAULT_CAP
+
+
+class _ThreadBuf:
+    """One thread's bounded event ring. Only the owning thread appends;
+    readers copy via :func:`snapshot_buffers` (a list copy is atomic enough
+    under the GIL for the monotonically-appended prefix)."""
+
+    __slots__ = ("tid", "name", "events", "dropped", "cap")
+
+    def __init__(self, tid: int, name: str, cap: int):
+        self.tid = tid
+        self.name = name
+        self.cap = cap
+        self.events: list = []
+        self.dropped = 0
+
+    def append(self, ev: dict):
+        if len(self.events) >= self.cap:
+            # drop-oldest keeps the tail of a long run (the part a post-mortem
+            # dump wants); the dropped count is exported as trace metadata
+            del self.events[0]
+            self.dropped += 1
+        self.events.append(ev)
+
+
+def _buf() -> _ThreadBuf:
+    b = getattr(_tls, "buf", None)
+    if b is None:
+        t = threading.current_thread()
+        b = _ThreadBuf(t.ident or 0, t.name, buffer_capacity())
+        _tls.buf = b
+        with _reg_lock:
+            _buffers.append(b)
+    return b
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def enabled() -> bool:
+    return _enabled and not _paused
+
+
+def start():
+    """Arm span recording (``profiler.set_state('run')`` / ``MXTPU_TRACE``)."""
+    global _enabled, _paused
+    _enabled = True
+    _paused = False
+
+
+def stop():
+    global _enabled
+    _enabled = False
+
+
+def pause():
+    global _paused
+    _paused = True
+
+
+def resume():
+    global _paused
+    _paused = False
+
+
+def reset():
+    """Drop all recorded events (tests, fresh dump epochs). Live threads'
+    buffers stay registered (their thread-locals still point at them); dead
+    producers' buffers — every traced DeviceFeed generation spawns one — are
+    unregistered so back-to-back traced legs don't accumulate rows."""
+    live = {t.ident for t in threading.enumerate()}
+    with _reg_lock:
+        _buffers[:] = [b for b in _buffers if b.tid in live]
+        for b in _buffers:
+            b.events = []
+            b.dropped = 0
+
+
+def snapshot_buffers():
+    """Read-side snapshot: ``[(tid, thread_name, events_copy, dropped)]``."""
+    with _reg_lock:
+        return [(b.tid, b.name, list(b.events), b.dropped) for b in _buffers]
+
+
+# -- recording ---------------------------------------------------------------
+
+
+class _NullSpan:
+    """Shared no-op for the tracing-off fast path (one allocation, ever)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kwargs):
+        return self
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "_t0", "_ann")
+
+    def __init__(self, name: str, cat: Optional[str], args: Optional[dict]):
+        self.name = name
+        self.cat = cat or name.split("/", 1)[0]
+        self.args = dict(args) if args else None
+        self._t0 = 0
+        self._ann = None
+
+    def set(self, **kwargs):
+        """Attach args discovered mid-span (payload bytes, cache key…)."""
+        if self.args is None:
+            self.args = {}
+        self.args.update(kwargs)
+        return self
+
+    def __enter__(self):
+        try:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None  # device tracing unavailable: framework span only
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        ev = {"name": self.name, "ph": "X", "cat": self.cat,
+              "ts": self._t0 / 1e3, "dur": (t1 - self._t0) / 1e3}
+        if self.args:
+            ev["args"] = self.args
+        _buf().append(ev)
+        return False
+
+
+def span(name: str, cat: Optional[str] = None, args: Optional[dict] = None):
+    """Context manager recording one duration span on the calling thread.
+    When tracing is off this returns a shared no-op (the fast path)."""
+    if not _enabled or _paused:
+        return _NULL
+    return _Span(name, cat, args)
+
+
+def instant(name: str, cat: Optional[str] = None,
+            args: Optional[dict] = None, scope: str = "t"):
+    """One instant event (chrome-trace ``ph: 'i'``)."""
+    if not _enabled or _paused:
+        return
+    ev = {"name": name, "ph": "i", "cat": cat or name.split("/", 1)[0],
+          "ts": time.perf_counter_ns() / 1e3, "s": scope}
+    if args:
+        ev["args"] = dict(args)
+    _buf().append(ev)
+
+
+def record_span(name: str, t0_ns: int, dur_ns: int,
+                cat: Optional[str] = None, args: Optional[dict] = None):
+    """Append an already-measured span (legacy Domain/Task/Frame objects
+    measured their own window before the tracer existed; they mirror here so
+    user spans land on the same timeline rows as the framework's)."""
+    if not _enabled or _paused:
+        return
+    ev = {"name": name, "ph": "X", "cat": cat or name.split("/", 1)[0],
+          "ts": t0_ns / 1e3, "dur": dur_ns / 1e3}
+    if args:
+        ev["args"] = dict(args)
+    _buf().append(ev)
+
+
+def counter(name: str, value, cat: str = "counters"):
+    """One counter sample (chrome-trace ``ph: 'C'`` — rendered as a stacked
+    area track in the viewer). Used for queue depths and rate gauges."""
+    if not _enabled or _paused:
+        return
+    _buf().append({"name": name, "ph": "C", "cat": cat,
+                   "ts": time.perf_counter_ns() / 1e3,
+                   "args": {name.rsplit("/", 1)[-1]: value}})
+
+
+# MXTPU_TRACE=1 arms tracing for the whole process at import (the env-var
+# analogue of the reference's MXNET_PROFILER_AUTOSTART)
+if os.environ.get("MXTPU_TRACE", "").lower() in ("1", "true", "on", "run"):
+    start()

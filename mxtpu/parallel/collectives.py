@@ -182,15 +182,18 @@ def _process_exchange(x, body):
     import time
     import numpy as np
     from .. import profiler
+    from ..observability import tracer
     t0 = time.perf_counter()
-    mesh = _process_mesh()
-    sh = NamedSharding(mesh, P("proc"))
     local = np.asarray(jax.device_get(jnp.asarray(x)))[None]
-    arr = jax.make_array_from_process_local_data(sh, local)
-    fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
-    out = fn(arr)
-    jax.block_until_ready(out)
-    res = jnp.asarray(jax.device_get(out))
+    with tracer.span("comm/exchange", cat="comm",
+                     args={"bytes": int(local.nbytes)}):
+        mesh = _process_mesh()
+        sh = NamedSharding(mesh, P("proc"))
+        arr = jax.make_array_from_process_local_data(sh, local)
+        fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
+        out = fn(arr)
+        jax.block_until_ready(out)
+        res = jnp.asarray(jax.device_get(out))
     profiler.record_collective((time.perf_counter() - t0) * 1e3, local.nbytes)
     return res
 

@@ -1,34 +1,72 @@
 """Profiler — parity with ``src/profiler/`` + ``python/mxnet/profiler.py``
-(SURVEY.md §5): set_config/set_state/dump, pause/resume, Domain/Task/Frame/Event/
-Counter/Marker objects, chrome://tracing output.
+(SURVEY.md §5): set_config/set_state/dump, pause/resume, Domain/Task/Frame/
+Event/Counter/Marker objects, chrome://tracing output.
 
-Backed by ``jax.profiler``: ``dump()`` produces a TensorBoard/XPlane trace directory
-(openable in Perfetto — the modern chrome://tracing), and custom objects map onto
-``jax.profiler.TraceAnnotation``/``StepTraceAnnotation``. Per-op granularity inside a
-fused XLA program comes from XLA's own HLO-level annotations rather than engine-push
-hooks (the reference hooks Engine::Push, profiler.h:256).
+This module is the user-facing FACADE over :mod:`mxtpu.observability`:
+
+* the span recorder (``observability.tracer``) captures the unified step
+  timeline — ``step/compile``, ``step/execute``, ``feed/transfer``,
+  ``feed/stall``, ``comm/exchange``, ``ckpt/*`` — on per-thread rings, each
+  span mirrored into ``jax.profiler.TraceAnnotation`` so XLA device traces
+  (XPlane dirs from ``set_state('run')``, openable in Perfetto) line up with
+  the framework spans;
+* ``dump()``/``dumps()`` serialize it to valid chrome://tracing JSON
+  (``observability.export``), with pid/tid rows per thread (main,
+  feed-producer, ckpt-writer) — ``dump(finished=True)`` freezes the snapshot
+  so repeated dumps are idempotent rather than accumulating;
+* MFU accounting (``observability.flops``) feeds ``get_mfu_stats()`` —
+  steps/s, p50/p99 step latency, FLOPs/step, MFU vs the chip's documented
+  peak;
+* every subsystem counter surface (``record_*`` / ``get_*_stats`` /
+  ``reset_*`` for checkpoint, device-feed, comm, sanitizer) is re-exported
+  unchanged from ``observability.metrics``.
+
+Tracing is opt-in — ``MXTPU_TRACE=1`` (the ``MXNET_PROFILER_AUTOSTART``
+analogue) or ``profiler.set_state('run')`` — and the off path is a single
+bool test per instrumentation point. The legacy Domain/Task/Counter/Marker
+objects keep their original always-on local event list (``_state['events']``)
+AND emit real spans onto the unified timeline when tracing is armed.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Optional
 
 import jax
 
+from .observability import export as _export
+from .observability import flops as _flops
+from .observability import tracer as _tracer
+from .observability.metrics import (  # noqa: F401  (re-exported surface)
+    _stats_lock,
+    get_checkpoint_stats, get_comm_stats, get_feed_stats,
+    get_sanitizer_stats,
+    record_checkpoint_commit, record_checkpoint_restore,
+    record_checkpoint_save, record_checkpoint_shard_write,
+    record_collective, record_comm_step,
+    record_feed_consume, record_feed_prefetch, record_feed_resident,
+    record_feed_transfer,
+    record_sanitizer,
+    reset_checkpoint_stats, reset_comm_stats, reset_feed_stats,
+    reset_sanitizer_stats,
+    sanitizer_violations, set_feed_depth,
+)
+
+# MFU/step-latency surface (observability.flops is the store)
+get_mfu_stats = _flops.get_mfu_stats
+record_step_time = _flops.record_step
+reset_step_times = _flops.reset_steps
+
 _state = {"config": {"filename": "profile.json", "profile_all": False},
           "running": False, "dir": None, "events": [], "paused": False}
 
-# THE module stats lock. Every stat dict here (_state events, _ckpt, _feed,
-# _comm, _san) is bumped from more than one thread — the DeviceFeed producer
-# (device_feed.py), the checkpoint writer (checkpoint/manager.py), and the
-# main training thread — and read-modify-write pairs (total+last) tear
-# without mutual exclusion. One lock, never held across a call that could
-# re-acquire it (tpulint R004 is the static guard for this contract).
-_stats_lock = threading.Lock()
+# dump(finished=True) freezes its payload here so repeated finished dumps
+# rewrite the SAME file content instead of re-collecting (and duplicating)
+# whatever was recorded since — cleared by set_state('run') / reset_trace()
+_final = {"payload": None}
 
 
 def set_config(**kwargs):
@@ -39,7 +77,19 @@ def set_config(**kwargs):
 
 
 def set_state(state: str = "stop", profile_process: str = "worker"):
+    """'run' arms the unified span recorder AND an XLA device trace
+    (``jax.profiler.start_trace`` XPlane dir next to the configured
+    filename); 'stop' closes both. ``set_config(xplane=False)`` keeps the
+    framework spans without the device-trace dir (cheap mode — what
+    ``MXTPU_TRACE=1`` uses)."""
     if state == "run" and not _state["running"]:
+        _tracer.start()
+        with _stats_lock:
+            _final["payload"] = None          # a new run unfreezes the dump
+        if not _state["config"].get("xplane", True):
+            with _stats_lock:
+                _state["running"] = True
+            return
         out_dir = os.path.splitext(_state["config"].get("filename", "profile.json"))[0] \
             + "_trace"
         with _stats_lock:
@@ -49,23 +99,28 @@ def set_state(state: str = "stop", profile_process: str = "worker"):
             _state["running"] = True
     elif state == "stop":
         if _state["running"]:
-            jax.profiler.stop_trace()
+            if _state["config"].get("xplane", True):
+                jax.profiler.stop_trace()
             with _stats_lock:
                 _state["running"] = False
+        _tracer.stop()
         with _stats_lock:
             # explicit stop cancels pause-resume
             _state.pop("resume_running", None)
 
 
 def pause(profile_process: str = "worker"):
-    """Suspend collection (c_api MXProfilePause parity): custom events stop
-    recording and the device trace is closed until resume()."""
+    """Suspend collection (c_api MXProfilePause parity): custom events and
+    framework spans stop recording and the device trace is closed until
+    resume()."""
     if _state["paused"]:
         return
     with _stats_lock:
         _state["paused"] = True
+    _tracer.pause()
     if _state["running"]:
-        jax.profiler.stop_trace()
+        if _state["config"].get("xplane", True):
+            jax.profiler.stop_trace()
         with _stats_lock:
             _state["running"] = False
             _state["resume_running"] = True
@@ -81,41 +136,55 @@ def resume(profile_process: str = "worker"):
             _state["segment"] = _state.get("segment", 0) + 1
             out_dir = f"{_state['dir']}_resume{_state['segment']}"
             _state["dir"] = out_dir  # dump() must point at the live trace dir
+    _tracer.resume()
     if restart:
-        jax.profiler.start_trace(out_dir)
+        if _state["config"].get("xplane", True):
+            jax.profiler.start_trace(out_dir)
         with _stats_lock:
             _state["running"] = True
 
 
+def reset_trace():
+    """Drop every recorded span/event and unfreeze a finished dump (tests,
+    back-to-back bench legs)."""
+    _tracer.reset()
+    with _stats_lock:
+        _state["events"] = []
+        _final["payload"] = None
+
+
 def dump(finished: bool = True, profile_process: str = "worker"):
-    """Stop tracing and write the chrome-tracing-compatible summary json."""
+    """Stop tracing and write the chrome://tracing JSON (one ``pid`` with a
+    named ``tid`` row per instrumented thread). ``finished=True`` (the
+    reference default) freezes the payload: calling ``dump(finished=True)``
+    again rewrites the identical file instead of duplicating events recorded
+    since; ``finished=False`` writes a live snapshot without freezing."""
     if _state["running"]:
         set_state("stop")
     with _stats_lock:
         fname = _state["config"].get("filename", "profile.json")
-        payload = {"traceEvents": list(_state["events"]),
-                   "xplane_dir": _state["dir"],
-                   "displayTimeUnit": "ms"}
-    with open(fname, "w") as f:
-        json.dump(payload, f)
+        legacy = list(_state["events"])
+        xdir = _state["dir"]
+        payload = _final["payload"] if finished else None
+    if payload is None:
+        payload = _export.chrome_trace(legacy_events=legacy, xplane_dir=xdir)
+        if finished:
+            with _stats_lock:
+                if _final["payload"] is None:
+                    _final["payload"] = payload
+                else:
+                    payload = _final["payload"]   # lost the freeze race
+    _export.write_chrome_trace(fname, payload)
     return fname
 
 
 def get_summary(sort_by: str = "total") -> str:
     """Aggregate-stats table (MXAggregateProfileStatsPrint / aggregate_stats.cc
-    parity): per-name count, total/avg/min/max duration over recorded events."""
+    parity): per-name count, total/avg/min/max duration over every recorded
+    span — the unified tracer's rings AND the legacy custom-object events."""
     with _stats_lock:
-        events = list(_state["events"])
-    stats = {}
-    for e in events:
-        if e.get("ph") != "X":
-            continue
-        s = stats.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
-        dur = e.get("dur", 0.0) / 1000.0  # ms
-        s[0] += 1
-        s[1] += dur
-        s[2] = min(s[2], dur)
-        s[3] = max(s[3], dur)
+        legacy = list(_state["events"])
+    stats = _export.aggregate(_export.collect_events(legacy))
     key = {"total": lambda kv: -kv[1][1], "count": lambda kv: -kv[1][0],
            "avg": lambda kv: -(kv[1][1] / max(kv[1][0], 1)),
            "name": lambda kv: kv[0]}[sort_by]
@@ -130,250 +199,23 @@ def get_summary(sort_by: str = "total") -> str:
 
 def dumps(reset: bool = False) -> str:
     """Aggregate table when set_config(aggregate_stats=True) (reference
-    profiler.dumps), raw chrome-trace JSON otherwise."""
+    profiler.dumps), raw chrome-trace JSON otherwise — traceEvents now
+    includes the unified span store alongside every subsystem stats block."""
     if _state["config"].get("aggregate_stats"):
         out = get_summary()
     else:
         with _stats_lock:
-            events = list(_state["events"])
-        out = json.dumps({"traceEvents": events,
+            legacy = list(_state["events"])
+        out = json.dumps({"traceEvents": _export.collect_events(legacy),
                           "compileCaches": get_compile_stats(),
                           "checkpoint": get_checkpoint_stats(),
                           "deviceFeed": get_feed_stats(),
                           "comm": get_comm_stats(),
-                          "sanitizer": get_sanitizer_stats()})
+                          "sanitizer": get_sanitizer_stats(),
+                          "mfu": get_mfu_stats()})
     if reset:
-        with _stats_lock:
-            _state["events"] = []
+        reset_trace()
     return out
-
-
-# ---------------------------------------------------------------------------
-# checkpoint observability (mxtpu.checkpoint manager counters)
-# ---------------------------------------------------------------------------
-
-_CKPT_ZERO = {"saves": 0, "commits": 0, "restores": 0,
-              "committed_bytes": 0,
-              "blocked_step_ms_total": 0.0, "blocked_step_ms_last": 0.0,
-              "save_latency_ms_total": 0.0, "save_latency_ms_last": 0.0,
-              "write_ms_last": 0.0,
-              "shard_writes": 0, "shard_write_ms_last": 0.0}
-_ckpt = dict(_CKPT_ZERO)
-
-
-def record_checkpoint_save(blocked_ms: float):
-    """Training-thread side of an async save: how long the step was blocked
-    on the snapshot handoff (device→host DMA start + enqueue)."""
-    with _stats_lock:
-        _ckpt["saves"] += 1
-        _ckpt["blocked_step_ms_last"] = blocked_ms
-        _ckpt["blocked_step_ms_total"] += blocked_ms
-
-
-def record_checkpoint_commit(write_ms: float, latency_ms: float, nbytes: int):
-    """Writer-thread side: ``write_ms`` is the serialize+fsync+commit work,
-    ``latency_ms`` the enqueue→commit wall time (queueing included),
-    ``nbytes`` the committed payload size."""
-    with _stats_lock:
-        _ckpt["commits"] += 1
-        _ckpt["write_ms_last"] = write_ms
-        _ckpt["save_latency_ms_last"] = latency_ms
-        _ckpt["save_latency_ms_total"] += latency_ms
-        _ckpt["committed_bytes"] += int(nbytes)
-
-
-def record_checkpoint_shard_write(write_ms: float):
-    """Writer-thread side on ranks != 0: only this rank's shard write is
-    measured — commit stats (count/bytes) belong to rank 0, which owns the
-    rename and is the only rank that can see the final dir."""
-    with _stats_lock:
-        _ckpt["shard_writes"] += 1
-        _ckpt["shard_write_ms_last"] = write_ms
-
-
-def record_checkpoint_restore():
-    with _stats_lock:
-        _ckpt["restores"] += 1
-
-
-def get_checkpoint_stats() -> dict:
-    """Checkpoint counters (saves/commits/restores, committed bytes, save
-    latency, blocked-step time) — the observability contract of the async
-    checkpoint subsystem; bench.py's `checkpoint` scenario reads these."""
-    with _stats_lock:
-        return dict(_ckpt)
-
-
-def reset_checkpoint_stats():
-    with _stats_lock:
-        _ckpt.update(_CKPT_ZERO)
-
-
-# ---------------------------------------------------------------------------
-# device-feed observability (mxtpu.device_feed input-pipeline counters)
-# ---------------------------------------------------------------------------
-
-_FEED_ZERO = {"batches_prefetched": 0, "batches_consumed": 0,
-              "transfer_count": 0, "resident_skips": 0,
-              "transfer_bytes": 0, "transfer_ms_total": 0.0,
-              "stall_ms_total": 0.0, "stall_ms_last": 0.0,
-              "queue_depth_max": 0, "feed_depth": 0}
-_feed = dict(_FEED_ZERO)
-
-
-def record_feed_transfer(nbytes: int, ms: float):
-    """Producer-thread side: one array dispatched through the host→device
-    boundary (``ms`` is the non-blocking dispatch wall time)."""
-    with _stats_lock:
-        _feed["transfer_count"] += 1
-        _feed["transfer_bytes"] += int(nbytes)
-        _feed["transfer_ms_total"] += ms
-
-
-def record_feed_resident():
-    """Producer-thread side: an array already committed with the target
-    sharding was NOT re-transferred — the double-``device_put`` guard
-    counter."""
-    with _stats_lock:
-        _feed["resident_skips"] += 1
-
-
-def record_feed_prefetch(queue_depth: int):
-    """Producer-thread side: one batch staged device-resident; samples the
-    queue-depth high-water mark."""
-    with _stats_lock:
-        _feed["batches_prefetched"] += 1
-        if queue_depth > _feed["queue_depth_max"]:
-            _feed["queue_depth_max"] = queue_depth
-
-
-def record_feed_consume(stall_ms: float):
-    """Consumer-thread side: one batch taken; ``stall_ms`` is how long the
-    step loop was blocked waiting on data (the input-stall metric)."""
-    with _stats_lock:
-        _feed["batches_consumed"] += 1
-        _feed["stall_ms_last"] = stall_ms
-        _feed["stall_ms_total"] += stall_ms
-
-
-def set_feed_depth(depth: int):
-    with _stats_lock:
-        _feed["feed_depth"] = int(depth)
-
-
-def get_feed_stats() -> dict:
-    """Input-pipeline counters (input-stall ms, transfer bytes/ms, queue-depth
-    high-water mark, batches prefetched vs consumed) — the observability
-    contract of the device-feed pipeline. ``Speedometer`` prints these;
-    ``bench.py input_pipeline`` reads them as the stall-fraction source of
-    truth. Counters are monotone until :func:`reset_feed_stats`."""
-    with _stats_lock:
-        return dict(_feed)
-
-
-def reset_feed_stats():
-    """Zero the feed counters (tests, per-epoch accounting, bench legs)."""
-    with _stats_lock:
-        _feed.update(_FEED_ZERO)
-
-
-# ---------------------------------------------------------------------------
-# distributed-comm observability (ZeRO-1 / collectives counters)
-# ---------------------------------------------------------------------------
-
-_COMM_ZERO = {"steps": 0, "zero_steps": 0,
-              "bytes_reduced": 0, "bytes_gathered": 0, "allreduce_bytes": 0,
-              "bucket_count": 0, "shard_bytes_per_device": 0, "dp": 1,
-              "collectives": 0, "collective_ms_total": 0.0,
-              "collective_bytes": 0}
-_comm = dict(_COMM_ZERO)
-
-
-def record_comm_step(bytes_reduced: int = 0, bytes_gathered: int = 0,
-                     bucket_count: int = 0, shard_bytes: int = 0,
-                     dp: int = 1, allreduce_bytes: int = 0,
-                     zero: bool = False):
-    """One training step's gradient-exchange accounting (per-device bytes,
-    analytic from the bucket layout and dp degree — ring collectives move
-    (N-1)/N of the payload per device). The ZeRO path records reduce-scatter
-    + all-gather legs; the replicated-psum path records the full all-reduce
-    equivalent, so the two are directly comparable in ``bench.py zero_dp``."""
-    with _stats_lock:
-        _comm["steps"] += 1
-        if zero:
-            _comm["zero_steps"] += 1
-        _comm["bytes_reduced"] += int(bytes_reduced)
-        _comm["bytes_gathered"] += int(bytes_gathered)
-        _comm["allreduce_bytes"] += int(allreduce_bytes)
-        _comm["bucket_count"] = int(bucket_count)
-        _comm["shard_bytes_per_device"] = int(shard_bytes)
-        _comm["dp"] = int(dp)
-
-
-def record_collective(ms: float, nbytes: int):
-    """One host-blocking array-level collective (``parallel.collectives``
-    cross-process exchange): measured wall ms + payload bytes."""
-    with _stats_lock:
-        _comm["collectives"] += 1
-        _comm["collective_ms_total"] += ms
-        _comm["collective_bytes"] += int(nbytes)
-
-
-def get_comm_stats() -> dict:
-    """Per-step comm counters (bytes reduced/gathered, bucket count, shard
-    bytes per device, dp degree, measured collective ms) — the observability
-    contract of the ZeRO-1 gradient path. ``Speedometer`` prints the per-step
-    deltas; ``Module.fit`` logs them per epoch; ``bench.py zero_dp`` compares
-    the ZeRO legs against the replicated all-reduce accounting."""
-    with _stats_lock:
-        return dict(_comm)
-
-
-def reset_comm_stats():
-    with _stats_lock:
-        _comm.update(_COMM_ZERO)
-
-
-# ---------------------------------------------------------------------------
-# sanitizer observability (mxtpu.analysis.sanitize counters)
-# ---------------------------------------------------------------------------
-
-_SAN_ZERO = {"transfer_guards": 0, "transfer_trips": 0,
-             "donation_poisons_armed": 0, "donation_trips": 0,
-             "retrace_escalations": 0,
-             "ownership_checks": 0, "ownership_trips": 0}
-_san = dict(_SAN_ZERO)
-
-
-def record_sanitizer(key: str, n: int = 1):
-    """One sanitizer event (``mxtpu.analysis.sanitize``): guards armed and
-    poisons planted count the coverage a sanitized run actually had; trips
-    and escalations count violations (a clean run reports zero)."""
-    with _stats_lock:
-        _san[key] += int(n)
-
-
-def get_sanitizer_stats() -> dict:
-    """Sanitizer counters (transfer-guard arms/trips, donation poisons
-    armed/tripped, retrace escalations, ownership assertions checked/
-    tripped) — the observability contract of ``MXTPU_SANITIZE``.
-    ``compile_cache_summary()`` prints them, ``Module.fit`` logs the
-    per-epoch deltas, and ``bench.py --sanitize`` emits them as the
-    ``"sanitizer"`` JSON block."""
-    with _stats_lock:
-        return dict(_san)
-
-
-def sanitizer_violations(stats: Optional[dict] = None) -> int:
-    """Total violations in a stats snapshot (0 for a clean sanitized run)."""
-    s = stats if stats is not None else get_sanitizer_stats()
-    return (s["transfer_trips"] + s["donation_trips"]
-            + s["retrace_escalations"] + s["ownership_trips"])
-
-
-def reset_sanitizer_stats():
-    with _stats_lock:
-        _san.update(_SAN_ZERO)
 
 
 # ---------------------------------------------------------------------------
@@ -421,6 +263,11 @@ def compile_cache_summary() -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# custom profiling objects (Domain/Task/Frame/Event/Counter/Marker)
+# ---------------------------------------------------------------------------
+
+
 class Domain:
     def __init__(self, name: str):
         self.name = name
@@ -449,14 +296,19 @@ class _Scoped:
 
     def stop(self):
         if self._ann is not None:
+            t1 = time.perf_counter_ns()
             self._ann.__exit__(None, None, None)
+            cat = self.domain.name if self.domain else "default"
             if not _state["paused"]:
                 with _stats_lock:
                     _state["events"].append({
                         "name": self.name, "ph": "X", "ts": self._t0 / 1000,
-                        "dur": (time.perf_counter_ns() - self._t0) / 1000,
-                        "pid": 0, "tid": 0,
-                        "cat": self.domain.name if self.domain else "default"})
+                        "dur": (t1 - self._t0) / 1000,
+                        "pid": 0, "tid": 0, "cat": cat})
+                # mirror onto the unified timeline (real pid/tid row) when
+                # the span recorder is armed
+                _tracer.record_span(self.name, self._t0, t1 - self._t0,
+                                    cat=cat)
             self._ann = None
 
     def __enter__(self):
@@ -492,6 +344,9 @@ class Counter:
                                          "ts": time.perf_counter_ns() / 1000,
                                          "pid": 0,
                                          "args": {self.name: value}})
+            _tracer.counter(self.name, value,
+                            cat=self.domain.name if self.domain
+                            else "counters")
 
     def increment(self, delta=1):
         self.set_value(self.value + delta)
@@ -510,3 +365,6 @@ class Marker:
                 _state["events"].append({"name": self.name, "ph": "i",
                                          "ts": time.perf_counter_ns() / 1000,
                                          "pid": 0, "s": scope[0]})
+            _tracer.instant(self.name,
+                            cat=self.domain.name if self.domain else "marker",
+                            scope=scope[0])

@@ -424,6 +424,24 @@ class StepExecutor:
         jitted = jax.jit(pure, donate_argnums=donate)
         return {"jitted": jitted, "struct": struct}
 
+    # -- FLOP accounting ---------------------------------------------------
+    def program_flops(self) -> Optional[float]:
+        """FLOPs of ONE execution of the current compiled step program (XLA
+        cost analysis; analytic conv/matmul jaxpr count as fallback —
+        ``observability.flops.estimate_step_flops``). Lazy and cached per
+        cache entry: the first call after a trace pays one AOT lower+compile,
+        subsequent calls are a dict read — callers (fit epoch logs, bench)
+        keep this OFF the step hot path."""
+        entry = self._cache.get(self._last_sig)
+        if entry is None or "avals" not in entry:
+            return None
+        if "flops" not in entry:
+            from .observability import flops as flops_mod
+            entry["flops"] = flops_mod.estimate_step_flops(entry["jitted"],
+                                                           entry["avals"])
+            flops_mod.set_step_flops(entry["flops"])
+        return entry["flops"]
+
     # -- the step ----------------------------------------------------------
     def step(self, data: Sequence, label, batch_size: Optional[int] = None):
         """Run one fused train step. Returns a dict with detached
@@ -432,6 +450,7 @@ class StepExecutor:
         from . import rng
         from .analysis import sanitize
         from .ndarray.ndarray import NDArray
+        from .observability import tracer
 
         san = sanitize.active()
         tr = self.trainer
@@ -491,10 +510,25 @@ class StepExecutor:
         data_raws = [d.data for d in data]
         label_raw = label.data if label is not None else None
         t_arr = jnp.int32(t)
-        with sanitize.step_guard(san, traced_now, where=self._cache_name):
-            out = entry["jitted"](
-                param_raws, aux_raws, state_raws, zstate_raws, zres_raws,
-                data_raws, label_raw, lr, wd, rescale, clip, t_arr, key)
+        step_args = (param_raws, aux_raws, state_raws, zstate_raws, zres_raws,
+                     data_raws, label_raw, lr, wd, rescale, clip, t_arr, key)
+        if traced_now:
+            # shape/dtype skeleton for the lazy FLOP estimate (program_flops)
+            # — holding real arrays would pin donated buffers
+            entry["avals"] = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                if hasattr(a, "shape") else a, step_args)
+        # one span per dispatch on the unified step timeline: the first call
+        # of a signature IS the trace+lower+compile (step/compile, tagged
+        # with the signature fingerprint), cache hits are step/execute
+        sp = tracer.span("step/compile" if traced_now else "step/execute",
+                         cat="step",
+                         args={"cache": self._cache_name,
+                               "signature":
+                               f"{hash(sig) & 0xffffffffffffffff:016x}"}
+                         if traced_now else {"cache": self._cache_name})
+        with sp, sanitize.step_guard(san, traced_now, where=self._cache_name):
+            out = entry["jitted"](*step_args)
         (new_params, new_aux, new_states, new_zstates, new_zres, grads,
          loss_arr, raw_outs, exposed0) = out
 

@@ -19,49 +19,92 @@ import conftest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_cpu_fallback_exits_zero_and_emits_json():
+def _run_fallback_bench(tmp_path, extra_env=None, args=()):
     env = conftest.subprocess_env()
     # the exact env main()'s re-exec builds for the fallback child
     env["MXTPU_BENCH_FALLBACK"] = "1"
     env["MXTPU_BENCH_SMOKE"] = "1"
+    # ratchet candidates land in the test's tmp dir, never the repo file
+    env["MXTPU_BENCH_BASELINE_PATH"] = str(tmp_path / "BENCH_BASELINE.json")
+    env.update(extra_env or {})
     p = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "bench.py")],
+        [sys.executable, os.path.join(_REPO, "bench.py"), *args],
         env=env, capture_output=True, text=True, timeout=480)
     assert p.returncode == 0, (
         f"bench.py cpu-fallback child exited rc={p.returncode}\n"
         f"stderr tail:\n{p.stderr[-2000:]}")
     lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
     assert lines, f"no stdout from bench.py; stderr:\n{p.stderr[-2000:]}"
-    doc = json.loads(lines[-1])        # the single JSON line contract
+    return json.loads(lines[-1]), p    # the single JSON line contract
+
+
+def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
+    doc, _ = _run_fallback_bench(tmp_path)
     assert doc["fallback"] == "cpu"
     assert doc["metric"] == "lenet_train_imgs_per_sec"
     assert doc["value"] > 0
     assert doc["loss_end"] < doc["loss_start"]       # it actually trained
     # every fallback scenario must keep emitting its keys
     assert {"checkpoint", "input_pipeline", "zero_dp",
-            "compile_caches"} <= set(doc)
+            "compile_caches", "mfu", "trace", "ratchet"} <= set(doc)
     zdp = doc["zero_dp"]
     assert zdp["dp"] >= 1
     assert zdp["zero1"]["opt_state_bytes_per_device"] > 0
     assert zdp["replicated"]["step_ms"] > 0 and zdp["zero1"]["step_ms"] > 0
+    # MFU block (ISSUE 6 ratchet inputs): nonzero mfu, steps/s, tail latency
+    mfu = doc["mfu"]
+    assert mfu["mfu"] is not None and mfu["mfu"] > 0
+    assert mfu["steps_per_sec"] > 0
+    assert mfu["p99_step_ms"] > 0 and mfu["p50_step_ms"] > 0
+    assert mfu["p99_step_ms"] >= mfu["p50_step_ms"]
+    assert mfu["flops_per_step"] > 0
+    # trace block: the traced leg dumped real spans across named threads
+    tr = doc["trace"]
+    assert tr["spans"] > 0 and tr["events"] >= tr["spans"]
+    assert "step" in tr["span_categories"]
+    assert "feed" in tr["span_categories"]
+    assert "ckpt" in tr["span_categories"]
+    assert len(tr["threads"]) >= 2
+    assert "step/compile" in tr["span_names"] or \
+        "step/execute" in tr["span_names"]
+    # the ratchet wrote a baseline CANDIDATE under the smoke-suffixed key
+    base = json.load(open(tmp_path / "BENCH_BASELINE.json"))
+    assert base["cpu-fallback-smoke"]["steps_per_sec"] > 0
+    assert doc["ratchet"]["harness"] == "cpu-fallback-smoke"
+    assert doc["ratchet"]["regressions"] == {}
 
 
-def test_bench_sanitized_leg_exits_zero_with_no_violations():
+def test_bench_leg_failure_yields_partial_json(tmp_path):
+    """A scenario raising a (simulated) transient backend error — the
+    BENCH_r05 crash shape — must NOT erase the scoreboard: the failing leg
+    emits ``{"error": ...}``, a leg failing ONCE is retried and succeeds,
+    and every other leg ships in an exit-0 JSON line."""
+    doc, p = _run_fallback_bench(tmp_path, extra_env={
+        # input_pipeline: fails every attempt → error leg
+        # zero_dp: fails once → the single transient retry must recover it
+        "MXTPU_BENCH_FAIL_LEG": "input_pipeline,zero_dp:1",
+        "MXTPU_BENCH_RETRY_BACKOFF_S": "0.01",
+    })
+    assert "error" in doc["input_pipeline"]
+    assert "UNAVAILABLE" in doc["input_pipeline"]["error"]
+    # the retried leg recovered — full payload, no error key
+    assert "error" not in doc["zero_dp"]
+    assert doc["zero_dp"]["zero1"]["step_ms"] > 0
+    assert "retrying once" in p.stderr
+    # the remaining legs are populated and the headline survived
+    assert doc["value"] > 0
+    assert "error" not in doc["checkpoint"]
+    assert doc["mfu"]["steps_per_sec"] > 0
+
+
+def test_bench_sanitized_leg_exits_zero_with_no_violations(tmp_path):
     """``bench.py --sanitize`` (ISSUE 5 satellite): the cpu-fallback child
     must still exit 0 with the sanitizers armed, emit the ``"sanitizer"``
     JSON block, and report ZERO violations — the committed training/
-    checkpoint/input-pipeline paths are sanitizer-clean by contract."""
-    env = conftest.subprocess_env()
-    env["MXTPU_BENCH_FALLBACK"] = "1"
-    env["MXTPU_BENCH_SMOKE"] = "1"
-    p = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "bench.py"), "--sanitize"],
-        env=env, capture_output=True, text=True, timeout=480)
-    assert p.returncode == 0, (
-        f"bench.py --sanitize child exited rc={p.returncode}\n"
-        f"stderr tail:\n{p.stderr[-2000:]}")
-    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
-    doc = json.loads(lines[-1])
+    checkpoint/input-pipeline paths are sanitizer-clean by contract. The
+    scope now also runs one TRACED leg (ISSUE 6 satellite): sanitizers +
+    tracing compose, still with zero violations."""
+    doc, _ = _run_fallback_bench(tmp_path, args=("--sanitize",))
     san = doc["sanitizer"]
     assert san["violations"] == 0, san
     assert set(san["modes"]) == {"transfers", "donation", "retrace",
@@ -71,3 +114,6 @@ def test_bench_sanitized_leg_exits_zero_with_no_violations():
     assert san["stats"]["donation_poisons_armed"] > 0
     assert san["stats"]["ownership_checks"] > 0
     assert san["step_ms_sanitized"] > 0
+    # tracing composed with the sanitizers: real spans, zero violations
+    assert san["traced_leg"]["events"] > 0
+    assert "step" in san["traced_leg"]["span_categories"]
