@@ -101,16 +101,6 @@ def _device_peak():
 # never erase the whole round again — BENCH_r05 rc=1 lost every leg)
 # ---------------------------------------------------------------------------
 
-_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",
-                      "ABORTED", "Unable to initialize", "failed to initialize",
-                      "Socket closed", "Connection reset", "handshake")
-
-
-def _is_transient(exc: BaseException) -> bool:
-    msg = f"{type(exc).__name__}: {exc}"
-    return any(m.lower() in msg.lower() for m in _TRANSIENT_MARKERS)
-
-
 def _retry_backoff_s() -> float:
     try:
         return float(os.environ.get("MXTPU_BENCH_RETRY_BACKOFF_S", "2.0"))
@@ -156,28 +146,40 @@ def _maybe_inject_failure(name: str):
 
 def run_leg(name: str, fn, *args, **kwargs):
     """Run one scoreboard scenario under the crash containment contract:
-    transient backend errors get ONE retry with backoff; any failure becomes
-    a ``{"error": ...}`` leg result instead of killing the process, so the
+    transient backend errors are retried by THE shared policy
+    (``mxtpu.resilience.retry_transient`` — bounded exponential backoff,
+    ``MXTPU_RETRY_MAX`` retries, base ``MXTPU_BENCH_RETRY_BACKOFF_S``;
+    replaces this harness's old ad-hoc one-retry); any failure becomes a
+    ``{"error": ...}`` leg result instead of killing the process, so the
     JSON line always ships with every other leg populated (rc stays 0)."""
-    for attempt in (0, 1):
-        try:
-            _maybe_inject_failure(name)
-            return fn(*args, **kwargs)
-        except (KeyboardInterrupt, SystemExit):
-            raise
-        except BaseException as e:
-            err = f"{type(e).__name__}: {e}"
-            if attempt == 0 and _is_transient(e):
-                backoff = _retry_backoff_s()
-                log(f"[bench] leg {name!r} hit a transient backend error "
-                    f"({err}); retrying once in {backoff:.1f}s")
-                time.sleep(backoff)
-                continue
-            import traceback
-            log(f"[bench] leg {name!r} FAILED ({'after retry' if attempt else 'non-transient'}):\n"
-                + traceback.format_exc())
-            return {"error": err, "leg": name, "retried": attempt == 1}
-    return {"error": "unreachable", "leg": name}
+    from mxtpu.resilience import RetryError, retry_transient
+    attempts = {"n": 0}
+
+    def _attempt():
+        attempts["n"] += 1
+        _maybe_inject_failure(name)
+        return fn(*args, **kwargs)
+
+    def _note(exc, attempt):
+        log(f"[bench] leg {name!r} hit a transient backend error "
+            f"({type(exc).__name__}: {exc}); retrying (attempt "
+            f"{attempt + 2})")
+
+    try:
+        return retry_transient(_attempt, label=f"bench.{name}",
+                               base_backoff_s=_retry_backoff_s(),
+                               on_retry=_note)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as e:
+        src = e.__cause__ if isinstance(e, RetryError) \
+            and e.__cause__ is not None else e
+        err = f"{type(src).__name__}: {src}"
+        import traceback
+        log(f"[bench] leg {name!r} FAILED "
+            f"({'after retries' if attempts['n'] > 1 else 'non-transient'}):\n"
+            + traceback.format_exc())
+        return {"error": err, "leg": name, "retried": attempts["n"] > 1}
 
 
 def _leg_ok(res) -> bool:
@@ -963,9 +965,11 @@ def bench_comm():
     return out
 
 
-def _lenet_module(batch: int):
+def _lenet_module(batch: int, setup: bool = True):
     """LeNet-scale Module on the fused StepExecutor path — shared by the
-    cpu-fallback harness and the input_pipeline scenario."""
+    cpu-fallback harness and the input_pipeline/resilience scenarios.
+    ``setup=False`` returns the module unbound so ``fit`` owns bind/init
+    (what the supervised-restart leg needs for a fresh per-attempt build)."""
     import mxtpu as mx
     from mxtpu.gluon import nn
     from mxtpu.gluon.block import HybridBlock
@@ -989,12 +993,13 @@ def _lenet_module(batch: int):
 
     mod = mx.Module(LeNet(), data_names=("data",),
                     label_names=("softmax_label",))
-    mod.bind(data_shapes=[DataDesc("data", (batch, 1, 28, 28))],
-             label_shapes=[DataDesc("softmax_label", (batch,))])
-    mod.init_params()
-    mod.init_optimizer(optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.05,
-                                         "momentum": 0.9})
+    if setup:
+        mod.bind(data_shapes=[DataDesc("data", (batch, 1, 28, 28))],
+                 label_shapes=[DataDesc("softmax_label", (batch,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
     return mod
 
 
@@ -1361,6 +1366,25 @@ def _sanitize_requested() -> bool:
     return "--sanitize" in sys.argv
 
 
+def _resilience_only() -> bool:
+    """``bench.py resilience`` — run just the fault-injection/supervised-
+    resume scenario and emit a resilience-only JSON line (rides the same
+    cpu-fallback re-exec as every other flag)."""
+    return "resilience" in sys.argv[1:]
+
+
+def _emit_resilience_only(smoke: bool) -> None:
+    import jax
+    resil = run_leg("resilience", bench_resilience, smoke=smoke)
+    doc = {"metric": "resilience_supervised_resume",
+           "value": (1.0 if isinstance(resil, dict)
+                     and resil.get("params_match") else 0.0),
+           "unit": "params_match",
+           "platform": jax.default_backend(),
+           "resilience": resil}
+    print(json.dumps(doc))
+
+
 def bench_sanitizer(smoke: bool = False):
     """One sanitized leg per scenario (``--sanitize``): the LeNet fused-step
     train loop, the checkpoint manager, and the device-feed input pipeline
@@ -1504,14 +1528,129 @@ def _fallback_train_leg(smoke: bool) -> dict:
     }
 
 
+def bench_resilience(smoke: bool = False):
+    """Resilience scenario (ISSUE 8): the same LeNet fit run twice — once
+    fault-free, once under ``MXTPU_FAULT_PLAN`` with an injected checkpoint
+    writer ``io_error`` (absorbed by the shared ``retry_transient`` policy)
+    plus a mid-epoch ``crash`` on the first attempt (survived by
+    ``resilience.supervise`` restarting from the last committed step).
+    Reports the restart/retry/steps-lost accounting from
+    ``profiler.get_resilience_stats()`` and whether the supervised run's
+    final params match the fault-free baseline — the end-to-end proof that
+    fault → retry → restart → resume loses no training state."""
+    import shutil
+    import tempfile
+
+    from mxtpu import callback, profiler
+    from mxtpu.checkpoint import CheckpointManager
+    from mxtpu.io import NDArrayIter
+    from mxtpu.resilience import faults, supervise
+
+    batch = 32
+    nbatch = 4 if smoke else 8
+    epochs = 2 if smoke else 3
+    rs = np.random.RandomState(11)
+    X = rs.rand(nbatch * batch, 1, 28, 28).astype(np.float32)
+    y = rs.randint(0, 10, nbatch * batch).astype(np.float32)
+
+    def _params_np(mod):
+        # positional (construction-order) list, not name-keyed: gluon name
+        # counters are process-global, so a re-instantiated LeNet gets fresh
+        # conv2dN_* names — restore matches positionally and so must we
+        arg, aux = mod.get_params()
+        return [np.asarray(v.data)
+                for v in list(arg.values()) + list(aux.values())]
+
+    def _fit(save_dir):
+        # One manager drives BOTH the epoch-end saves and the resume —
+        # resume_from on a fresh directory is a no-op, so baseline and
+        # every supervised attempt share this exact code path. Seeding makes
+        # every attempt's fresh init identical; a restore overrides both the
+        # params and the RNG stream from the committed snapshot.
+        import mxtpu as mx
+        mx.rng.seed(20260804)
+        it = NDArrayIter(X, y, batch_size=batch, shuffle=False)
+        mod = _lenet_module(batch, setup=False)
+        mgr = CheckpointManager(save_dir)
+        try:
+            mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.05,
+                                      "momentum": 0.9},
+                    epoch_end_callback=callback.do_checkpoint(
+                        mgr, module=mod),
+                    resume_from=mgr)
+            mgr.wait_until_finished()
+        finally:
+            mgr.close()
+        return _params_np(mod)
+
+    root = tempfile.mkdtemp(prefix="mxtpu-bench-resil-")
+    saved = {k: os.environ.get(k)
+             for k in (faults.ENV_PLAN, faults.ENV_ATTEMPT)}
+    crash_at = nbatch + 2          # two steps into the second epoch
+    plan = (f"site=ckpt.write:at=1:kind=io_error,"
+            f"site=step:at={crash_at}:kind=crash:attempt=1")
+    t0 = time.perf_counter()
+    try:
+        base = _fit(os.path.join(root, "baseline"))
+        profiler.reset_resilience_stats()
+        faults.reset_fault_plan()
+        os.environ[faults.ENV_PLAN] = plan
+        faulted_dir = os.path.join(root, "faulted")
+        res = supervise(lambda ctx: _fit(faulted_dir),
+                        directory=faulted_dir, mode="inline")
+        params = res.result
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        faults.reset_fault_plan()
+        shutil.rmtree(root, ignore_errors=True)
+
+    diffs = [float(np.max(np.abs(p - b))) if p.size else 0.0
+             for p, b in zip(params, base)]
+    max_diff = max(diffs) if diffs else 0.0
+    match = (len(params) == len(base)
+             and all(p.shape == b.shape for p, b in zip(params, base))
+             and all(np.allclose(p, b, rtol=1e-5, atol=1e-6)
+                     for p, b in zip(params, base)))
+    stats = profiler.get_resilience_stats()
+    out = {
+        "fault_plan": plan,
+        "nbatch": nbatch,
+        "epochs": epochs,
+        "attempts": res.attempts,
+        "restarts": res.restarts,
+        "steps_lost": res.steps_lost,
+        "restart_latency_ms": stats["restart_latency_ms_last"],
+        "retries": stats["retries"],
+        "faults_injected": stats["faults_injected"],
+        "params_match": bool(match),
+        "max_abs_param_diff": max_diff,
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+    log(f"[resilience] {res.attempts} attempts ({res.restarts} restarts, "
+        f"~{res.steps_lost} steps lost, last restart "
+        f"{stats['restart_latency_ms_last']:.0f} ms), "
+        f"{stats['retries']} retries / {stats['faults_injected']} faults "
+        f"-> params_match={match} (max diff {max_diff:.2e})")
+    if not match:
+        raise AssertionError(
+            f"supervised resume diverged from fault-free baseline "
+            f"(max param diff {max_diff:.3e})")
+    return out
+
+
 def bench_cpu_fallback():
     """Reduced harness for hosts where the TPU backend won't initialize
     (BENCH_r05 regression: rc=1 'Unable to initialize backend'). Emits the
     single-line JSON with ``"fallback": "cpu"`` instead of crashing: a
     LeNet-scale training loop through the Module API — which also exercises
     the fused StepExecutor path — sized to finish in seconds on one core.
-    Every leg runs under :func:`run_leg` crash containment (one retry on
-    transient backend errors, ``{"error": ...}`` otherwise), so a single bad
+    Every leg runs under :func:`run_leg` crash containment (transient
+    backend errors retried with backoff, ``{"error": ...}`` otherwise), so a single bad
     scenario can never erase the scoreboard again. ``MXTPU_BENCH_SMOKE=1``
     shrinks every leg's iteration counts (same code paths, same JSON keys)
     so the tier-1 bench guard can run this harness as a fast regression
@@ -1520,6 +1659,9 @@ def bench_cpu_fallback():
     from mxtpu import profiler
 
     smoke = os.environ.get("MXTPU_BENCH_SMOKE") == "1"
+    if _resilience_only():
+        _emit_resilience_only(smoke)
+        return
     train = run_leg("train", _fallback_train_leg, smoke)
     mod = train.pop("module", None) if isinstance(train, dict) else None
     # the checkpoint + input-pipeline + zero_dp + trace scenarios reuse the
@@ -1531,6 +1673,7 @@ def bench_cpu_fallback():
                    steps=8 if smoke else 48)
     zdp = run_leg("zero_dp", bench_zero_dp, steps=4 if smoke else 16,
                   hidden=128 if smoke else 512)
+    resil = run_leg("resilience", bench_resilience, smoke=smoke)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer, smoke=smoke) \
         if _sanitize_requested() else None
@@ -1551,6 +1694,7 @@ def bench_cpu_fallback():
         "checkpoint": ckpt,
         "input_pipeline": pipe,
         "zero_dp": zdp,
+        "resilience": resil,
         "trace": trace,
         "compile_caches": caches,
     }
@@ -1598,7 +1742,10 @@ def main():
             or jax.default_backend() == "cpu":
         bench_cpu_fallback()
         return
-    # every scenario runs under run_leg crash containment: one retry with
+    if _resilience_only():
+        _emit_resilience_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
+        return
+    # every scenario runs under run_leg crash containment: retries with
     # backoff on transient backend errors (UNAVAILABLE / init failures), an
     # {"error": ...} leg entry otherwise — the scoreboard always ships
     train = {}
@@ -1623,6 +1770,7 @@ def main():
     ckpt = run_leg("checkpoint", bench_checkpoint)
     feed_pipe = run_leg("input_pipeline", bench_input_pipeline)
     zdp = run_leg("zero_dp", bench_zero_dp)
+    resil = run_leg("resilience", bench_resilience)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer) \
         if _sanitize_requested() else None
@@ -1658,6 +1806,7 @@ def main():
         "checkpoint": ckpt,
         "input_pipeline": feed_pipe,
         "zero_dp": zdp,
+        "resilience": resil,
         "trace": trace,
         "compile_caches": _compile_caches(),
     }

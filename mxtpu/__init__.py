@@ -45,7 +45,7 @@ _SUBSYSTEMS = ["initializer", "optimizer", "lr_scheduler", "metric", "callback",
                "profiler", "test_utils", "model", "image", "visualization",
                "contrib", "operator", "monitor", "rtc", "capi", "rnn",
                "attribute", "engine", "serving", "step_cache", "checkpoint",
-               "device_feed", "analysis", "observability"]
+               "device_feed", "analysis", "observability", "resilience"]
 for _name in _SUBSYSTEMS:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)

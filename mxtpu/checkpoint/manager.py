@@ -55,6 +55,38 @@ _ARRAYS_FILE = "arrays-r{rank}.npz"
 _META_FILE = "meta.json"
 _META_KEY = "__meta__"
 
+# Managers that were never close()d must not swallow a latched writer error
+# at interpreter exit: every live manager is tracked here and audited by an
+# atexit hook (loud error log — the shutdown-time analogue of close()'s
+# re-raise, since raising inside atexit can't fail the caller anymore).
+_live_lock = threading.Lock()
+_live_managers: "weakref.WeakSet" = None  # created on first manager
+
+
+def _audit_unclosed_managers():
+    with _live_lock:
+        mgrs = list(_live_managers) if _live_managers is not None else []
+    for m in mgrs:
+        with m._lock:
+            errs = list(m._errors)
+        if errs:
+            logging.getLogger(__name__).error(
+                "CheckpointManager(%s): exiting with %d unraised async-writer "
+                "error(s) — the last save(s) of this run did NOT commit. "
+                "First: %s. Call close()/wait_until_finished() to surface "
+                "these as exceptions.", m.directory, len(errs), errs[0])
+
+
+def _track_manager(mgr: "CheckpointManager"):
+    global _live_managers
+    import atexit
+    import weakref
+    with _live_lock:
+        if _live_managers is None:
+            _live_managers = weakref.WeakSet()
+            atexit.register(_audit_unclosed_managers)
+        _live_managers.add(mgr)
+
 
 class _SaveJob:
     __slots__ = ("snapshot", "done", "error", "t_enqueued")
@@ -104,6 +136,7 @@ class CheckpointManager:
         # test seam: {"before_write"|"before_rename"|"before_marker": fn} —
         # crash-mid-save tests kill the writer at the matching window
         self._test_hooks: Dict[str, Callable[[], None]] = {}
+        _track_manager(self)
 
     # -- discovery ---------------------------------------------------------
     def all_steps(self) -> List[int]:
@@ -212,13 +245,22 @@ class CheckpointManager:
             self._thread.start()
 
     def _writer_loop(self):
+        from ..resilience import retry_transient
+        from ..resilience.watchdog import heartbeat
         while True:
             job = self._queue.get()
             if job is None:
                 self._queue.task_done()
                 return
+            heartbeat("ckpt")
             try:
-                self._write(job)
+                # Transient fs errors (ENOSPC races, NFS hiccups, injected
+                # io_error faults) are retried — staging dirs are reusable
+                # and commit_dir tolerates a torn previous attempt, so
+                # _write is idempotent per job. Logic errors (and the test
+                # hooks' _Boom) escalate on the first occurrence.
+                retry_transient(self._write, job,
+                                label=f"ckpt.write[{job.snapshot.step}]")
             except BaseException as e:  # keep the writer alive past one bad job
                 job.error = e
                 with self._lock:
@@ -247,6 +289,8 @@ class CheckpointManager:
         rank = jax.process_index()
         if "before_write" in self._test_hooks:
             self._test_hooks["before_write"]()
+        from ..resilience import fault_point
+        fault_point("ckpt.write")
         with tracer.span("ckpt/write", cat="ckpt", args={"step": int(step)}):
             if rank == 0:
                 # Only the committing rank may sweep: a non-zero rank returns
@@ -262,6 +306,7 @@ class CheckpointManager:
         shard_ms = (time.perf_counter() - t0) * 1e3
         self._barrier()                     # every rank's shard is on disk
         if rank == 0:
+            fault_point("ckpt.commit")
             with tracer.span("ckpt/commit", cat="ckpt",
                              args={"step": int(step)}):
                 with open(os.path.join(stage, _META_FILE), "w") as f:
@@ -389,7 +434,8 @@ class CheckpointManager:
     # -- preemption --------------------------------------------------------
     def install_preemption_handler(self, module=None, trainer=None,
                                    state_fn: Optional[Callable[[], dict]] = None,
-                                   signals=(signal.SIGTERM,)):
+                                   signals=(signal.SIGTERM,),
+                                   include_sigint: bool = False):
         """Hook SIGTERM (TPU fleet preemption notice) to run ONE final
         blocking save and drain the writer, then hand the signal back: a
         previous Python handler is chained; the default disposition
@@ -397,9 +443,17 @@ class CheckpointManager:
         the preemption notice still kills the job; SIG_IGN stays ignored.
         ``state_fn`` may supply the save kwargs (must include ``step``);
         otherwise the last saved step + 1 is used with the given
-        module/trainer."""
+        module/trainer — plus the module's live ``_fit_progress``
+        epoch/nbatch (maintained by ``Module.fit``) so a mid-epoch
+        preemption resumes mid-epoch instead of replaying the epoch.
+
+        ``include_sigint=True`` opts Ctrl-C into the same final-save +
+        re-delivery contract (long local runs); default off — an interactive
+        Ctrl-C normally wants KeyboardInterrupt semantics, not a save."""
         if self._preempt_installed:
             return
+        if include_sigint and signal.SIGINT not in signals:
+            signals = tuple(signals) + (signal.SIGINT,)
         prev = {}
 
         def _handler(signum, frame):
@@ -415,6 +469,10 @@ class CheckpointManager:
                 else:
                     kwargs = {"module": module, "trainer": trainer,
                               "step": (self._last_step or 0) + 1}
+                    prog = getattr(module, "_fit_progress", None)
+                    if prog:
+                        kwargs.setdefault("epoch", prog.get("epoch"))
+                        kwargs.setdefault("nbatch", prog.get("nbatch"))
                 kwargs["blocking"] = True
                 self.logger.warning(
                     "CheckpointManager: signal %s — final blocking save of "

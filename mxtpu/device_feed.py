@@ -234,12 +234,18 @@ class DeviceFeed(DataIter):
 
     # -- producer ----------------------------------------------------------
     def _produce(self, gen: _Generation, src):
+        from .resilience import fault_point
+        from .resilience.watchdog import heartbeat
         try:
             while not gen.stop.is_set():
                 try:
                     batch = next(src)
                 except StopIteration:
                     break
+                # resilience seam: an injected producer fault takes the same
+                # latched-error path a real decode/transfer failure does
+                fault_point("feed.produce")
+                heartbeat("feed")
                 # producer-thread span: one batch through the host→device
                 # boundary (its own tid row in the trace, overlapping the
                 # consumer's feed/stall spans when the pipeline is behind)

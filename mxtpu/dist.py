@@ -26,20 +26,53 @@ __all__ = ["initialize", "auto_initialize", "is_initialized", "rank", "size",
 _initialized = False
 
 
+def _pod_connected() -> bool:
+    """Whether ``jax.distributed`` already holds a live coordinator client
+    (connected by us or by someone calling ``jax.distributed.initialize``
+    directly). Deliberately NOT ``jax.process_count()``: that would
+    initialize the local XLA backend, after which a first
+    ``jax.distributed.initialize`` is forbidden — the predicate must be
+    safe to call from ``initialize()`` itself."""
+    try:
+        from jax._src import distributed as _jax_distributed
+        return _jax_distributed.global_state.client is not None
+    except Exception:  # jax internals moved — fall back to the module flag
+        return False
+
+
 def is_initialized() -> bool:
-    return _initialized or jax.process_count() > 1
+    """Whether the pod connection is up. An externally-connected pod counts,
+    and in that case the module flag is synced so predicate and state can't
+    diverge: before this fix the predicate returned True while
+    ``_initialized`` stayed False, so a later explicit ``initialize()``
+    still reached ``jax.distributed.initialize``, which rejects late
+    calls."""
+    global _initialized
+    if not _initialized and _pod_connected():
+        _initialized = True
+    return _initialized
 
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None):
-    """Connect this process to the pod (jax.distributed.initialize wrapper)."""
+    """Connect this process to the pod (jax.distributed.initialize wrapper).
+
+    Transient bring-up failures (coordinator not yet listening, connection
+    races during a gang start) are retried per ``resilience.retry_transient``;
+    logic errors (bad addresses, double init) escalate immediately."""
     global _initialized
-    if _initialized:
+    if is_initialized():   # also syncs the flag for externally-connected pods
         return
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    from .resilience import fault_point, retry_transient
+
+    def _connect():
+        fault_point("dist.initialize")
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+    retry_transient(_connect, label="dist.initialize")
     _initialized = True
 
 
@@ -62,7 +95,7 @@ def auto_initialize() -> bool:
         try:
             initialize(f"{uri}:{port}", int(n), wid)
         except RuntimeError as e:
-            if jax.process_count() > 1:
+            if _pod_connected():
                 _initialized = True  # someone else already connected the pod
                 return True
             raise RuntimeError(

@@ -227,6 +227,19 @@ class BaseModule:
                 self.logger.info(
                     "fit: resumed from checkpoint step %s (epoch=%s nbatch=%s)",
                     snap.step, begin_epoch, resume_nbatch)
+            # the watchdog's stall policy gets a final blocking save through
+            # this manager (current params + live epoch/nbatch progress) —
+            # a hung step still leaves a resumable checkpoint behind
+            from .resilience import watchdog as _watchdog
+
+            def _emergency_save(_mgr=mgr, _mod=self):
+                prog = getattr(_mod, "_fit_progress", None) or {}
+                _mgr.save(step=(_mgr._last_step or 0) + 1, module=_mod,
+                          trainer=getattr(_mod, "_trainer", None),
+                          epoch=prog.get("epoch"), nbatch=prog.get("nbatch"),
+                          blocking=True)
+
+            _watchdog.set_emergency_save(_emergency_save)
         eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
 
@@ -258,6 +271,11 @@ class BaseModule:
                 # is a host-synced step wall time, not just dispatch
                 self.update_metric(eval_metric, data_batch.label)
                 flops_mod.record_step(time.perf_counter() - t_step)
+                # live progress marker (updated AFTER the batch completes, so
+                # a preemption/emergency save resumes past this batch, never
+                # replaying it) — read by install_preemption_handler's
+                # default state_fn and the watchdog emergency save
+                self._fit_progress = {"epoch": epoch, "nbatch": nbatch}
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -549,9 +567,11 @@ class Module(BaseModule):
                 return
             except Exception as e:
                 from .analysis.sanitize import SanitizerError
-                if isinstance(e, SanitizerError):
-                    # a sanitizer escalation is a deliberate failure — the
-                    # eager fallback would hide the very hazard it names
+                from .resilience.faults import InjectedFault
+                if isinstance(e, (SanitizerError, InjectedFault)):
+                    # a sanitizer escalation or an injected fault is a
+                    # deliberate failure — the eager fallback would hide the
+                    # very hazard it names (and permanently de-fuse the step)
                     raise
                 # trace/compile failure (unsupported optimizer kernel, exotic
                 # block): permanently fall back to the eager path — behavior

@@ -43,6 +43,19 @@ def record_checkpoint_save(blocked_ms: float):
         _ckpt["blocked_step_ms_total"] += blocked_ms
 
 
+# Commit observers (resilience's committed-step watermark rides here).
+# Registered callables run OUTSIDE the stats lock — a hook may call back
+# into any record_*/get_* without self-deadlock.
+_commit_hooks: list = []
+
+
+def add_commit_hook(fn):
+    """Register ``fn()`` to run after every checkpoint commit (idempotent)."""
+    with _stats_lock:
+        if fn not in _commit_hooks:
+            _commit_hooks.append(fn)
+
+
 def record_checkpoint_commit(write_ms: float, latency_ms: float, nbytes: int):
     """Writer-thread side: ``write_ms`` is the serialize+fsync+commit work,
     ``latency_ms`` the enqueue→commit wall time (queueing included),
@@ -53,6 +66,13 @@ def record_checkpoint_commit(write_ms: float, latency_ms: float, nbytes: int):
         _ckpt["save_latency_ms_last"] = latency_ms
         _ckpt["save_latency_ms_total"] += latency_ms
         _ckpt["committed_bytes"] += int(nbytes)
+        hooks = list(_commit_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception as e:
+            import logging
+            logging.getLogger(__name__).warning("commit hook failed: %s", e)
 
 
 def record_checkpoint_shard_write(write_ms: float):
@@ -205,6 +225,45 @@ def get_comm_stats() -> dict:
 def reset_comm_stats():
     with _stats_lock:
         _comm.update(_COMM_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# resilience observability (mxtpu.resilience counters)
+# ---------------------------------------------------------------------------
+
+_RESIL_ZERO = {"faults_injected": 0,
+               "retries": 0, "retries_exhausted": 0, "escalations": 0,
+               "watchdog_stalls": 0, "emergency_saves": 0,
+               "restarts": 0, "steps_lost": 0,
+               "restart_latency_ms_total": 0.0,
+               "restart_latency_ms_last": 0.0}
+_resil = dict(_RESIL_ZERO)
+
+
+def record_resilience(key: str, n=1):
+    """One resilience event (``mxtpu.resilience``): faults fired, transient
+    retries taken/exhausted, non-transient escalations, watchdog stalls,
+    emergency saves, supervisor restarts, steps lost since last commit.
+    ``*_last`` keys assign; everything else accumulates."""
+    with _stats_lock:
+        if key.endswith("_last"):
+            _resil[key] = n
+        else:
+            _resil[key] += n
+
+
+def get_resilience_stats() -> dict:
+    """Resilience counters — the observability contract of the fault-
+    injection/retry/watchdog/supervisor stack. ``bench.py resilience`` emits
+    these as its JSON block; the guard tests assert injected faults left
+    fingerprints here."""
+    with _stats_lock:
+        return dict(_resil)
+
+
+def reset_resilience_stats():
+    with _stats_lock:
+        _resil.update(_RESIL_ZERO)
 
 
 # ---------------------------------------------------------------------------

@@ -81,7 +81,19 @@ def allreduce_array(x, mesh: Optional[Mesh] = None, op: str = "sum"):
         return r / mesh.shape[axis] if op == "mean" else r
 
     fn = shard_map_compat(_psum, mesh, P(), P())
-    return fn(jnp.asarray(x))
+
+    # Resilience seam + retry at the array-level entry (the path kvstore and
+    # barrier() ride): a transient backend failure here — the "one
+    # UNAVAILABLE erased a bench round" incident — is retried; the injected
+    # `collective` fault reproduces it on CPU tier-1, where the
+    # cross-process short-circuits above never fire.
+    from ..resilience import fault_point, retry_transient
+
+    def _run():
+        fault_point("collective")
+        return fn(jnp.asarray(x))
+
+    return retry_transient(_run, label="collective.allreduce")
 
 
 allreduce = allreduce_array
@@ -183,17 +195,26 @@ def _process_exchange(x, body):
     import numpy as np
     from .. import profiler
     from ..observability import tracer
+    from ..resilience import fault_point, retry_transient
     t0 = time.perf_counter()
     local = np.asarray(jax.device_get(jnp.asarray(x)))[None]
-    with tracer.span("comm/exchange", cat="comm",
-                     args={"bytes": int(local.nbytes)}):
-        mesh = _process_mesh()
-        sh = NamedSharding(mesh, P("proc"))
-        arr = jax.make_array_from_process_local_data(sh, local)
-        fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
-        out = fn(arr)
-        jax.block_until_ready(out)
-        res = jnp.asarray(jax.device_get(out))
+
+    def _run():
+        # seam + retry around the whole exchange: DCN flakes surface here as
+        # backend UNAVAILABLE, and re-running the collective is idempotent
+        # (every rank re-contributes the same host value)
+        fault_point("exchange")
+        with tracer.span("comm/exchange", cat="comm",
+                         args={"bytes": int(local.nbytes)}):
+            mesh = _process_mesh()
+            sh = NamedSharding(mesh, P("proc"))
+            arr = jax.make_array_from_process_local_data(sh, local)
+            fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
+            out = fn(arr)
+            jax.block_until_ready(out)
+            return jnp.asarray(jax.device_get(out))
+
+    res = retry_transient(_run, label="collective.exchange")
     profiler.record_collective((time.perf_counter() - t0) * 1e3, local.nbytes)
     return res
 

@@ -1,0 +1,25 @@
+"""mxtpu.resilience — fault injection, watchdog/retry runtime, and the
+elastic resume supervisor (ROADMAP item 4; see ``docs/resilience.md``).
+
+The reference MXNet's dependency engine kept making progress under async
+chaos inside one process; this package is the same discipline at the *job*
+level: schedule failures deterministically (:mod:`.faults`), retry what is
+transient (:mod:`.retry`), detect what hangs (:mod:`.watchdog`), and restart
+what dies — resuming from the last committed checkpoint at whatever dp size
+is available (:mod:`.supervisor`).
+"""
+
+from .faults import (FaultPlan, InjectedFault, fault_point, get_fault_plan,
+                     reset_fault_plan)
+from .retry import RetryError, classify_error, is_transient, retry_transient
+from .supervisor import (GiveUpError, RestartContext, SuperviseResult,
+                         supervise)
+from .watchdog import (WATCHDOG_EXIT_CODE, StallReport, Watchdog, heartbeat)
+
+__all__ = [
+    "FaultPlan", "InjectedFault", "fault_point", "get_fault_plan",
+    "reset_fault_plan",
+    "RetryError", "classify_error", "is_transient", "retry_transient",
+    "Watchdog", "StallReport", "heartbeat", "WATCHDOG_EXIT_CODE",
+    "supervise", "RestartContext", "SuperviseResult", "GiveUpError",
+]

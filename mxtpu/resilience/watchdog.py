@@ -1,0 +1,395 @@
+"""Per-step deadline watchdog — turns a silent hang into a structured abort.
+
+A hung collective (or a wedged host callback, or a dead feed producer with a
+blocked consumer) does not raise; it just stops the world. The reference's
+dependency engine had the same failure shape — an op whose callback never
+fires wedges every dependent op — and the operational answer is the same:
+watch for "no step finished within the deadline", and when it trips, dump
+*what the process was doing* (per-thread beats, the tracer's most recent
+spans per timeline row, live Python stacks), attempt one emergency blocking
+checkpoint, and exit with a recognizable code so a supervisor can restart
+instead of waiting forever.
+
+Heartbeats are cheap module-level calls (``watchdog.heartbeat("step")``)
+wired into ``step_cache.StepExecutor.step`` (the deadline source), the
+DeviceFeed producer (``feed``), and the checkpoint writer (``ckpt``) — the
+last two don't gate the deadline but land in the :class:`StallReport` so a
+stall distinguishes "step wedged while feed kept producing" from "everything
+stopped".
+
+Heartbeats also drive the *progress beacon*: when a supervisor set
+``MXTPU_PROGRESS_BEACON`` the step count (and committed-step watermark, via
+the checkpoint commit hook) is mirrored to a small JSON file the parent can
+read after SIGKILL — the "steps lost since last commit" accounting in
+``get_resilience_stats()`` (approximate by one async-snapshot lag; see
+``docs/resilience.md``).
+
+Knobs: ``MXTPU_STEP_DEADLINE_S`` (arms the deadline; unset = watchdog must
+be constructed explicitly), ``MXTPU_WATCHDOG_GRACE_S`` (emergency-save
+budget before the abort, default 20).
+
+Exit code :data:`WATCHDOG_EXIT_CODE` (87) marks a watchdog abort to
+``supervisor.supervise`` (restart-worthy, like a crash, but reported
+separately).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Watchdog", "StallReport", "heartbeat", "active", "armed",
+           "set_emergency_save", "set_progress_beacon", "beat_counts",
+           "WATCHDOG_EXIT_CODE", "ENV_DEADLINE", "ENV_BEACON"]
+
+WATCHDOG_EXIT_CODE = 87
+ENV_DEADLINE = "MXTPU_STEP_DEADLINE_S"
+ENV_BEACON = "MXTPU_PROGRESS_BEACON"
+
+_log = logging.getLogger("mxtpu.resilience")
+
+# -- module heartbeat plumbing ----------------------------------------------
+# heartbeat() must stay callable (and cheap) with no watchdog armed: the step
+# loop, feed producer, and ckpt writer call it unconditionally. Counts are
+# module state guarded by one lock (R004 contract); the active watchdog and
+# beacon path are scalar rebinds.
+
+_hb_lock = threading.Lock()
+_beat_counts: Dict[str, int] = {}
+_beacon = {"path": None, "committed": 0, "last_write": 0.0}
+_active: Optional["Watchdog"] = None
+
+
+def heartbeat(source: str = "step") -> None:
+    """Record one unit of progress from ``source`` (thread-safe, hot-path
+    cheap: one lock bump; beacon writes are throttled)."""
+    with _hb_lock:
+        _beat_counts[source] = _beat_counts.get(source, 0) + 1
+        n = _beat_counts[source]
+        path = _beacon["path"]
+    wd = _active
+    if wd is not None:
+        wd.beat(source)
+    if path is not None and source == "step":
+        _maybe_write_beacon(n)
+
+
+def beat_counts() -> Dict[str, int]:
+    with _hb_lock:
+        return dict(_beat_counts)
+
+
+def reset_heartbeats() -> None:
+    with _hb_lock:
+        _beat_counts.clear()
+
+
+def active() -> Optional["Watchdog"]:
+    return _active
+
+
+def armed() -> bool:
+    return _active is not None
+
+
+def set_emergency_save(fn: Optional[Callable[[], None]]) -> None:
+    """Register the blocking-save callable the default stall policy runs
+    before aborting (``Module.fit`` wires this when a CheckpointManager is
+    in play). No-op storage when no watchdog ever arms."""
+    wd = _active
+    if wd is not None:
+        wd.set_emergency(fn)
+    global _pending_emergency
+    _pending_emergency = fn
+
+
+_pending_emergency: Optional[Callable[[], None]] = None
+
+
+# -- progress beacon ---------------------------------------------------------
+
+def set_progress_beacon(path: Optional[str]) -> None:
+    """Point the beacon at ``path`` (or disarm with None). The supervisor
+    sets this in the child via ``MXTPU_PROGRESS_BEACON``."""
+    with _hb_lock:
+        _beacon["path"] = path
+        _beacon["committed"] = 0
+        _beacon["last_write"] = 0.0
+
+
+def _write_beacon_locked_snapshot(path: str, steps: int, committed: int) -> None:
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"steps": steps, "committed_steps": committed,
+                       "pid": os.getpid(), "ts": time.time()}, f)
+        os.replace(tmp, path)
+    except OSError:
+        _log.debug("progress beacon write failed", exc_info=True)
+
+
+def _maybe_write_beacon(steps: int, force: bool = False) -> None:
+    now = time.monotonic()
+    with _hb_lock:
+        path = _beacon["path"]
+        if path is None:
+            return
+        if not force and now - _beacon["last_write"] < 0.05:
+            return
+        _beacon["last_write"] = now
+        committed = _beacon["committed"]
+    _write_beacon_locked_snapshot(path, steps, committed)
+
+
+def _on_checkpoint_commit() -> None:
+    """Checkpoint commit hook (registered with ``observability.metrics``):
+    advance the committed-step watermark to the current step count. Off by
+    one async-snapshot lag — documented as approximate."""
+    with _hb_lock:
+        _beacon["committed"] = _beat_counts.get("step", 0)
+        steps = _beat_counts.get("step", 0)
+        path = _beacon["path"]
+    if path is not None:
+        _maybe_write_beacon(steps, force=True)
+
+
+def ensure_commit_hook() -> None:
+    """Register the committed-step watermark hook with the metrics store
+    (idempotent — ``add_commit_hook`` dedups)."""
+    from ..observability import metrics
+    metrics.add_commit_hook(_on_checkpoint_commit)
+
+
+def progress_snapshot() -> dict:
+    """``{"steps": N, "committed_steps": M}`` for the current process —
+    the inline-supervisor side of steps-lost accounting."""
+    with _hb_lock:
+        return {"steps": _beat_counts.get("step", 0),
+                "committed_steps": _beacon["committed"]}
+
+
+def read_beacon(path: str) -> Optional[dict]:
+    """Parse a beacon file (parent side, after child death)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _install_beacon_from_env() -> None:
+    path = os.environ.get(ENV_BEACON)
+    if path:
+        set_progress_beacon(path)
+        ensure_commit_hook()
+
+
+# -- stall report ------------------------------------------------------------
+
+class StallReport:
+    """Everything known at the moment the deadline tripped: per-source beat
+    ages/counts, the tracer's most recent spans per thread row (the "blocked
+    span"), and live Python stacks for every thread."""
+
+    def __init__(self, deadline_s: float, waited_s: float,
+                 beats: Dict[str, dict], spans: List[dict],
+                 stacks: Dict[str, str]):
+        self.deadline_s = deadline_s
+        self.waited_s = waited_s
+        self.beats = beats
+        self.spans = spans
+        self.stacks = stacks
+
+    def to_dict(self) -> dict:
+        return {"deadline_s": self.deadline_s, "waited_s": self.waited_s,
+                "beats": self.beats, "recent_spans": self.spans,
+                "stacks": self.stacks}
+
+    def render(self) -> str:
+        lines = [f"WATCHDOG: no step heartbeat for {self.waited_s:.1f}s "
+                 f"(deadline {self.deadline_s:.1f}s)"]
+        for src, info in sorted(self.beats.items()):
+            lines.append(f"  beat[{src}]: count={info['count']} "
+                         f"age={info['age_s']:.1f}s")
+        for row in self.spans:
+            tail = ", ".join(e.get("name", "?") for e in row["events"])
+            lines.append(f"  spans[{row['thread']}]: ... {tail}")
+        for name, stack in self.stacks.items():
+            lines.append(f"  stack[{name}]:")
+            for ln in stack.rstrip().splitlines():
+                lines.append(f"    {ln}")
+        return "\n".join(lines)
+
+    def __str__(self):
+        return self.render()
+
+
+def _thread_stacks() -> Dict[str, str]:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        label = f"{names.get(tid, 'unknown')}({tid})"
+        out[label] = "".join(traceback.format_stack(frame))
+    return out
+
+
+def _span_tails(per_thread: int = 4) -> List[dict]:
+    from ..observability import tracer
+    rows = []
+    for tid, name, events, _dropped in tracer.snapshot_buffers():
+        if events:
+            rows.append({"thread": f"{name}({tid})",
+                         "events": events[-per_thread:]})
+    return rows
+
+
+# -- watchdog ----------------------------------------------------------------
+
+class Watchdog:
+    """Deadline monitor over the ``step`` heartbeat.
+
+    Default stall policy: render + log the :class:`StallReport`, run the
+    registered emergency save (in a side thread, bounded by ``grace_s``),
+    then ``os._exit(87)`` so the supervisor restarts from the last commit.
+    Pass ``on_stall`` to fully replace that policy (tests; embedders)."""
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 poll_s: Optional[float] = None,
+                 on_stall: Optional[Callable[[StallReport], None]] = None,
+                 grace_s: Optional[float] = None):
+        if deadline_s is None:
+            raw = os.environ.get(ENV_DEADLINE, "")
+            deadline_s = float(raw) if raw else None
+        if deadline_s is None or deadline_s <= 0:
+            raise ValueError(
+                f"Watchdog needs a positive deadline (arg or {ENV_DEADLINE})")
+        self.deadline_s = float(deadline_s)
+        self.poll_s = poll_s if poll_s is not None \
+            else max(0.05, min(self.deadline_s / 4.0, 1.0))
+        self.on_stall = on_stall
+        if grace_s is None:
+            grace_s = float(os.environ.get("MXTPU_WATCHDOG_GRACE_S", "20"))
+        self.grace_s = grace_s
+        self.stalled: Optional[StallReport] = None
+        self._emergency: Optional[Callable[[], None]] = _pending_emergency
+        self._lock = threading.Lock()
+        self._beats: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t_start = 0.0
+
+    # -- lifecycle --
+    def start(self) -> "Watchdog":
+        global _active
+        if self._thread is not None:
+            return self
+        self._t_start = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._monitor,
+                                        name="mxtpu-watchdog", daemon=True)
+        _active = self
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        global _active
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+        if _active is self:
+            _active = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- inputs --
+    def beat(self, source: str = "step") -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._beats[source] = now
+            self._counts[source] = self._counts.get(source, 0) + 1
+
+    def set_emergency(self, fn: Optional[Callable[[], None]]) -> None:
+        self._emergency = fn
+
+    # -- monitor --
+    def _step_age(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            last = self._beats.get("step", self._t_start)
+        return now - last
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            if self._step_age() > self.deadline_s:
+                self._handle_stall()
+                return  # one-shot: a stall ends this monitor
+
+    def _build_report(self) -> StallReport:
+        now = time.monotonic()
+        with self._lock:
+            beats = {src: {"count": self._counts.get(src, 0),
+                           "age_s": now - t}
+                     for src, t in self._beats.items()}
+            if "step" not in beats:
+                beats["step"] = {"count": 0, "age_s": now - self._t_start}
+        return StallReport(self.deadline_s, beats["step"]["age_s"], beats,
+                           _span_tails(), _thread_stacks())
+
+    def _handle_stall(self) -> None:
+        report = self._build_report()
+        self.stalled = report
+        from ..observability import metrics, tracer
+        metrics.record_resilience("watchdog_stalls")
+        tracer.instant("resilience/stall", cat="resilience",
+                       args={"waited_s": round(report.waited_s, 3),
+                             "deadline_s": self.deadline_s})
+        _log.error("%s", report.render())
+        if self.on_stall is not None:
+            self.on_stall(report)
+            return
+        self._emergency_save()
+        _log.error("watchdog: aborting with exit code %d", WATCHDOG_EXIT_CODE)
+        logging.shutdown()
+        os._exit(WATCHDOG_EXIT_CODE)
+
+    def _emergency_save(self) -> None:
+        fn = self._emergency
+        if fn is None:
+            return
+        from ..observability import metrics
+        done = threading.Event()
+
+        def _run():
+            try:
+                fn()
+                metrics.record_resilience("emergency_saves")
+            except BaseException:  # mxtpu: ignore[R005] — the process is
+                # about to os._exit(87); nothing may escape this thread
+                _log.exception("watchdog: emergency save failed")
+            finally:
+                done.set()
+
+        # the stalled thread might hold arbitrary locks — bound the save
+        t = threading.Thread(target=_run, name="mxtpu-emergency-save",
+                             daemon=True)
+        t.start()
+        if not done.wait(self.grace_s):
+            _log.error("watchdog: emergency save did not finish in %.1fs",
+                       self.grace_s)
+
+
+_install_beacon_from_env()

@@ -451,6 +451,15 @@ class StepExecutor:
         from .analysis import sanitize
         from .ndarray.ndarray import NDArray
         from .observability import tracer
+        from .resilience import fault_point
+        from .resilience.watchdog import heartbeat
+
+        # resilience seam FIRST — before the RNG advances below — so a fault
+        # (or preemption save) fired here leaves per-step RNG state identical
+        # to a run that never reached this step; heartbeat feeds the
+        # per-step deadline watchdog and the supervisor's progress beacon
+        fault_point("step")
+        heartbeat("step")
 
         san = sanitize.active()
         tr = self.trainer

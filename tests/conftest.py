@@ -37,6 +37,10 @@ def pytest_configure(config):
         "multi_device(n=8): needs an n-device mesh (the XLA "
         "host-device-count spoof above provides 8 virtual CPU devices); "
         "the dp_mesh fixture auto-skips when fewer devices exist")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (`-m 'not slow'`); the full "
+        "crash-matrix sweep lives here — run with `-m slow`")
 
 
 @pytest.fixture

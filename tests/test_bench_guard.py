@@ -45,8 +45,17 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert doc["value"] > 0
     assert doc["loss_end"] < doc["loss_start"]       # it actually trained
     # every fallback scenario must keep emitting its keys
-    assert {"checkpoint", "input_pipeline", "zero_dp",
+    assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
             "compile_caches", "mfu", "trace", "ratchet"} <= set(doc)
+    # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
+    # mid-epoch crash survived by a supervised restart, final params equal
+    # to the fault-free baseline
+    resil = doc["resilience"]
+    assert "error" not in resil, resil
+    assert resil["params_match"] is True
+    assert resil["restarts"] >= 1
+    assert resil["retries"] >= 1
+    assert resil["faults_injected"] >= 2
     zdp = doc["zero_dp"]
     assert zdp["dp"] >= 1
     assert zdp["zero1"]["opt_state_bytes_per_device"] > 0
@@ -77,24 +86,40 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
 def test_bench_leg_failure_yields_partial_json(tmp_path):
     """A scenario raising a (simulated) transient backend error — the
     BENCH_r05 crash shape — must NOT erase the scoreboard: the failing leg
-    emits ``{"error": ...}``, a leg failing ONCE is retried and succeeds,
-    and every other leg ships in an exit-0 JSON line."""
+    emits ``{"error": ...}``, a leg failing once is recovered by the shared
+    ``retry_transient`` policy, and every other leg ships in an exit-0 JSON
+    line."""
     doc, p = _run_fallback_bench(tmp_path, extra_env={
-        # input_pipeline: fails every attempt → error leg
-        # zero_dp: fails once → the single transient retry must recover it
+        # input_pipeline: fails every attempt → retries exhaust → error leg
+        # zero_dp: fails once → the transient retry policy must recover it
         "MXTPU_BENCH_FAIL_LEG": "input_pipeline,zero_dp:1",
         "MXTPU_BENCH_RETRY_BACKOFF_S": "0.01",
+        "MXTPU_RETRY_BACKOFF_MAX_S": "0.05",
     })
     assert "error" in doc["input_pipeline"]
     assert "UNAVAILABLE" in doc["input_pipeline"]["error"]
+    assert doc["input_pipeline"]["retried"] is True
     # the retried leg recovered — full payload, no error key
     assert "error" not in doc["zero_dp"]
     assert doc["zero_dp"]["zero1"]["step_ms"] > 0
-    assert "retrying once" in p.stderr
+    assert "retrying" in p.stderr
     # the remaining legs are populated and the headline survived
     assert doc["value"] > 0
     assert "error" not in doc["checkpoint"]
     assert doc["mfu"]["steps_per_sec"] > 0
+
+
+def test_bench_resilience_scenario_cli(tmp_path):
+    """``bench.py resilience`` (ISSUE 8 satellite): the resilience-only CLI
+    path must exit 0 and emit a single resilience JSON doc — fault injected
+    mid-run, supervised resume, params parity with the fault-free run."""
+    doc, _ = _run_fallback_bench(tmp_path, args=("resilience",))
+    assert doc["metric"] == "resilience_supervised_resume"
+    assert doc["value"] == 1.0
+    resil = doc["resilience"]
+    assert resil["params_match"] is True
+    assert resil["attempts"] == resil["restarts"] + 1
+    assert resil["restart_latency_ms"] > 0
 
 
 def test_bench_sanitized_leg_exits_zero_with_no_violations(tmp_path):

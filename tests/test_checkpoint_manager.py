@@ -180,6 +180,97 @@ def test_async_writer_error_surfaces_on_next_save(tmp_path):
     mgr.close()
 
 
+def test_async_writer_error_surfaces_at_close(tmp_path):
+    """close() is often the LAST manager call a trainer makes — a writer
+    error still latched there must re-raise, not vanish with the thread."""
+    mgr = CheckpointManager(tmp_path)
+    mgr._test_hooks = {"before_write": _boom}
+    mgr.save(1, arg_params={"w": np.ones(2, np.float32)})
+    mgr._queue.join()
+    with pytest.raises(_Boom):
+        mgr.close()
+    mgr.close()                            # idempotent after surfacing
+
+
+def test_unclosed_manager_with_writer_error_audited_at_exit(tmp_path):
+    """A trainer that never calls close()/wait_until_finished() after a
+    failed async save must still hear about it: the atexit audit logs the
+    unraised writer error(s) so 'my last checkpoints silently never
+    committed' can't happen."""
+    script = r"""
+import sys
+import numpy as np
+from mxtpu.checkpoint import CheckpointManager
+
+
+def _boom():
+    raise RuntimeError("disk on fire")
+
+
+mgr = CheckpointManager(sys.argv[1])
+mgr._test_hooks = {"before_write": _boom}
+mgr.save(1, arg_params={"w": np.ones(2, np.float32)})
+mgr._queue.join()
+# exits WITHOUT close() — the audit must speak up
+"""
+    r = subprocess.run([sys.executable, "-c", script, str(tmp_path)],
+                       capture_output=True, text=True,
+                       env=subprocess_env(), timeout=180)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "unraised async-writer" in r.stderr
+    assert "did NOT commit" in r.stderr
+
+
+def test_writer_retries_transient_fault_then_commits(tmp_path, monkeypatch):
+    """An injected transient io_error in the writer thread is absorbed by
+    the shared retry policy — the save still commits, and the retry is
+    visible in the resilience stats."""
+    from mxtpu.resilience import faults
+    monkeypatch.setenv(faults.ENV_PLAN, "site=ckpt.write:at=1:kind=io_error")
+    monkeypatch.setenv("MXTPU_RETRY_BACKOFF_S", "0.01")
+    faults.reset_fault_plan()
+    profiler.reset_resilience_stats()
+    try:
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(1, arg_params={"w": np.ones(2, np.float32)}, blocking=True)
+        assert mgr.latest_step() == 1
+        mgr.close()
+    finally:
+        monkeypatch.delenv(faults.ENV_PLAN)
+        faults.reset_fault_plan()
+    stats = profiler.get_resilience_stats()
+    assert stats["faults_injected"] == 1 and stats["retries"] == 1
+
+
+def test_preemption_handler_sigint_opt_in(tmp_path):
+    """``include_sigint=True`` (satellite): Ctrl-C gets the same final-save +
+    SIG_DFL re-delivery contract as SIGTERM — the process still dies by
+    SIGINT, and the final checkpoint is committed."""
+    script = r"""
+import os, signal, sys, time
+import numpy as np
+from mxtpu.checkpoint import CheckpointManager
+signal.signal(signal.SIGINT, signal.SIG_DFL)   # pristine disposition
+mgr = CheckpointManager(sys.argv[1])
+mgr.install_preemption_handler(
+    state_fn=lambda: {"step": 3,
+                      "arg_params": {"w": np.full(2, 9.0, np.float32)}},
+    include_sigint=True)
+os.kill(os.getpid(), signal.SIGINT)
+time.sleep(60)
+print("SURVIVED")
+"""
+    r = subprocess.run([sys.executable, "-c", script, str(tmp_path)],
+                       capture_output=True, text=True,
+                       env=subprocess_env(), timeout=180)
+    assert r.returncode == -signal.SIGINT, (r.returncode, r.stderr[-2000:])
+    assert "SURVIVED" not in r.stdout
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.latest_step() == 3
+    np.testing.assert_array_equal(mgr.restore().arrays["arg:w"],
+                                  np.full(2, 9.0, np.float32))
+
+
 def test_sigkill_mid_save_subprocess(tmp_path):
     """A real process death (SIGKILL, no cleanup handlers) between the
     staging write and the COMMIT marker: the next process restores the

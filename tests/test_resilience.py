@@ -1,0 +1,440 @@
+"""Resilience runtime units (mxtpu/resilience/): fault-plan grammar and
+deterministic firing, transient-vs-logic error classification, the shared
+retry policy, the step-deadline watchdog (StallReport path), the progress
+beacon, the inline elastic supervisor, and the dist.is_initialized
+state-sync satellite. CPU-only, in-process, tier-1 fast — the end-to-end
+fit-under-faults parity scenarios live in test_resilience_guard.py."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mxtpu import profiler
+from mxtpu.resilience import (FaultPlan, GiveUpError, InjectedFault,
+                              RetryError, Watchdog, classify_error,
+                              fault_point, retry_transient, supervise)
+from mxtpu.resilience import faults, retry, supervisor, watchdog
+
+from conftest import subprocess_env
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    faults.reset_fault_plan()
+    profiler.reset_resilience_stats()
+    watchdog.reset_heartbeats()
+    yield
+    faults.reset_fault_plan()
+    watchdog.set_progress_beacon(None)
+
+
+# ---------------------------------------------------------------------------
+# fault-plan grammar
+# ---------------------------------------------------------------------------
+
+
+def test_plan_grammar_fields_and_defaults():
+    plan = FaultPlan.parse(
+        "site=ckpt.write:step=2:kind=io_error,"
+        "at=3:kind=crash:count=2:attempt=4; site=feed.produce:count=-1")
+    assert [(r.site, r.at, r.kind, r.count, r.attempt) for r in plan.rules] \
+        == [("ckpt.write", 2, "io_error", 1, None),
+            ("step", 3, "crash", 2, 4),           # site defaults to "step"
+            ("feed.produce", 1, "io_error", -1, None)]
+
+
+def test_plan_grammar_at_and_step_are_aliases():
+    a = FaultPlan.parse("at=5").rules[0]
+    b = FaultPlan.parse("step=5").rules[0]
+    assert a.at == b.at == 5
+
+
+@pytest.mark.parametrize("spec", [
+    "kind=segfault",          # unknown kind
+    "sight=step",             # unknown field
+    "justaword",              # not key=value
+    "at=0",                   # pass index is 1-based
+])
+def test_plan_grammar_rejects_bad_specs(spec):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(spec)
+
+
+def test_fault_point_fires_on_scheduled_pass(monkeypatch):
+    monkeypatch.setenv(faults.ENV_PLAN, "site=x:at=2:kind=io_error")
+    faults.reset_fault_plan()
+    fault_point("x")                       # pass 1: armed but not yet due
+    with pytest.raises(InjectedFault) as ei:
+        fault_point("x")                   # pass 2: fires
+    assert ei.value.site == "x" and ei.value.hit == 2
+    assert ei.value.transient is True
+    fault_point("x")                       # pass 3: count=1 exhausted
+    # pass counters are per-site
+    for _ in range(5):
+        fault_point("unrelated-site")
+    assert profiler.get_resilience_stats()["faults_injected"] == 1
+
+
+def test_fault_point_noop_without_plan():
+    for _ in range(3):
+        fault_point("step")
+    assert profiler.get_resilience_stats()["faults_injected"] == 0
+
+
+def test_fault_plan_attempt_gating(monkeypatch):
+    monkeypatch.setenv(faults.ENV_PLAN, "at=1:kind=crash:attempt=2")
+    monkeypatch.setenv(faults.ENV_ATTEMPT, "1")
+    faults.reset_fault_plan()
+    fault_point("step")                    # attempt 1: gated off
+    monkeypatch.setenv(faults.ENV_ATTEMPT, "2")
+    faults.reset_fault_plan()              # fresh counters, like a restart
+    with pytest.raises(InjectedFault) as ei:
+        fault_point("step")
+    assert ei.value.transient is False     # crash must escalate
+
+
+def test_unavailable_kind_message_and_transience():
+    e = InjectedFault("collective", "unavailable", 3)
+    assert str(e).startswith("UNAVAILABLE: ")
+    assert e.transient is True
+    assert classify_error(e) is True
+
+
+# ---------------------------------------------------------------------------
+# classification + retry policy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("exc,transient", [
+    (ValueError("shape mismatch"), False),
+    (KeyError("w"), False),
+    (TimeoutError("deadline"), True),
+    (ConnectionError("peer gone"), True),
+    (RuntimeError("UNAVAILABLE: backend handshake failed"), True),
+    (RuntimeError("failed to initialize backend"), True),
+    (RuntimeError("boom"), False),
+    (InjectedFault("s", "crash", 1), False),
+    (InjectedFault("s", "io_error", 1), True),
+])
+def test_classify_error(exc, transient):
+    assert classify_error(exc) is transient
+
+
+def test_retry_transient_recovers_and_counts():
+    calls = {"n": 0}
+    seen = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionError("reset")
+        return 42
+
+    out = retry_transient(flaky, label="t", base_backoff_s=0.001,
+                          on_retry=lambda e, a: seen.append(a))
+    assert out == 42 and calls["n"] == 3
+    assert seen == [0, 1]
+    stats = profiler.get_resilience_stats()
+    assert stats["retries"] == 2
+    assert stats["retries_exhausted"] == 0
+
+
+def test_retry_transient_escalates_logic_errors_immediately():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("wrong shape")
+
+    with pytest.raises(ValueError):
+        retry_transient(broken, base_backoff_s=0.001)
+    assert calls["n"] == 1                 # no second attempt
+    assert profiler.get_resilience_stats()["escalations"] == 1
+
+
+def test_retry_transient_exhaustion_raises_retry_error():
+    def always():
+        raise ConnectionError("still down")
+
+    with pytest.raises(RetryError) as ei:
+        retry_transient(always, label="pod", max_retries=2,
+                        base_backoff_s=0.001)
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert ei.value.attempts == 3
+    stats = profiler.get_resilience_stats()
+    assert stats["retries"] == 2 and stats["retries_exhausted"] == 1
+
+
+def test_retry_transient_passes_interrupts_through():
+    def interrupted():
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        retry_transient(interrupted, base_backoff_s=0.001)
+    assert profiler.get_resilience_stats()["retries"] == 0
+
+
+def test_backoff_doubles_and_caps():
+    lo = retry._backoff_s(0, 0.5, 30.0)
+    assert 0.5 <= lo <= 0.5 * 1.25
+    capped = retry._backoff_s(10, 0.5, 2.0)
+    assert capped <= 2.0 * 1.25
+
+
+# ---------------------------------------------------------------------------
+# watchdog + progress beacon
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_stall_report_via_on_stall():
+    reports = []
+    wd = Watchdog(deadline_s=0.2, poll_s=0.05, on_stall=reports.append)
+    with wd:
+        deadline = time.monotonic() + 5.0
+        while not reports and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert reports, "watchdog never tripped"
+    rep = reports[0]
+    assert rep.waited_s >= 0.2
+    assert rep.beats["step"]["count"] == 0
+    assert rep.stacks                      # live python stacks captured
+    assert "WATCHDOG" in rep.render()
+    assert rep.to_dict()["deadline_s"] == 0.2
+    assert profiler.get_resilience_stats()["watchdog_stalls"] == 1
+
+
+def test_watchdog_heartbeats_keep_it_alive():
+    wd = Watchdog(deadline_s=0.4, poll_s=0.05,
+                  on_stall=lambda r: pytest.fail("spurious stall"))
+    with wd:
+        for _ in range(8):
+            watchdog.heartbeat("step")     # module-level beat reaches _active
+            time.sleep(0.05)
+    assert wd.stalled is None
+
+
+def test_watchdog_requires_a_deadline(monkeypatch):
+    monkeypatch.delenv(watchdog.ENV_DEADLINE, raising=False)
+    with pytest.raises(ValueError):
+        Watchdog()
+    monkeypatch.setenv(watchdog.ENV_DEADLINE, "2.5")
+    assert Watchdog().deadline_s == 2.5    # env arms it
+
+
+def test_progress_beacon_roundtrip(tmp_path):
+    path = str(tmp_path / "beacon.json")
+    watchdog.set_progress_beacon(path)
+    watchdog.heartbeat("step")
+    doc = watchdog.read_beacon(path)
+    assert doc["steps"] >= 1 and doc["committed_steps"] == 0
+    assert doc["pid"] == os.getpid()
+    # a checkpoint commit advances the committed watermark to the step count
+    watchdog.heartbeat("step")
+    watchdog._on_checkpoint_commit()
+    snap = watchdog.progress_snapshot()
+    assert snap["committed_steps"] == snap["steps"] >= 2
+    doc = watchdog.read_beacon(path)
+    assert doc["committed_steps"] == snap["steps"]
+    assert watchdog.read_beacon(str(tmp_path / "missing.json")) is None
+
+
+def test_commit_hook_registered_through_metrics():
+    from mxtpu.observability import metrics
+    watchdog.ensure_commit_hook()
+    watchdog.heartbeat("step")
+    before = watchdog.progress_snapshot()
+    metrics.record_checkpoint_commit(1.0, 1.0, 128)
+    after = watchdog.progress_snapshot()
+    assert after["committed_steps"] == before["steps"]
+
+
+# ---------------------------------------------------------------------------
+# inline supervisor
+# ---------------------------------------------------------------------------
+
+
+def test_supervise_inline_restarts_then_succeeds():
+    attempts_seen = []
+
+    def fit(ctx):
+        attempts_seen.append(
+            (ctx.attempt, ctx.prev_error,
+             os.environ.get(faults.ENV_ATTEMPT)))
+        if ctx.attempt == 1:
+            raise InjectedFault("step", "crash", 7)
+        return "trained"
+
+    res = supervise(fit, restart_backoff_s=0.01)
+    assert res.result == "trained"
+    assert res.attempts == 2 and res.restarts == 1
+    assert len(res.errors) == 1 and "injected crash" in res.errors[0]
+    # each attempt saw its 1-based index in MXTPU_RESTART_ATTEMPT
+    assert [(a, env) for a, _, env in attempts_seen] == [(1, "1"), (2, "2")]
+    assert attempts_seen[0][1] is None
+    assert "injected crash" in attempts_seen[1][1]
+    stats = profiler.get_resilience_stats()
+    assert stats["restarts"] == 1
+    assert stats["restart_latency_ms_last"] > 0
+
+
+def test_supervise_inline_gives_up_after_budget():
+    def fit(ctx):
+        raise RuntimeError("boom")
+
+    with pytest.raises(GiveUpError) as ei:
+        supervise(fit, max_restarts=1, restart_backoff_s=0.01)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+
+
+def test_supervise_inline_interrupt_is_not_restartable():
+    def fit(ctx):
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        supervise(fit, restart_backoff_s=0.01)
+
+
+def test_supervise_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        supervise(lambda ctx: None, mode="thread")
+
+
+def test_restart_context_resume_source(tmp_path):
+    ctx = supervisor.RestartContext(attempt=1, directory=None,
+                                    resume_step=None)
+    assert ctx.resume_from() is None and ctx.restarts == 0
+    ctx = supervisor.RestartContext(attempt=3, directory=str(tmp_path),
+                                    resume_step=4)
+    assert ctx.resume_from() == str(tmp_path) and ctx.restarts == 2
+    mgr = object()              # any non-None stands in for a manager
+    ctx = supervisor.RestartContext(attempt=2, directory=str(tmp_path),
+                                    resume_step=4, manager=mgr)
+    assert ctx.resume_from() is mgr
+
+
+def test_dp_schedule_and_xla_flags_helpers():
+    assert supervisor._dp_for_attempt(None, 1) is None
+    assert supervisor._dp_for_attempt([8, 4], 1) == 8
+    assert supervisor._dp_for_attempt([8, 4], 2) == 4
+    assert supervisor._dp_for_attempt([8, 4], 9) == 4      # clamps to last
+    assert supervisor._dp_for_attempt(lambda a: 2 * a, 3) == 6
+    flags = supervisor._xla_flags_with_device_count(
+        "--xla_foo=1 --xla_force_host_platform_device_count=8", 4)
+    assert flags == "--xla_foo=1 --xla_force_host_platform_device_count=4"
+
+
+def test_env_scope_sets_and_restores(monkeypatch):
+    monkeypatch.setenv("MXTPU_T_KEEP", "old")
+    monkeypatch.delenv("MXTPU_T_NEW", raising=False)
+    with supervisor._EnvScope({"MXTPU_T_KEEP": "new", "MXTPU_T_NEW": 7}):
+        assert os.environ["MXTPU_T_KEEP"] == "new"
+        assert os.environ["MXTPU_T_NEW"] == "7"
+    assert os.environ["MXTPU_T_KEEP"] == "old"
+    assert "MXTPU_T_NEW" not in os.environ
+
+
+def test_describe_exit_codes():
+    assert "watchdog" in supervisor._describe_exit(87)
+    assert "SIGKILL" in supervisor._describe_exit(-signal.SIGKILL)
+    assert supervisor._describe_exit(1) == "exit 1"
+
+
+# ---------------------------------------------------------------------------
+# resilience stats surface
+# ---------------------------------------------------------------------------
+
+
+def test_resilience_stats_shape_and_reset():
+    stats = profiler.get_resilience_stats()
+    assert set(stats) == {"faults_injected", "retries", "retries_exhausted",
+                          "escalations", "watchdog_stalls", "emergency_saves",
+                          "restarts", "steps_lost",
+                          "restart_latency_ms_total",
+                          "restart_latency_ms_last"}
+    assert all(v == 0 for v in stats.values())
+    profiler.record_resilience("retries")
+    profiler.record_resilience("restart_latency_ms_last", 5.0)
+    profiler.record_resilience("restart_latency_ms_last", 7.0)  # assign, not +=
+    stats = profiler.get_resilience_stats()
+    assert stats["retries"] == 1
+    assert stats["restart_latency_ms_last"] == 7.0
+    profiler.reset_resilience_stats()
+    assert profiler.get_resilience_stats()["retries"] == 0
+
+
+def test_profiler_dumps_includes_resilience_block():
+    import json
+    profiler.record_resilience("restarts")
+    doc = json.loads(profiler.dumps())
+    assert doc["resilience"]["restarts"] == 1
+
+
+def test_retry_emits_trace_spans():
+    from mxtpu.observability import export, tracer
+    was_on = tracer.enabled()
+    tracer.start()
+    try:
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionError("reset")
+            return 1
+
+        retry_transient(flaky, base_backoff_s=0.001)
+        names = {e.get("name") for e in export.collect_events()}
+    finally:
+        if not was_on:
+            tracer.stop()
+            tracer.reset()
+    assert "resilience/retry" in names
+
+
+# ---------------------------------------------------------------------------
+# satellite: dist.is_initialized state/predicate sync
+# ---------------------------------------------------------------------------
+
+
+def test_dist_is_initialized_syncs_flag_state(monkeypatch):
+    import mxtpu.dist as dist
+    monkeypatch.setattr(dist, "_initialized", False)
+    assert dist.is_initialized() is False      # single-process: both false
+    # an externally-connected pod (jax.distributed holds a live client) must
+    # sync the module flag, so a later initialize() early-returns instead of
+    # re-connecting; the predicate reads that client state directly — NOT
+    # jax.process_count(), which would initialize the XLA backend and
+    # thereby forbid a first jax.distributed.initialize
+    monkeypatch.setattr(dist, "_pod_connected", lambda: True)
+    assert dist.is_initialized() is True
+    assert dist._initialized is True
+    called = []
+    monkeypatch.setattr(dist.jax.distributed, "initialize",
+                        lambda **kw: called.append(kw))
+    dist.initialize("127.0.0.1:9", 4, 0)       # no late-init crash
+    assert called == []
+
+
+def test_dist_initialize_retries_transient_bringup(monkeypatch):
+    import mxtpu.dist as dist
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setenv("MXTPU_RETRY_BACKOFF_S", "0.001")
+    calls = {"n": 0}
+
+    def flaky_init(**kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("UNAVAILABLE: coordinator not listening")
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", flaky_init)
+    dist.initialize("127.0.0.1:9", 2, 0)
+    assert calls["n"] == 2 and dist._initialized is True
+    assert profiler.get_resilience_stats()["retries"] == 1
+    monkeypatch.setattr(dist, "_initialized", False)
