@@ -962,7 +962,57 @@ def bench_comm():
     for mb, ms, alg, bus in rows:
         log(f"[comm] {mb:.0f}MB: {ms:.2f} ms/iter, algbw {alg:.2f} GB/s "
             f"({out['tier']})")
+    out["all_to_all_probe"] = _all_to_all_probe()
     return out
+
+
+def _all_to_all_probe(mb: float = 4.0, iters: int = 6):
+    """Point timing for the MULTICHIP all_to_all anomaly: the SAME logical
+    shard-ownership transpose measured two ways on the same mesh — (a) the
+    ``shard_map``+``lax.all_to_all`` lowering behind
+    ``parallel.collectives.all_to_all_array`` (what Ulysses/MoE dispatch
+    use), and (b) a bare ``jax.jit`` resharding (identity with the output
+    sharding), where the partitioner itself picks the collective. A large
+    ratio between the two legs localizes the anomaly to the lowering rather
+    than the wire."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxtpu.parallel import collectives
+    from mxtpu.parallel.mesh import data_parallel_mesh
+
+    mesh = data_parallel_mesh()
+    n = mesh.devices.size
+    if n == 1:
+        return {"skipped": "single device"}
+    ax = mesh.axis_names[0]
+    rows = max(n, int(mb * 1e6 / 4 // (n * 128)) * n)
+    x = jax.device_put(
+        jnp.arange(rows * n * 128, dtype=jnp.float32).reshape(rows, n * 128),
+        NamedSharding(mesh, P(ax, None)))
+    nbytes = x.size * 4
+
+    def timed(fn):
+        fn(x).block_until_ready()                   # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(x)
+        r.block_until_ready()
+        return 1e3 * (time.perf_counter() - t0) / iters
+
+    shard_map_ms = timed(lambda v: collectives.all_to_all_array(
+        v, mesh, split_axis=1, concat_axis=0))
+    resharded = NamedSharding(mesh, P(None, ax))
+    jit_reshard = jax.jit(lambda v: v, out_shardings=resharded)
+    jit_ms = timed(jit_reshard)
+    probe = {"bytes": int(nbytes),
+             "shard_map_ms": round(shard_map_ms, 3),
+             "jit_reshard_ms": round(jit_ms, 3),
+             "ratio": round(shard_map_ms / max(jit_ms, 1e-9), 2)}
+    log(f"[comm] all_to_all probe ({nbytes/1e6:.1f} MB): shard_map "
+        f"{shard_map_ms:.2f} ms vs jit-reshard {jit_ms:.2f} ms "
+        f"(ratio {probe['ratio']}x)")
+    return probe
 
 
 def _lenet_module(batch: int, setup: bool = True):
@@ -1187,6 +1237,89 @@ def bench_zero_dp(steps: int = 16, batch: int = 64, hidden: int = 512):
     return out
 
 
+def bench_fsdp(steps: int = 12, batch: int = 64, hidden: int = 512):
+    """ZeRO stage ladder (MXTPU_ZERO_STAGE=1|2|3) through the SAME
+    DataParallelTrainer and model: step time, per-step gradient comm bytes,
+    and the headline — per-device resident bytes for params/grads/optimizer
+    slots from ``profiler.get_memory_stats()``. Stage 3 (FSDP) holds params
+    1/N on the fsdp axis with JIT per-layer all-gathers; the scoreboard
+    asserts the stage-3 param+slot residency shrink and that the final loss
+    stays bit-identical across stages (dim-0-only fsdp sharding keeps the
+    reduction order fixed)."""
+    from mxtpu import nd, optimizer as opt_mod, profiler
+    from mxtpu.gluon import nn
+    from mxtpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxtpu.parallel import DataParallelTrainer
+    from mxtpu.parallel.mesh import data_parallel_mesh
+
+    import mxtpu as mx
+
+    mesh = data_parallel_mesh()
+    n_dev = mesh.devices.size
+    rs = np.random.RandomState(0)
+    X = rs.randn(batch, hidden // 2).astype(np.float32)
+    y = rs.randint(0, 16, batch).astype(np.float32)
+
+    def leg(stage: int) -> dict:
+        prev = os.environ.get("MXTPU_ZERO_STAGE")
+        os.environ["MXTPU_ZERO_STAGE"] = str(stage)
+        try:
+            mx.rng.seed(0)
+            net = nn.HybridSequential()
+            net.add(nn.Dense(hidden, activation="relu",
+                             in_units=hidden // 2),
+                    nn.Dense(hidden, activation="relu", in_units=hidden),
+                    nn.Dense(16, in_units=hidden))
+            net.initialize(init=mx.initializer.Xavier())
+            dpt = DataParallelTrainer(
+                net, SoftmaxCrossEntropyLoss(),
+                opt_mod.SGD(learning_rate=0.05, momentum=0.9), mesh,
+                zero=True)
+            loss = dpt.step_async(nd.array(X), nd.array(y))
+            l0 = float(loss.data)                   # compile + first step
+            profiler.reset_comm_stats()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = dpt.step_async(nd.array(X), nd.array(y))
+            l1 = float(loss.data)                   # one readback syncs
+            dt = time.perf_counter() - t0
+            c = profiler.get_comm_stats()
+            m = profiler.get_memory_stats()
+            comm_per_step = (c["bytes_reduced"] + c["bytes_gathered"]
+                             + c["allreduce_bytes"]) / max(c["steps"], 1)
+            return {
+                "step_ms": round(1e3 * dt / steps, 3),
+                "comm_bytes_per_step": int(comm_per_step),
+                "param_bytes_per_device": m["param_bytes_per_device"],
+                "grad_bytes_per_device": m["grad_bytes_per_device"],
+                "slot_bytes_per_device": m["slot_bytes_per_device"],
+                "loss_start": l0, "loss_end": l1,
+            }
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_ZERO_STAGE", None)
+            else:
+                os.environ["MXTPU_ZERO_STAGE"] = prev
+
+    legs = {s: leg(s) for s in (1, 2, 3)}
+    ps1 = (legs[1]["param_bytes_per_device"]
+           + legs[1]["slot_bytes_per_device"])
+    ps3 = (legs[3]["param_bytes_per_device"]
+           + legs[3]["slot_bytes_per_device"])
+    out = {"dp": n_dev,
+           "stage1": legs[1], "stage2": legs[2], "stage3": legs[3],
+           "param_slot_shrink": round(ps1 / max(ps3, 1), 2),
+           "loss_bit_parity": (legs[1]["loss_end"] == legs[2]["loss_end"]
+                               == legs[3]["loss_end"])}
+    log(f"[fsdp] dp={n_dev}: "
+        + " | ".join(f"stage{s} {legs[s]['step_ms']} ms/step, "
+                     f"{(legs[s]['param_bytes_per_device'] + legs[s]['slot_bytes_per_device'])/1e3:.1f} kB "
+                     f"param+slot/dev" for s in (1, 2, 3))
+        + f" -> shrink {out['param_slot_shrink']}x, "
+        f"loss bit-parity={out['loss_bit_parity']}")
+    return out
+
+
 def bench_trace(steps: Optional[int] = None, batch: int = 32):
     """Unified-tracing scenario: arms the span recorder over a fused-step
     loop fed by the DeviceFeed producer plus one async checkpoint save, dumps
@@ -1316,9 +1449,13 @@ def apply_ratchet(doc: dict, harness: str):
             else doc.get("mfu_stats") or {}
         mfu_val = mfu_field if isinstance(mfu_field, (int, float)) \
             else block.get("mfu")
+        fsdp_block = doc.get("fsdp")
+        fsdp_shrink = fsdp_block.get("param_slot_shrink") \
+            if isinstance(fsdp_block, dict) else None
         metrics = {}
         for key, val in (("img_s", doc.get("value")), ("mfu", mfu_val),
-                         ("steps_per_sec", block.get("steps_per_sec"))):
+                         ("steps_per_sec", block.get("steps_per_sec")),
+                         ("fsdp_param_slot_shrink", fsdp_shrink)):
             if isinstance(val, (int, float)) and val > 0:
                 metrics[key] = val
         path = _ratchet_path()
@@ -1673,6 +1810,8 @@ def bench_cpu_fallback():
                    steps=8 if smoke else 48)
     zdp = run_leg("zero_dp", bench_zero_dp, steps=4 if smoke else 16,
                   hidden=128 if smoke else 512)
+    fsdp = run_leg("fsdp", bench_fsdp, steps=4 if smoke else 12,
+                   hidden=128 if smoke else 512)
     resil = run_leg("resilience", bench_resilience, smoke=smoke)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer, smoke=smoke) \
@@ -1694,6 +1833,7 @@ def bench_cpu_fallback():
         "checkpoint": ckpt,
         "input_pipeline": pipe,
         "zero_dp": zdp,
+        "fsdp": fsdp,
         "resilience": resil,
         "trace": trace,
         "compile_caches": caches,
@@ -1770,6 +1910,7 @@ def main():
     ckpt = run_leg("checkpoint", bench_checkpoint)
     feed_pipe = run_leg("input_pipeline", bench_input_pipeline)
     zdp = run_leg("zero_dp", bench_zero_dp)
+    fsdp = run_leg("fsdp", bench_fsdp)
     resil = run_leg("resilience", bench_resilience)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer) \
@@ -1806,6 +1947,7 @@ def main():
         "checkpoint": ckpt,
         "input_pipeline": feed_pipe,
         "zero_dp": zdp,
+        "fsdp": fsdp,
         "resilience": resil,
         "trace": trace,
         "compile_caches": _compile_caches(),

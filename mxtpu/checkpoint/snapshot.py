@@ -219,15 +219,37 @@ def _needs_mesh(snapshot: TrainingSnapshot) -> bool:
     return bool(snapshot.meta.get("shardings"))
 
 
+def _filter_spec_for_mesh(spec: list, mesh) -> list:
+    """Drop spec entries naming axes the current mesh doesn't have — an
+    fsdp8 snapshot restored onto a dp-only (or narrower) mesh falls back to
+    replicated on those dims instead of raising. Saved arrays are global
+    (``_to_host`` gathers), so re-placement with fewer/renamed axes is just
+    a different slicing of the same full array."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = [a for a in entry if a in names]
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return [keep(e) for e in spec]
+
+
 def restored_array(snapshot: TrainingSnapshot, key: str, mesh=None):
     """One array back on device, re-placed with its saved sharding spec
-    (via ``parallel.data_parallel._place``) when one was recorded."""
+    (via ``parallel.data_parallel._place``) when one was recorded. Spec
+    entries naming mesh axes that no longer exist (elastic restarts can
+    shrink or rename the fsdp axis) degrade to replicated on that dim."""
     import jax.numpy as jnp
     raw = snapshot.arrays[key]
     spec = snapshot.meta.get("shardings", {}).get(key)
     if spec is not None and mesh is not None:
         from jax.sharding import NamedSharding
         from ..parallel.data_parallel import _place
+        spec = _filter_spec_for_mesh(spec, mesh)
         return _place(raw, NamedSharding(mesh, _spec_to_partition(spec)))
     return jnp.asarray(raw)
 

@@ -68,11 +68,14 @@ class Trainer:
         self._kv_initialized = True
 
     def zero_requested(self) -> bool:
-        """True when this trainer's kvstore type selects the ZeRO-1 sharded
+        """True when this trainer's kvstore type selects the ZeRO sharded
         gradient/update path (the fused step's dataflow: bucketed
         reduce-scatter → 1/N-sharded optimizer slots → all-gather;
-        parallel/zero.py). The reference's ``device``/``dist_sync`` types map
-        here — exactly the types whose KVStore sharded state across
+        parallel/zero.py). The stage is a separate knob —
+        ``MXTPU_ZERO_STAGE=1|2|3`` (parallel/fsdp.py) escalates from sharded
+        slots (1) to reduce-scattered grad accumulators (2) to 1/N-resident
+        fsdp-sharded parameters (3). The reference's ``device``/``dist_sync``
+        types map here — exactly the types whose KVStore sharded state across
         devices/servers. ``local`` kvstores, an explicit
         ``update_on_kvstore=True`` (server-side updates), ``MXTPU_ZERO=0``,
         and non-elementwise optimizers all keep the replicated-psum path."""

@@ -148,8 +148,10 @@ class FeedForward:
         ``profiler.set_state('run')``) every epoch's fused steps, feed
         transfers/stalls, and checkpoint writes land as spans in
         ``profiler.dump()``'s chrome-trace JSON, and the per-epoch log
-        carries steps/s, p50/p99 step latency, and MFU
-        (``profiler.get_mfu_stats()``)."""
+        carries steps/s, p50/p99 step latency, MFU
+        (``profiler.get_mfu_stats()``), and — under a sharded kvstore —
+        the ZeRO stage's per-device param/grad/slot residency
+        (``profiler.get_memory_stats()``)."""
         assert self.num_epoch is not None, "num_epoch required"
         data = self._init_iter(X, y, is_train=True)
         if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:

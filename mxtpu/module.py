@@ -311,16 +311,36 @@ class BaseModule:
                 zsteps = c["zero_steps"] - comm0["zero_steps"]
                 if zsteps:
                     self.logger.info(
-                        "Epoch[%d] Comm (ZeRO-1, dp=%d): %.2f MB reduce-"
+                        "Epoch[%d] Comm (ZeRO-%d, dp=%d): %.2f MB reduce-"
                         "scatter + %.2f MB all-gather per step over %d "
                         "bucket(s); %.2f MB optimizer shard per device",
-                        epoch, c["dp"],
+                        epoch,
+                        max(1, profiler.get_memory_stats()["stage"]),
+                        c["dp"],
                         (c["bytes_reduced"] - comm0["bytes_reduced"])
                         / max(zsteps, 1) / 1e6,
                         (c["bytes_gathered"] - comm0["bytes_gathered"])
                         / max(zsteps, 1) / 1e6,
                         c["bucket_count"],
                         c["shard_bytes_per_device"] / 1e6)
+                m = profiler.get_memory_stats()
+                if m["param_bytes_per_device"] or m["slot_bytes_per_device"]:
+                    repl = (m["replicated_param_bytes"]
+                            + m["replicated_grad_bytes"]
+                            + m["replicated_slot_bytes"])
+                    dev = (m["param_bytes_per_device"]
+                           + m["grad_bytes_per_device"]
+                           + m["slot_bytes_per_device"])
+                    self.logger.info(
+                        "Epoch[%d] Memory (ZeRO-%d, data=%d fsdp=%d): "
+                        "%.2f MB/device (params %.2f + grads %.2f + slots "
+                        "%.2f) vs %.2f MB replicated (%.1fx)",
+                        epoch, m["stage"], m["data_degree"],
+                        m["fsdp_degree"], dev / 1e6,
+                        m["param_bytes_per_device"] / 1e6,
+                        m["grad_bytes_per_device"] / 1e6,
+                        m["slot_bytes_per_device"] / 1e6,
+                        repl / 1e6, repl / max(dev, 1))
             if san0 is not None:
                 s = profiler.get_sanitizer_stats()
                 self.logger.info(

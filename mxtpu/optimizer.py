@@ -33,9 +33,11 @@ def create(name, **kwargs) -> "Optimizer":
 
 
 class Optimizer:
-    # ZeRO-1 eligibility (parallel/zero.py): True when the update math is
+    # ZeRO eligibility (parallel/zero.py): True when the update math is
     # purely elementwise, so concatenating params into flat buckets and
-    # updating each device's shard is exact. Norm-coupled (LBSGD) or
+    # updating each device's shard is exact — this also licenses stage 2/3
+    # (parallel/fsdp.py), where the same kernel runs on reduce-scattered
+    # grad shards and fsdp-sharded params/slots. Norm-coupled (LBSGD) or
     # noise-injecting (SGLD) optimizers must opt out.
     elementwise = True
 

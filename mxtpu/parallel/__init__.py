@@ -16,11 +16,14 @@ from .collectives import (all_gather, all_to_all, all_to_all_array,
                           process_barrier, psum, reduce_scatter,
                           reduce_scatter_array)
 from .data_parallel import DataParallelTrainer, place, replicate, shard_batch
-from .mesh import (Mesh, NamedSharding, P, data_parallel_mesh, dp_axis_name,
-                   dp_size, force_virtual_cpu_devices, get_default_mesh,
-                   make_mesh, set_default_mesh)
+from .mesh import (Mesh, NamedSharding, P, data_axis_names,
+                   data_parallel_mesh, data_size, dp_axis_name, dp_size,
+                   force_virtual_cpu_devices, fsdp_axis_name, fsdp_size,
+                   get_default_mesh, make_mesh, set_default_mesh)
 from . import zero
 from .zero import ZeroLayout, zero_bucket_bytes, zero_enabled
+from . import fsdp
+from .fsdp import compose_spec, fsdp_param_specs, zero_stage
 from . import ring_attention
 from .ring_attention import ring_attention_inner, ring_self_attention
 from . import ulysses

@@ -22,8 +22,10 @@ from .. import autograd
 from .. import ndarray as nd_mod
 from ..ndarray.ndarray import NDArray
 from ..step_cache import build_update_all, cache_stats
+from . import fsdp as fsdp_mod
 from . import zero as zero_mod
-from .mesh import Mesh, dp_size, get_default_mesh
+from .mesh import (Mesh, data_axis_names, data_size, dp_size,
+                   fsdp_axis_name, fsdp_size, get_default_mesh)
 
 __all__ = ["shard_batch", "replicate", "place", "DataParallelTrainer"]
 
@@ -63,7 +65,8 @@ def shard_batch(array, mesh: Optional[Mesh] = None, axis: int = 0) -> NDArray:
     step path never double-``device_put``s resident inputs."""
     mesh = mesh or get_default_mesh()
     spec = [None] * (array.ndim if hasattr(array, "ndim") else len(array.shape))
-    spec[axis] = mesh.axis_names[0]
+    axes = data_axis_names(mesh)
+    spec[axis] = axes if len(axes) > 1 else axes[0]
     raw = array.data if isinstance(array, NDArray) else jnp.asarray(array)
     target = NamedSharding(mesh, P(*spec))
     if isinstance(raw, jax.Array) and getattr(raw, "committed", False) \
@@ -114,14 +117,16 @@ class DataParallelTrainer:
         pressure; k=4 keeps the b128 working set). Micro-batches take every
         k-th row so each stays evenly dp-sharded.
 
-        ``zero`` selects the ZeRO-1 gradient/update path (default: the
-        ``MXTPU_ZERO`` env, on unless ``=0``): gradients are bucketed and
-        reduce-scattered over dp, optimizer slots live 1/N-sharded, updated
-        params are all-gathered back (parallel/zero.py). Replicated params
-        only; tensor-parallel-sharded params keep the per-param update.
-        ``compression_params`` (KVStore ``set_gradient_compression`` dict:
-        type 2bit|fp16|bf16) lowers the bucket payload with an error-feedback
-        residual."""
+        ``zero`` selects the ZeRO gradient/update path (default: the
+        ``MXTPU_ZERO`` env, on unless ``=0``), staged by ``MXTPU_ZERO_STAGE``:
+        gradients resolve per-param as reduce-scatters over the named data
+        axes into packed buckets, optimizer slots live 1/N-sharded, updated
+        params are all-gathered back (parallel/zero.py). Works on any mesh —
+        tensor-parallel-sharded params keep the per-param update; at stage 3
+        shardable params are instead RESIDENT 1/N on the ``fsdp`` axis
+        (parallel/fsdp.py). ``compression_params`` (KVStore
+        ``set_gradient_compression`` dict: type 2bit|fp16|bf16) lowers the
+        bucket payload with an error-feedback residual."""
         self.block = block
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -129,14 +134,9 @@ class DataParallelTrainer:
         self.param_shardings = param_shardings
         self.remat = remat
         self.micro_batches = int(micro_batches)
-        # ZeRO engages on SINGLE-axis (pure-dp) meshes only: this jax
-        # version's partitioner mis-reduces concat-of-partial-sum gradients
-        # when the mesh has extra axes (verified: 2x over-reduction on a
-        # (dp, tp) mesh in every constraint formulation) — multi-axis meshes
-        # keep the replicated-psum update
         self.zero = (zero_mod.zero_enabled() if zero is None else bool(zero)) \
-            and zero_mod.supports_zero(optimizer) \
-            and len(self.mesh.axis_names) == 1
+            and zero_mod.supports_zero(optimizer)
+        self.stage = fsdp_mod.zero_stage() if self.zero else 0
         if compression_params is not None:
             zero_mod.comm_dtype_of(compression_params)  # validate the kind
         self._compression_params = compression_params
@@ -169,24 +169,35 @@ class DataParallelTrainer:
                                if p._data is not None and p.grad_req != "null"]
         self._aux_handles = [p for n, p in named
                              if p._data is not None and p.grad_req == "null"]
-        # place across the mesh: replicated unless a tp sharding was requested
+        # place across the mesh: replicated unless a tp sharding was
+        # requested; at stage 3 the fsdp axis composes into every spec with
+        # an eligible (free, divisible) dimension — those params are RESIDENT
+        # 1/N and XLA all-gathers them just-in-time per layer
         self._param_sh = [NamedSharding(self.mesh, self._spec_for(n))
                           for n in self._param_names]
+        if self.zero and self.stage >= 3:
+            composed = fsdp_mod.fsdp_param_specs(
+                [p.data().shape for p in self._param_handles],
+                [sh.spec for sh in self._param_sh], self.mesh)
+            self._param_sh = [
+                NamedSharding(self.mesh, c) if c is not None else sh
+                for c, sh in zip(composed, self._param_sh)]
         for p, sh in zip(self._param_handles, self._param_sh):
             p._data._set_data(_place(p.data().data, sh))
         for p in self._aux_handles:
             p._data._set_data(_place(p.data().data, NamedSharding(self.mesh, P())))
         repl = NamedSharding(self.mesh, P())
         if self.zero:
-            # ZeRO-1: replicated params bucket into dp-sharded flat slots;
-            # tensor-parallel-sharded params keep the per-param update below
+            # replicated params bucket into data-sharded flat slots; tp- and
+            # fsdp-sharded params keep the per-param update below (their
+            # slots follow the param's sharding, so fsdp slots are 1/N too)
             eligible = [sh.spec == P() for sh in self._param_sh]
             raws = [p.data().data for p in self._param_handles]
             self._zero_layout = zero_mod.ZeroLayout(
                 raws,
                 [getattr(p, "lr_mult", 1.0) for p in self._param_handles],
                 [getattr(p, "wd_mult", 1.0) for p in self._param_handles],
-                dp_size(self.mesh), eligible=eligible)
+                data_size(self.mesh), eligible=eligible)
             self._zero_states, self._zero_residuals = zero_mod.init_zero_states(
                 self.optimizer, self._zero_layout, raws, self.mesh,
                 with_residual=self._compression_params is not None)
@@ -208,6 +219,20 @@ class DataParallelTrainer:
             sh if getattr(s, "shape", None) == p.data().shape else repl
             for s in st)
             for p, sh, st in zip(self._param_handles, self._param_sh, self._states)]
+        self._record_memory()
+
+    def _record_memory(self):
+        """Per-device param/grad/slot byte accounting (profiler
+        ``get_memory_stats``), from the actual placed shardings."""
+        params = [p.data().data for p in self._param_handles]
+        slots = [s for st in list(self._states) + list(self._zero_states)
+                 for s in (st or ()) if hasattr(s, "dtype")]
+        slots += [r for r in self._zero_residuals if r is not None]
+        grad_bytes = sum(
+            int(np.prod(p.shape)) * np.dtype(str(p.dtype)).itemsize
+            for p in params)
+        fsdp_mod.measure_memory(self.stage, self.mesh, params, slots,
+                                grad_bytes)
 
     def _build(self):
         block, loss_fn, opt = self.block, self.loss_fn, self.optimizer
@@ -230,6 +255,16 @@ class DataParallelTrainer:
             opt, self._zero_layout, self.mesh,
             comm_dtype=zero_mod.comm_dtype_of(self._compression_params),
             compression_params=self._compression_params) if self.zero else None
+        # ZeRO-2: micro-batch accumulation holds packed 1/N bucket SHARDS —
+        # each micro-gradient reduce-scatters into its shard inside the scan,
+        # so no replicated gradient buffer ever materializes for bucketed
+        # params
+        stage2_acc = (zero_update is not None and self.stage >= 2
+                      and self.micro_batches > 1
+                      and self._zero_layout.buckets)
+        pack_grads = zero_mod.build_grad_pack(self._zero_layout, self.mesh) \
+            if stage2_acc else None
+        zshard = self._zero_layout.shard_spec(self.mesh) if self.zero else None
 
         def step(params, auxs, states, zstates, zres, x, y, lr, wd, rescale,
                  clip, key, t):
@@ -266,24 +301,57 @@ class DataParallelTrainer:
                     ys = jnp.swapaxes(
                         y.reshape((-1, k) + y.shape[1:]), 0, 1)
 
-                    def body(carry, xy):
-                        gacc, lacc, auxs_c = carry
-                        xb, yb = xy
-                        (lv, new_aux), g = jax.value_and_grad(
-                            loss_of, has_aux=True)(list(params), auxs_c,
-                                                   xb, yb)
-                        # accumulate in f32: summing k similar-magnitude bf16
-                        # grads in bf16 would compound rounding vs the k=1 step
-                        gacc = [a + gi.astype(jnp.float32)
-                                for a, gi in zip(gacc, g)]
-                        return (gacc, lacc + lv, new_aux), None
+                    if pack_grads is not None:
+                        # ZeRO-2 carry: packed bucket shards (1/N resident)
+                        # plus full f32 grads ONLY for the passthrough set
+                        def body(carry, xy):
+                            pacc, gpt, lacc, auxs_c = carry
+                            xb, yb = xy
+                            (lv, new_aux), g = jax.value_and_grad(
+                                loss_of, has_aux=True)(list(params), auxs_c,
+                                                       xb, yb)
+                            pk = pack_grads(g)
+                            pacc = [a + q for a, q in zip(pacc, pk)]
+                            gpt = [a + g[i].astype(jnp.float32)
+                                   for a, i in zip(gpt, pt)]
+                            return (pacc, gpt, lacc + lv, new_aux), None
 
-                    init = ([jnp.zeros(p.shape, jnp.float32) for p in params],
-                            jnp.zeros((), jnp.float32), list(auxs))
-                    (gsum, lsum, new_auxs), _ = jax.lax.scan(
-                        body, init, (xs, ys))
-                    grads = [g / k for g in gsum]   # f32; caller casts per param
-                    loss_val = lsum / k
+                        init = ([jax.lax.with_sharding_constraint(
+                                    jnp.zeros((b.padded,), jnp.float32),
+                                    zshard)
+                                 for b in self._zero_layout.buckets],
+                                [jnp.zeros(params[i].shape, jnp.float32)
+                                 for i in pt],
+                                jnp.zeros((), jnp.float32), list(auxs))
+                        (psum_b, gpt_sum, lsum, new_auxs), _ = jax.lax.scan(
+                            body, init, (xs, ys))
+                        packed = [p / k for p in psum_b]
+                        grads = [None] * len(params)
+                        for j, i in enumerate(pt):
+                            grads[i] = gpt_sum[j] / k
+                        loss_val = lsum / k
+                    else:
+                        def body(carry, xy):
+                            gacc, lacc, auxs_c = carry
+                            xb, yb = xy
+                            (lv, new_aux), g = jax.value_and_grad(
+                                loss_of, has_aux=True)(list(params), auxs_c,
+                                                       xb, yb)
+                            # accumulate in f32: summing k similar-magnitude
+                            # bf16 grads in bf16 would compound rounding vs
+                            # the k=1 step
+                            gacc = [a + gi.astype(jnp.float32)
+                                    for a, gi in zip(gacc, g)]
+                            return (gacc, lacc + lv, new_aux), None
+
+                        init = ([jnp.zeros(p.shape, jnp.float32)
+                                 for p in params],
+                                jnp.zeros((), jnp.float32), list(auxs))
+                        (gsum, lsum, new_auxs), _ = jax.lax.scan(
+                            body, init, (xs, ys))
+                        grads = [g / k for g in gsum]  # f32; cast per param
+                        packed = None
+                        loss_val = lsum / k
                 else:
                     def loss_of(ps):
                         f = (jax.checkpoint(loss_on) if self.remat
@@ -292,10 +360,11 @@ class DataParallelTrainer:
 
                     (loss_val, new_auxs), grads = jax.value_and_grad(
                         loss_of, has_aux=True)(list(params))
+                    packed = None
                 if zero_update is not None:
                     new_params, new_zstates, new_zres = zero_update(
                         list(params), list(grads), zstates, zres,
-                        lr, wd, rescale, clip, t)
+                        lr, wd, rescale, clip, t, packed_grads=packed)
                 else:
                     new_params = list(params)
                     new_zstates, new_zres = zstates, zres
@@ -317,7 +386,9 @@ class DataParallelTrainer:
                 rng_mod.pop_trace_provider()
 
         repl = NamedSharding(self.mesh, P())
-        batch = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        axes = data_axis_names(self.mesh)
+        batch = NamedSharding(self.mesh,
+                              P(axes if len(axes) > 1 else axes[0]))
         zstate_sh = getattr(self, "_zero_state_sh", []) if self.zero else []
         zres_sh = [self._zero_layout.shard_spec(self.mesh)
                    if r is not None else None
@@ -395,9 +466,28 @@ class DataParallelTrainer:
         path, the full-allreduce equivalent on the replicated path — so the
         two paths are directly comparable."""
         from .. import profiler
-        n = dp_size(self.mesh)
+        n = data_size(self.mesh)
         if self.zero and self._zero_layout is not None:
             c = self._zero_layout.step_comm()
+            if self.stage >= 2 and self.micro_batches > 1:
+                # ZeRO-2 reduce-scatters each micro-gradient into the shard
+                # accumulator: k reduce legs per step instead of one
+                c["bytes_reduced"] *= self.micro_batches
+            if self.stage >= 3 and self._param_sh is not None:
+                # stage-3 params live 1/N: the compiler's JIT all-gathers
+                # (fwd + bwd) and grad reduce-scatter don't pass through the
+                # explicit bucket collectives, so account them analytically
+                # with the same per-device ring fractions step_comm() uses
+                axis = fsdp_axis_name(self.mesh)
+                nf = fsdp_size(self.mesh)
+                fsdp_bytes = sum(
+                    int(np.prod(p.data().shape))
+                    * np.dtype(str(p.data().dtype)).itemsize
+                    for p, sh in zip(self._param_handles, self._param_sh)
+                    if any(fsdp_mod._mentions(e, axis) for e in sh.spec))
+                frac = (nf - 1) / nf if nf > 1 else 0.0
+                c["bytes_gathered"] += int(2 * fsdp_bytes * frac)
+                c["bytes_reduced"] += int(fsdp_bytes * frac)
             profiler.record_comm_step(zero=True, allreduce_bytes=0, **c)
         else:
             frac = 2.0 * (n - 1) / n if n > 1 else 0.0

@@ -1,0 +1,150 @@
+"""FSDP / ZeRO-3 staging — full parameter sharding on a named ``fsdp`` axis.
+
+ZeRO stages (Rajbhandari et al., 2020) map onto mxtpu as:
+
+- **Stage 1** — optimizer slots live 1/N per device inside flat buckets
+  (``zero.ZeroLayout``); params and grads stay replicated.
+- **Stage 2** — gradients are additionally held reduce-scattered 1/N per
+  bucket: micro-batch accumulators allocate the packed bucket *shard*, never
+  the replicated grad, so accumulation memory also drops 1/N.
+- **Stage 3 / FSDP** — parameters are *resident* 1/N, each sharded on its
+  first eligible dimension over the ``fsdp`` mesh axis. The compiled step
+  takes sharded params in and XLA inserts the just-in-time per-layer
+  all-gathers in forward/backward (and reduce-scatters the grads back to the
+  shards), overlapping them against the matmuls — the GSPMD formulation of
+  FSDP. Optimizer slots follow the param's sharding, so state is 1/N without
+  bucketing for every fsdp-sharded param.
+
+The stage knob is ``MXTPU_ZERO_STAGE=1|2|3`` (default 1, bit-parity with
+PR 4 behavior). On meshes without an axis literally named ``fsdp`` the last
+data axis doubles as the parameter-shard axis, so a plain ``("dp",)`` mesh
+gives classic single-level FSDP at stage 3 and ``("dp", "fsdp", "tp")``
+gives HSDP composed with tensor parallelism.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import data_axis_names, data_size, fsdp_axis_name, fsdp_size
+
+__all__ = ["zero_stage", "compose_spec", "fsdp_param_specs",
+           "per_device_bytes", "replicated_bytes", "measure_memory"]
+
+
+def zero_stage() -> int:
+    """The active ZeRO stage from ``MXTPU_ZERO_STAGE`` (default 1, clamped
+    to [1, 3]). Read at trainer/executor construction so benchmarks can flip
+    it per scenario."""
+    try:
+        stage = int(os.environ.get("MXTPU_ZERO_STAGE", "1"))
+    except ValueError:
+        stage = 1
+    return max(1, min(3, stage))
+
+
+def _spec_entries(spec: Optional[P], ndim: int) -> List:
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (ndim - len(entries))
+    return entries[:ndim]
+
+
+def _mentions(entry, axis: str) -> bool:
+    if entry is None:
+        return False
+    if isinstance(entry, (tuple, list)):
+        return axis in entry
+    return entry == axis
+
+
+def compose_spec(shape: Sequence[int], base_spec: Optional[P],
+                 mesh: Mesh) -> Optional[P]:
+    """Insert the fsdp axis into ``base_spec`` (the param's tp layout) on
+    dimension 0 when it is unsharded and divisible by the fsdp degree — the
+    SpecLayout data/fsdp/tp composition. Returns the composed spec, or None
+    when dim 0 is ineligible (such params stay replicated and take the
+    bucketed stage-1 treatment instead).
+
+    Only dim 0 is considered on purpose: sharding a contraction dimension
+    makes XLA compute the forward matmul as per-device partial sums + psum,
+    which changes the floating-point reduction order and breaks bit-parity
+    with stages 1/2. Dim-0 (output-dim) sharding only moves where the
+    all-gather happens, never the arithmetic order."""
+    axis = fsdp_axis_name(mesh)
+    n = fsdp_size(mesh)
+    if n <= 1 or not shape:
+        return None
+    entries = _spec_entries(base_spec, len(shape))
+    if any(_mentions(e, axis) for e in entries):
+        return base_spec  # already fsdp-sharded
+    if entries[0] is None and shape[0] % n == 0 and shape[0] >= n:
+        entries[0] = axis
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+    return None
+
+
+def fsdp_param_specs(shapes: Sequence[Sequence[int]],
+                     base_specs: Sequence[Optional[P]],
+                     mesh: Mesh) -> List[Optional[P]]:
+    """Composed per-param specs for stage 3; None marks bucket-eligible
+    (replicated-resident) params."""
+    return [compose_spec(s, b, mesh) for s, b in zip(shapes, base_specs)]
+
+
+def per_device_bytes(arr) -> int:
+    """Resident bytes of one array on ONE device, honoring its sharding."""
+    size = int(np.prod(arr.shape)) if arr.shape else 1
+    itemsize = np.dtype(arr.dtype).itemsize
+    sh = getattr(arr, "sharding", None)
+    if sh is not None and hasattr(sh, "shard_shape"):
+        try:
+            shp = sh.shard_shape(tuple(arr.shape))
+            size = int(np.prod(shp)) if shp else 1
+        except Exception:
+            pass
+    return size * itemsize
+
+
+def replicated_bytes(arr) -> int:
+    size = int(np.prod(arr.shape)) if arr.shape else 1
+    return size * np.dtype(arr.dtype).itemsize
+
+
+def measure_memory(stage: int, mesh: Optional[Mesh], params: Sequence,
+                   slot_arrays: Sequence, grad_bytes_full: int,
+                   record: bool = True) -> dict:
+    """Per-device resident byte accounting for params/grads/slots, plus the
+    replicated-equivalent figures the shrink ratio is quoted against.
+
+    ``params``/``slot_arrays`` are jax arrays (placed, so their shardings are
+    the ground truth). Gradients are transient in the fused program; they are
+    accounted analytically: full size at stage 1, 1/N (data degree) at
+    stages 2/3 where they are held packed/reduce-scattered."""
+    n_data = data_size(mesh) if mesh is not None else 1
+    param_dev = sum(per_device_bytes(p) for p in params)
+    param_repl = sum(replicated_bytes(p) for p in params)
+    slot_dev = sum(per_device_bytes(s) for s in slot_arrays)
+    slot_repl = sum(replicated_bytes(s) for s in slot_arrays)
+    grad_dev = grad_bytes_full if stage < 2 else -(-grad_bytes_full // max(1, n_data))
+    stats = {
+        "stage": int(stage),
+        "data_degree": int(n_data),
+        "fsdp_degree": int(fsdp_size(mesh)) if mesh is not None else 1,
+        "param_bytes_per_device": int(param_dev),
+        "grad_bytes_per_device": int(grad_dev),
+        "slot_bytes_per_device": int(slot_dev),
+        "replicated_param_bytes": int(param_repl),
+        "replicated_grad_bytes": int(grad_bytes_full),
+        "replicated_slot_bytes": int(slot_repl),
+    }
+    if record:
+        from ..observability import metrics
+        metrics.record_memory_stats(**stats)
+    return stats

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["Mesh", "NamedSharding", "P", "force_virtual_cpu_devices",
            "make_mesh", "data_parallel_mesh", "dp_axis_name", "dp_size",
+           "data_axis_names", "data_size", "fsdp_axis_name", "fsdp_size",
            "get_default_mesh", "set_default_mesh"]
 
 _default_mesh: Optional[Mesh] = None
@@ -49,6 +50,36 @@ def dp_axis_name(mesh: Mesh) -> str:
 def dp_size(mesh: Mesh) -> int:
     """Degree of the data-parallel axis — the N in ZeRO's 1/N state shards."""
     return int(mesh.shape[mesh.axis_names[0]])
+
+
+def data_axis_names(mesh: Mesh) -> Tuple[str, ...]:
+    """The axes the BATCH shards over: every ``dp``/``fsdp`` axis present.
+
+    An HSDP mesh ``("dp", "fsdp", "tp")`` feeds batches sharded over
+    ``("dp", "fsdp")`` — replicas × shards both consume distinct data — while
+    ``tp`` sees the batch replicated. Meshes with neither conventional name
+    keep the first-axis-is-data convention (``dp_axis_name``)."""
+    named = tuple(a for a in mesh.axis_names if a in ("dp", "fsdp"))
+    return named or (mesh.axis_names[0],)
+
+
+def data_size(mesh: Mesh) -> int:
+    """Combined degree of the data axes — the N in ZeRO's 1/N shards."""
+    n = 1
+    for a in data_axis_names(mesh):
+        n *= int(mesh.shape[a])
+    return n
+
+
+def fsdp_axis_name(mesh: Mesh) -> str:
+    """The axis PARAMETERS shard over in ZeRO-3/FSDP: the ``fsdp`` axis when
+    the mesh names one, else the last data axis (pure-dp meshes double their
+    data axis as the parameter-shard axis — plain single-level FSDP)."""
+    return "fsdp" if "fsdp" in mesh.axis_names else data_axis_names(mesh)[-1]
+
+
+def fsdp_size(mesh: Mesh) -> int:
+    return int(mesh.shape[fsdp_axis_name(mesh)])
 
 
 def get_default_mesh() -> Mesh:

@@ -1,22 +1,34 @@
-"""ZeRO-1 sharded-optimizer data parallelism — the TPU-native re-imagining of the
+"""ZeRO sharded data parallelism — the TPU-native re-imagining of the
 reference's KVStore server sharding (SURVEY §1 layer 6, ``include/mxnet/kvstore.h``):
 ps-lite never holds the full optimizer state on one worker — keys are sharded across
 servers, the update runs on the shard owner, and workers pull back only what they
-need. Here the same ownership split is expressed in ONE fused XLA program:
+need. Here the same ownership split is expressed in ONE fused XLA program, staged
+per ZeRO (Rajbhandari et al., 2020) via ``MXTPU_ZERO_STAGE`` (see
+``parallel/fsdp.py``):
 
 * gradients are flattened into a small number of dtype-homogeneous **buckets**
-  (``MXTPU_ZERO_BUCKET_MB``, default 32), each padded to a multiple of the dp
-  degree;
-* every bucket is constrained to ``PartitionSpec(dp)`` right after the backward —
-  GSPMD converts the pending gradient reduction into a **reduce-scatter** (the
-  partial-sum → sharded-consumer optimization), so each device receives only its
-  1/N shard of the summed gradient (MULTICHIP_r05: reduce_scatter 64 MB = 464 ms
-  vs allreduce 1117 ms);
-* optimizer slots live ONLY as dp-sharded flat buckets (1/N of the state bytes per
-  device, ``NamedSharding`` so checkpoint capture/restore keeps working), and the
-  elementwise update runs on the shard;
-* the updated shard is constrained back to replicated — one **all-gather** per
-  bucket rebuilds the full parameters the next forward consumes.
+  (``MXTPU_ZERO_BUCKET_MB``, default 32), each param padded to a multiple of the
+  data degree N and packed into an N-interleaved flat layout (device d owns the
+  d-th chunk of every member param);
+* each per-param gradient is constrained to the data-axis sharding right after
+  the backward — GSPMD converts the pending per-axis reduction into a
+  **reduce-scatter** (the partial-sum → sharded-consumer optimization)
+  (MULTICHIP_r05: reduce_scatter 64 MB = 464 ms vs allreduce 1117 ms) — and the
+  owned shards are packed with a ``shard_map`` local concat. The per-param
+  constraint + explicit local pack is load-bearing: concatenating partial-sum
+  gradients BEFORE the constraint trips a partitioner mis-reduction on
+  multi-axis meshes (an extra reduction over the idle axis, verified on
+  (dp, tp)), which is why PR 4 had to fall back to replicated updates there.
+  Per-param resolution over named axes is exact on any mesh, so the fallback
+  is gone and ZeRO composes with tensor parallelism;
+* optimizer slots live ONLY as data-sharded flat buckets (1/N of the state
+  bytes per device, ``NamedSharding`` so checkpoint capture/restore keeps
+  working), and the elementwise update runs on the shard;
+* the updated packed shard is constrained back to replicated — one
+  **all-gather** per bucket — and de-interleaved with static slices into the
+  full parameters the next forward consumes. At stage 3 (FSDP) shardable
+  params never enter buckets at all: they stay resident 1/N on the ``fsdp``
+  axis and take the per-param sharded update (``parallel/fsdp.py``).
 
 Because everything happens inside the jitted step, XLA schedules the per-bucket
 collectives against the remaining backward/update compute (the reference's
@@ -24,13 +36,8 @@ push/pull priority-overlap trick becomes latency hiding for free) instead of
 serializing one monolithic all-reduce at the step boundary.
 
 Eligibility: the optimizer must be **elementwise** (``Optimizer.elementwise``) —
-bucket concatenation must not change the math (SGD/NAG/Adam/RMSProp/…); norm-based
+bucket packing must not change the math (SGD/NAG/Adam/RMSProp/…); norm-based
 (LBSGD) and noise-injecting (SGLD) optimizers fall back to the replicated path.
-The mesh must be SINGLE-axis (pure dp): on multi-axis meshes this jax version's
-partitioner mis-reduces concatenations of partial-sum gradients (an extra
-reduction over the idle axis — verified on a (dp, tp) mesh in every constraint
-formulation), so ``DataParallelTrainer``/``StepExecutor`` keep the replicated
-update there.
 """
 
 from __future__ import annotations
@@ -44,10 +51,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import Mesh
+from .mesh import Mesh, data_axis_names
 
 __all__ = ["zero_enabled", "zero_bucket_bytes", "supports_zero", "ZeroLayout",
-           "build_zero_update", "init_zero_states", "comm_dtype_of"]
+           "build_zero_update", "build_grad_pack", "init_zero_states",
+           "state_shardings", "comm_dtype_of"]
 
 
 def zero_enabled() -> bool:
@@ -94,14 +102,22 @@ def comm_dtype_of(compression_params: Optional[dict]):
 
 
 class ZeroBucket:
-    """One dtype/lr-mult/wd-mult-homogeneous gradient bucket."""
+    """One dtype/lr-mult/wd-mult-homogeneous gradient bucket.
 
-    __slots__ = ("indices", "sizes", "shapes", "dtype", "lr_mult", "wd_mult",
-                 "unpadded", "padded")
+    Packed layout: every member param is padded to ``psizes[k]`` (a multiple
+    of N) and the bucket is N-INTERLEAVED — viewing the flat bucket as
+    ``(N, padded // N)``, row d is the concat of every param's d-th chunk.
+    Device d therefore owns a contiguous slice of each param, the pack is a
+    shard-local concat (no cross-device data motion), and the layout degrades
+    to a plain concatenation at N = 1."""
+
+    __slots__ = ("indices", "sizes", "psizes", "shapes", "dtype", "lr_mult",
+                 "wd_mult", "unpadded", "padded")
 
     def __init__(self, dtype, lr_mult: float, wd_mult: float):
         self.indices: List[int] = []
         self.sizes: List[int] = []
+        self.psizes: List[int] = []
         self.shapes: List[tuple] = []
         self.dtype = dtype
         self.lr_mult = float(lr_mult)
@@ -115,17 +131,52 @@ class ZeroBucket:
 
     def describe(self) -> dict:
         return {"indices": list(self.indices), "sizes": list(self.sizes),
-                "dtype": str(np.dtype(self.dtype)), "unpadded": self.unpadded,
+                "psizes": list(self.psizes), "dtype": str(np.dtype(self.dtype)),
+                "unpadded": self.unpadded,
                 "lr_mult": self.lr_mult, "wd_mult": self.wd_mult}
+
+
+def _pack_flat_host(flats: Sequence[np.ndarray], psizes: Sequence[int],
+                    n: int) -> np.ndarray:
+    """Host-side interleave: pad each flat to its psize and stack the
+    per-device chunks column-wise → the packed global bucket."""
+    cols = []
+    for a, ps in zip(flats, psizes):
+        a = np.ravel(np.asarray(a))
+        flat = np.zeros((ps,), a.dtype)
+        flat[:a.shape[0]] = a
+        cols.append(flat.reshape(n, ps // n))
+    mat = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+    return np.ascontiguousarray(mat.reshape(-1))
+
+
+def _unpack_flat_host(packed: np.ndarray, sizes: Sequence[int],
+                      psizes: Sequence[int], n: int) -> List[np.ndarray]:
+    """Inverse of ``_pack_flat_host``: per-param unpadded flats."""
+    packed = np.ravel(np.asarray(packed))
+    mat = packed.reshape(n, packed.shape[0] // n)
+    outs, off = [], 0
+    for sz, ps in zip(sizes, psizes):
+        step = ps // n
+        outs.append(np.ascontiguousarray(
+            mat[:, off:off + step].reshape(-1)[:sz]))
+        off += step
+    return outs
 
 
 class ZeroLayout:
     """Deterministic bucket layout over a parameter list.
 
     Grouping (by dtype and per-param lr/wd multiplier, chunked at
-    ``bucket_bytes``) is independent of the dp degree — only the per-bucket
-    PADDING depends on N — so a checkpointed state restores onto a different
-    dp size by stripping the old pad and re-padding (``adopt_states``).
+    ``bucket_bytes``) is independent of the data degree — only the per-param
+    PADDING (and hence the interleave) depends on N — so a checkpointed state
+    restores onto a different degree by de-interleaving with the saved
+    N/psizes and re-packing with the current ones (``adopt_states``).
+
+    ``eligible`` masks params OUT of the buckets (``passthrough``): at
+    stages 1/2 that is the tensor-parallel params (their grads reduce over
+    the tp axis, not dp); at stage 3 it is additionally every fsdp-shardable
+    param, which gets the per-param resident-sharded update instead.
     """
 
     def __init__(self, params: Sequence, lr_mults: Sequence[float],
@@ -154,7 +205,8 @@ class ZeroLayout:
             b.shapes.append(tuple(w.shape))
             b.unpadded += n
         for b in self.buckets:
-            b.padded = -(-b.unpadded // self.dp) * self.dp
+            b.psizes = [-(-s // self.dp) * self.dp for s in b.sizes]
+            b.padded = sum(b.psizes)
 
     # -- identity ----------------------------------------------------------
     def fingerprint(self) -> tuple:
@@ -169,7 +221,9 @@ class ZeroLayout:
 
     def compatible_with(self, desc: dict) -> bool:
         """True when ``desc`` (a saved ``describe()``) has the same grouping —
-        dp may differ (padding is re-derived), bucket membership may not."""
+        dp may differ (the interleave is re-derived from the saved psizes),
+        bucket membership may not. Pre-packed-format checkpoints (no psizes
+        recorded) are incompatible: their flat layout cannot be de-interleaved."""
         if not desc:
             return False
         saved = desc.get("buckets", [])
@@ -178,6 +232,7 @@ class ZeroLayout:
         for s, b in zip(saved, self.buckets):
             if (s.get("indices") != list(b.indices)
                     or s.get("sizes") != list(b.sizes)
+                    or not s.get("psizes")
                     or np.dtype(s.get("dtype")) != b.dtype):
                 return False
         return True
@@ -211,13 +266,18 @@ class ZeroLayout:
         return total
 
     # -- state shard/unshard ----------------------------------------------
+    def data_spec(self, mesh: Mesh) -> P:
+        """1-D PartitionSpec over every data axis of ``mesh`` (dp×fsdp)."""
+        axes = data_axis_names(mesh)
+        return P(axes if len(axes) > 1 else axes[0])
+
     def shard_spec(self, mesh: Mesh):
-        # dp=1: P('dp') and P() are the same layout, but XLA normalizes
+        # dp=1: the data spec and P() are the same layout, but XLA normalizes
         # outputs to P() — use P() up front so the step signature (which
         # includes shardings) stays stable across steps (no retrace)
         if self.dp == 1:
             return NamedSharding(mesh, P())
-        return NamedSharding(mesh, P(mesh.axis_names[0]))
+        return NamedSharding(mesh, self.data_spec(mesh))
 
     def repl_spec(self, mesh: Mesh):
         return NamedSharding(mesh, P())
@@ -225,25 +285,32 @@ class ZeroLayout:
     def adopt_states(self, saved_arrays: Dict[str, np.ndarray],
                      saved_desc: dict, mesh: Mesh):
         """Re-place checkpointed bucket states onto THIS layout's mesh/dp:
-        strip the saved padding (saved dp may differ), re-pad to the current
-        multiple, place sharded. Returns ``(states, residuals)`` or ``None``
-        when the saved layout is incompatible (caller starts fresh)."""
+        de-interleave with the SAVED dp/psizes, re-pack with the current ones,
+        place sharded. Returns ``(states, residuals)`` or ``None`` when the
+        saved layout is incompatible (caller starts fresh)."""
         if not self.compatible_with(saved_desc):
             return None
         from .data_parallel import _place
+        old_n = max(1, int(saved_desc.get("dp", 1)))
+        saved_buckets = saved_desc.get("buckets", [])
         shard = self.shard_spec(mesh)
         repl = self.repl_spec(mesh)
+
+        def repack(raw: np.ndarray, b: ZeroBucket, old_ps: List[int]):
+            flats = _unpack_flat_host(raw, b.sizes, old_ps, old_n)
+            return _pack_flat_host(flats, b.psizes, self.dp)
+
         states: List[Tuple] = []
         residuals: List[Any] = []
         for bi, b in enumerate(self.buckets):
+            old_ps = [int(v) for v in saved_buckets[bi]["psizes"]]
+            old_padded = sum(old_ps)
             st = []
             j = 0
             while f"zopt:{bi}:{j}" in saved_arrays:
                 raw = np.asarray(saved_arrays[f"zopt:{bi}:{j}"])
-                if raw.ndim == 1 and raw.shape[0] >= b.unpadded:
-                    flat = np.zeros((b.padded,), raw.dtype)
-                    flat[:b.unpadded] = raw[:b.unpadded]
-                    st.append(_place(flat, shard))
+                if raw.ndim == 1 and raw.shape[0] == old_padded:
+                    st.append(_place(repack(raw, b, old_ps), shard))
                 else:                       # scalar/replicated slot
                     st.append(_place(raw, repl))
                 j += 1
@@ -251,9 +318,11 @@ class ZeroLayout:
             rk = f"zres:{bi}"
             if rk in saved_arrays:
                 raw = np.asarray(saved_arrays[rk])
-                flat = np.zeros((b.padded,), raw.dtype)
-                flat[:min(b.unpadded, raw.shape[0])] = raw[:b.unpadded]
-                residuals.append(_place(flat, shard))
+                if raw.shape[0] == old_padded:
+                    residuals.append(_place(repack(raw, b, old_ps), shard))
+                else:
+                    residuals.append(
+                        _place(np.zeros((b.padded,), raw.dtype), shard))
             else:
                 residuals.append(None)
         return states, residuals
@@ -265,18 +334,39 @@ class ZeroLayout:
 
 
 def _bucket_weight(layout: ZeroLayout, b: ZeroBucket, param_raws):
-    flats = [jnp.ravel(param_raws[i]).astype(b.dtype) for i in b.indices]
-    if b.padded > b.unpadded:
-        flats.append(jnp.zeros((b.padded - b.unpadded,), b.dtype))
-    return jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+    """Packed (N-interleaved) bucket weight, traceable. Params carry no
+    pending reduction, so reshape/concat are layout-only here — the
+    partitioner hazard is specific to partial-sum GRADIENTS."""
+    n = layout.dp
+    cols = []
+    for i, sz, ps in zip(b.indices, b.sizes, b.psizes):
+        flat = jnp.ravel(param_raws[i]).astype(b.dtype)
+        if ps > sz:
+            flat = jnp.pad(flat, (0, ps - sz))
+        cols.append(flat.reshape(n, ps // n))
+    mat = jnp.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+    return mat.reshape(-1)
+
+
+def _unpack_bucket(new_w_full, b: ZeroBucket, n: int):
+    """Static-slice de-interleave of a REPLICATED packed bucket back into
+    per-param flats (runs after the all-gather, no pending reductions)."""
+    mat = new_w_full.reshape(n, b.padded // n)
+    outs, off = [], 0
+    for sz, ps in zip(b.sizes, b.psizes):
+        step = ps // n
+        outs.append(mat[:, off:off + step].reshape(-1)[:sz])
+        off += step
+    return outs
 
 
 def init_zero_states(opt, layout: ZeroLayout, param_raws, mesh: Mesh,
                      with_residual: bool = False):
-    """Create per-bucket optimizer slots, placed dp-sharded (1/N resident per
-    device). Slot shapes follow ``create_state`` on the flat bucket "weight"
-    (so DCASGD's prev-weight copy, Nadam's scalar schedule, … all work);
-    bucket-shaped slots shard over dp, scalar slots stay replicated."""
+    """Create per-bucket optimizer slots, placed data-sharded (1/N resident
+    per device). Slot shapes follow ``create_state`` on the flat bucket
+    "weight" (so DCASGD's prev-weight copy, Nadam's scalar schedule, … all
+    work); bucket-shaped slots shard over the data axes, scalar slots stay
+    replicated."""
     from .data_parallel import _place
     from ..ndarray.ndarray import NDArray
     shard = layout.shard_spec(mesh)
@@ -309,42 +399,93 @@ def state_shardings(layout: ZeroLayout, states, mesh: Mesh):
 # ---------------------------------------------------------------------------
 
 
+def _build_bucket_pack(layout: ZeroLayout, mesh: Mesh):
+    """Traceable per-bucket gradient pack: per-param pad → per-param data-axis
+    sharding constraint (GSPMD resolves each pending reduction as a
+    reduce-scatter over the NAMED axes — exact on any mesh) → shard_map local
+    concat into the packed shard. The per-param constraint must come BEFORE
+    any concatenation: concat of partial-sum grads is what the partitioner
+    mis-reduces on multi-axis meshes."""
+    n = layout.dp
+    spec1d = layout.data_spec(mesh)
+    shard = layout.shard_spec(mesh)
+
+    def pack_bucket(b: ZeroBucket, grads, dt):
+        flats = []
+        for i, sz, ps in zip(b.indices, b.sizes, b.psizes):
+            f = jnp.ravel(grads[i])
+            if ps > sz:
+                f = jnp.pad(f, (0, ps - sz))
+            f = f.astype(dt)
+            if n > 1:
+                f = jax.lax.with_sharding_constraint(f, shard)
+            flats.append(f)
+        if len(flats) == 1:
+            return flats[0]
+        if n == 1:
+            return jnp.concatenate(flats)
+        from .collectives import shard_map_compat
+        local_concat = shard_map_compat(
+            lambda *locs: jnp.concatenate(locs), mesh,
+            in_specs=tuple(spec1d for _ in flats),
+            out_specs=spec1d, check=False)
+        return local_concat(*flats)
+
+    return pack_bucket
+
+
+def build_grad_pack(layout: ZeroLayout, mesh: Mesh):
+    """Traceable ``pack_grads(grads) -> [packed f32 bucket shards]`` — the
+    ZeRO-2 entry point: micro-batch loops reduce-scatter each micro-gradient
+    into the 1/N packed shard and accumulate THAT, so accumulation memory is
+    the bucket shard, never the replicated gradient."""
+    pack_bucket = _build_bucket_pack(layout, mesh)
+
+    def pack_grads(grads):
+        return [pack_bucket(b, grads, jnp.float32) for b in layout.buckets]
+
+    return pack_grads
+
+
 def build_zero_update(opt, layout: ZeroLayout, mesh: Mesh,
                       comm_dtype=None, compression_params: Optional[dict] = None):
     """One traceable function applying ``opt`` to every bucketed parameter
     through the reduce-scatter → shard-update → all-gather dataflow.
 
     Returns ``zero_update(params, grads, states, residuals, lr, wd, rescale,
-    clip, t) -> (new_params, new_states, new_residuals)``. ``params`` and
-    ``grads`` are the full per-param lists; passthrough (non-bucketed, e.g.
-    tensor-parallel) parameters are NOT updated here — callers compose with
-    ``build_update_all`` for those.
+    clip, t, packed_grads=None) -> (new_params, new_states, new_residuals)``.
+    ``params`` and ``grads`` are the full per-param lists; passthrough
+    (non-bucketed: tensor-parallel, or fsdp-resident at stage 3) parameters
+    are NOT updated here — callers compose with ``build_update_all`` for
+    those. ``packed_grads`` (from ``build_grad_pack``, stage 2) bypasses the
+    gradient pack when the caller already holds reduce-scattered shards.
 
-    The two ``with_sharding_constraint`` calls are the whole trick: the first
-    lands on the gradient while its cross-dp reduction is still pending, so
-    GSPMD materializes it as a reduce-scatter; the second forces the updated
-    shard back to replicated, an all-gather. Per-bucket, so XLA interleaves
-    the collectives with the rest of the backward/update instead of fencing
-    the step on one monolithic all-reduce.
+    The sharding constraints are the whole trick: the per-param constraint
+    lands on each gradient while its cross-data-axis reduction is still
+    pending, so GSPMD materializes a per-axis reduce-scatter; the final
+    constraint forces the updated packed shard back to replicated, an
+    all-gather. Per-bucket, so XLA interleaves the collectives with the rest
+    of the backward/update instead of fencing the step on one monolithic
+    all-reduce.
     """
     shard = layout.shard_spec(mesh)
     repl = layout.repl_spec(mesh)
+    n = layout.dp
+    pack_bucket = _build_bucket_pack(layout, mesh)
     clipped = opt.clip_gradient is not None
     thr = float((compression_params or {}).get("threshold", 0.5))
 
-    def zero_update(params, grads, states, residuals, lr, wd, rescale, clip, t):
+    def zero_update(params, grads, states, residuals, lr, wd, rescale, clip, t,
+                    packed_grads=None):
         new_params = list(params)
         new_states = []
         new_residuals = []
         for bi, b in enumerate(layout.buckets):
             dt = jnp.dtype(str(b.dtype))
-            flats = [jnp.ravel(grads[i]) for i in b.indices]
-            if b.padded > b.unpadded:
-                flats.append(jnp.zeros((b.padded - b.unpadded,), flats[0].dtype))
-            g_full = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-            # pending dp-reduction + sharded consumer → GSPMD reduce-scatter
-            g_shard = jax.lax.with_sharding_constraint(
-                g_full.astype(dt), shard)
+            if packed_grads is not None:
+                g_shard = packed_grads[bi].astype(dt)
+            else:
+                g_shard = pack_bucket(b, grads, dt)
             w_full = _bucket_weight(layout, b, params)
             w_shard = jax.lax.with_sharding_constraint(w_full, shard)
             gg = opt._preprocess_grad(g_shard, rescale.astype(dt),
@@ -374,13 +515,12 @@ def build_zero_update(opt, layout: ZeroLayout, mesh: Mesh,
                 if getattr(s, "shape", None) == (b.padded,) else s
                 for s in new_st))
             new_residuals.append(res)
-            # updated shard → replicated params: the all-gather
+            # updated packed shard → replicated: the all-gather; then a
+            # static-slice de-interleave rebuilds each full parameter
             new_w_full = jax.lax.with_sharding_constraint(new_w_shard, repl)
-            off = 0
-            for i, n, shp in zip(b.indices, b.sizes, b.shapes):
-                new_params[i] = jax.lax.dynamic_slice_in_dim(
-                    new_w_full, off, n).reshape(shp).astype(params[i].dtype)
-                off += n
+            for i, flat in zip(b.indices, _unpack_bucket(new_w_full, b, n)):
+                new_params[i] = flat.reshape(
+                    params[i].shape).astype(params[i].dtype)
         return new_params, new_states, new_residuals
 
     return zero_update

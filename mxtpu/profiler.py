@@ -44,15 +44,15 @@ from .observability.metrics import (  # noqa: F401  (re-exported surface)
     _stats_lock,
     add_commit_hook,
     get_checkpoint_stats, get_comm_stats, get_feed_stats,
-    get_resilience_stats, get_sanitizer_stats,
+    get_memory_stats, get_resilience_stats, get_sanitizer_stats,
     record_checkpoint_commit, record_checkpoint_restore,
     record_checkpoint_save, record_checkpoint_shard_write,
     record_collective, record_comm_step,
     record_feed_consume, record_feed_prefetch, record_feed_resident,
-    record_feed_transfer,
+    record_feed_transfer, record_memory_stats,
     record_resilience, record_sanitizer,
     reset_checkpoint_stats, reset_comm_stats, reset_feed_stats,
-    reset_resilience_stats, reset_sanitizer_stats,
+    reset_memory_stats, reset_resilience_stats, reset_sanitizer_stats,
     sanitizer_violations, set_feed_depth,
 )
 
@@ -212,6 +212,7 @@ def dumps(reset: bool = False) -> str:
                           "checkpoint": get_checkpoint_stats(),
                           "deviceFeed": get_feed_stats(),
                           "comm": get_comm_stats(),
+                          "memory": get_memory_stats(),
                           "sanitizer": get_sanitizer_stats(),
                           "resilience": get_resilience_stats(),
                           "mfu": get_mfu_stats()})
@@ -262,6 +263,17 @@ def compile_cache_summary() -> str:
             f"retrace-escalations={san['retrace_escalations']}, "
             f"ownership={san['ownership_checks']} "
             f"(trips {san['ownership_trips']})")
+    mem = get_memory_stats()
+    if mem["param_bytes_per_device"] or mem["slot_bytes_per_device"]:
+        lines.append(
+            f"memory: zero-stage={mem['stage']} "
+            f"(data×fsdp {mem['data_degree']}×{mem['fsdp_degree']}) "
+            f"per-device params={mem['param_bytes_per_device']} "
+            f"grads={mem['grad_bytes_per_device']} "
+            f"slots={mem['slot_bytes_per_device']} B "
+            f"(replicated: {mem['replicated_param_bytes']}/"
+            f"{mem['replicated_grad_bytes']}/"
+            f"{mem['replicated_slot_bytes']} B)")
     return "\n".join(lines)
 
 

@@ -117,7 +117,8 @@ def optimizer_fingerprint(opt) -> tuple:
     return (type(opt).__name__, opt.clip_gradient is not None, items)
 
 
-def build_update_all(opt, lr_mults: Sequence[float], wd_mults: Sequence[float]):
+def build_update_all(opt, lr_mults: Sequence[float], wd_mults: Sequence[float],
+                     shardings: Optional[Sequence] = None):
     """One traceable function applying ``opt`` to every parameter.
 
     Exactly the ``_preprocess_grad`` + ``_kernel`` composition the eager
@@ -126,6 +127,13 @@ def build_update_all(opt, lr_mults: Sequence[float], wd_mults: Sequence[float]):
     inlined so the whole multi-parameter update fuses into the enclosing
     step program. Shared by :class:`StepExecutor` and
     ``parallel.data_parallel.DataParallelTrainer``.
+
+    ``shardings`` (optional per-param ``NamedSharding`` or None entries)
+    constrains each gradient to its param's sharding BEFORE the kernel: for
+    fsdp-resident params GSPMD resolves the pending data-axis reduction as an
+    explicit per-axis reduce-scatter onto the shard (never a replicated
+    all-reduce), and the updated param is constrained back to the same
+    resident sharding.
 
     Returns ``update_all(params, grads, states, lr, wd, rescale, clip, t)``
     → ``(new_params, new_states)``. ``clip`` is ignored unless the optimizer
@@ -138,16 +146,22 @@ def build_update_all(opt, lr_mults: Sequence[float], wd_mults: Sequence[float]):
         new_states: List[Tuple] = []
         for i, (w, g, st) in enumerate(zip(params, grads, states)):
             dt = w.dtype
-            gg = opt._preprocess_grad(g.astype(dt), rescale.astype(dt),
+            g = g.astype(dt)
+            sh = shardings[i] if shardings is not None else None
+            if sh is not None:
+                g = jax.lax.with_sharding_constraint(g, sh)
+            gg = opt._preprocess_grad(g, rescale.astype(dt),
                                       clip.astype(dt) if clipped else None)
             out = opt._kernel(w, gg, lr.astype(dt) * lr_mults[i],
                               wd.astype(dt) * wd_mults[i], t, *st)
             if isinstance(out, tuple):
-                new_params.append(out[0])
-                new_states.append(tuple(out[1:]))
+                new_w, new_st = out[0], tuple(out[1:])
             else:
-                new_params.append(out)
-                new_states.append(())
+                new_w, new_st = out, ()
+            if sh is not None:
+                new_w = jax.lax.with_sharding_constraint(new_w, sh)
+            new_params.append(new_w)
+            new_states.append(new_st)
         return new_params, new_states
 
     return update_all
@@ -182,8 +196,16 @@ def unique_buffers(state: Tuple) -> Tuple:
     """Deep-copy optimizer-state arrays so no two donated leaves alias one
     buffer (freshly created zeros states can share a constant; XLA rejects
     donating the same buffer twice)."""
-    return tuple(jnp.array(s, copy=True) if hasattr(s, "dtype") else s
-                 for s in state)
+    def copy(s):
+        if not hasattr(s, "dtype"):
+            return s
+        sh = getattr(s, "sharding", None)
+        if sh is not None and getattr(sh, "num_devices", 1) > 1:
+            # sharding-preserving copy: jnp.array(copy=True) would gather a
+            # NamedSharding-placed slot onto one device
+            return s + jnp.zeros((), s.dtype)
+        return jnp.array(s, copy=True)
+    return tuple(copy(s) for s in state)
 
 
 # ---------------------------------------------------------------------------
@@ -225,27 +247,51 @@ class StepExecutor:
         self._param_handles = list(trainer._params)
         self._aux_handles = [p for p in trainer._all_params
                              if p.grad_req == "null" and p._data is not None]
-        # ZeRO-1 engagement, resolved ONCE (kvstore type device/dist_sync +
+        # ZeRO engagement, resolved ONCE (kvstore type device/dist_sync +
         # MXTPU_ZERO + elementwise optimizer → trainer.zero_requested()):
-        # params go replicated on the dp mesh, the batch dp-shards, gradients
-        # bucket into reduce-scatters, and optimizer slots live 1/N-sharded
+        # the batch shards over the data axes, gradients resolve per-param
+        # as named-axis reduce-scatters into packed buckets, and optimizer
+        # slots live 1/N-sharded. ``MXTPU_ZERO_STAGE=3`` additionally keeps
+        # every shardable param RESIDENT 1/N on the fsdp axis. Works on any
+        # mesh (the old multi-axis replicated fallback is gone — per-param
+        # constraint resolution is exact where the concat formulation
+        # mis-reduced).
         self._zero_mesh = None
+        self._zero_stage = 0
+        self._param_sh = None
         if trainer.zero_requested():
             from .parallel.mesh import get_default_mesh
-            mesh = get_default_mesh()
-            # single-axis meshes only (see DataParallelTrainer: multi-axis
-            # concat-of-partial-sum gradients mis-reduce on this jax version)
-            if len(mesh.axis_names) == 1:
-                self._zero_mesh = mesh
+            from .parallel.fsdp import zero_stage
+            self._zero_mesh = get_default_mesh()
+            self._zero_stage = zero_stage()
 
-    # -- ZeRO-1 plumbing ---------------------------------------------------
+    # -- ZeRO plumbing -----------------------------------------------------
     def _ensure_placed(self):
-        """Replicate params/aux across the dp mesh (idempotent; the committed
-        NamedSharding is part of the signature, so this runs BEFORE _sig)."""
+        """Place params/aux across the mesh (idempotent; the committed
+        NamedSharding is part of the signature, so this runs BEFORE _sig).
+        Stages 1/2 replicate everything; stage 3 keeps each shardable param
+        RESIDENT 1/N on the fsdp axis (XLA all-gathers it just-in-time inside
+        the compiled step and frees the gathered copy after use)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         from .parallel.data_parallel import _place
-        repl = NamedSharding(self._zero_mesh, P())
-        for p in self._param_handles + self._aux_handles:
+        mesh = self._zero_mesh
+        repl = NamedSharding(mesh, P())
+        if self._param_sh is None:
+            if self._zero_stage >= 3:
+                from .parallel import fsdp as fsdp_mod
+                composed = fsdp_mod.fsdp_param_specs(
+                    [tuple(p._data._data.shape) for p in self._param_handles],
+                    [None] * len(self._param_handles), mesh)
+                self._param_sh = [
+                    NamedSharding(mesh, c) if c is not None else repl
+                    for c in composed]
+            else:
+                self._param_sh = [repl] * len(self._param_handles)
+        for p, sh in zip(self._param_handles, self._param_sh):
+            raw = p._data._data
+            if getattr(raw, "sharding", None) != sh:
+                p._data._set_data(_place(raw, sh))
+        for p in self._aux_handles:
             raw = p._data._data
             if getattr(raw, "sharding", None) != repl:
                 p._data._set_data(_place(raw, repl))
@@ -253,22 +299,28 @@ class StepExecutor:
     def _ensure_zero_states(self):
         """Create (or adopt from a checkpoint restore) the per-bucket sharded
         optimizer slots, owned by the Trainer so snapshot capture sees them."""
+        from jax.sharding import PartitionSpec as P
         from .parallel import zero as zero_mod
-        from .parallel.mesh import dp_size
+        from .parallel.mesh import data_size
         tr = self.trainer
         opt = tr._optimizer
         if tr._zero_layout is not None:
+            if tr._zero_layout.passthrough:
+                self._ensure_pt_states()
             return
         raws = [p._data._data for p in self._param_handles]
         comp = getattr(tr._kvstore, "_compression_params", None) \
             if tr._kvstore is not None else None
+        # stage 3: fsdp-resident params are NOT bucketed — they keep the
+        # per-param sharded update (slots follow the param's sharding)
         layout = zero_mod.ZeroLayout(
             raws,
             [getattr(p, "lr_mult", 1.0) * opt.lr_mult.get(i, 1.0)
              for i, p in enumerate(self._param_handles)],
             [getattr(p, "wd_mult", 1.0) * opt.wd_mult.get(i, 1.0)
              for i, p in enumerate(self._param_handles)],
-            dp_size(self._zero_mesh))
+            data_size(self._zero_mesh),
+            eligible=[sh.spec == P() for sh in self._param_sh])
         tr._zero_layout = layout
         adopted = None
         if tr._zero_restore is not None:
@@ -303,6 +355,33 @@ class StepExecutor:
                 for b, r in zip(layout.buckets, tr._zero_residuals)]
         if donation_supported():
             tr._zero_states = [unique_buffers(st) for st in tr._zero_states]
+        if layout.passthrough:
+            self._ensure_pt_states()
+
+    def _ensure_pt_states(self):
+        """Per-param optimizer slots for the passthrough set (fsdp-resident
+        params at stage 3): each slot is placed with its PARAM's sharding, so
+        state is 1/N resident without bucketing — and the checkpoint path
+        (``opt:i:j`` keys + recorded specs) re-shards it across fsdp widths
+        exactly like a param."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .parallel.data_parallel import _place
+        tr = self.trainer
+        opt = tr._optimizer
+        repl = NamedSharding(self._zero_mesh, P())
+        donate = donation_supported()
+        for i in tr._zero_layout.passthrough:
+            if tr._states[i] is not None:
+                continue
+            p = self._param_handles[i]
+            shape = tuple(p._data._data.shape)
+            st = opt.create_state_multi_precision(i, p.data())
+            placed = tuple(
+                _place(s, self._param_sh[i]
+                       if getattr(s, "shape", None) == shape else repl)
+                if hasattr(s, "dtype") else s
+                for s in st)
+            tr._states[i] = unique_buffers(placed) if donate else placed
 
     # -- signature ---------------------------------------------------------
     def _ensure_states(self):
@@ -354,6 +433,8 @@ class StepExecutor:
                     for i, p in enumerate(param_handles)]
         update_all = build_update_all(opt, lr_mults, wd_mults)
         zero_update = None
+        pt: List[int] = []
+        pt_update = None
         if self._zero_mesh is not None:
             from .parallel import zero as zero_mod
             comp = getattr(self.trainer._kvstore, "_compression_params", None) \
@@ -362,6 +443,15 @@ class StepExecutor:
                 opt, self.trainer._zero_layout, self._zero_mesh,
                 comm_dtype=zero_mod.comm_dtype_of(comp),
                 compression_params=comp)
+            # fsdp-resident (stage 3) params: per-param update with the
+            # gradient constrained to the param's resident sharding — the
+            # pending data-axis reduction lowers to an explicit per-axis
+            # reduce-scatter onto the 1/N shard
+            pt = list(self.trainer._zero_layout.passthrough)
+            if pt:
+                pt_update = build_update_all(
+                    opt, [lr_mults[i] for i in pt], [wd_mults[i] for i in pt],
+                    shardings=[self._param_sh[i] for i in pt])
         softmax_expose = isinstance(loss_fn, SoftmaxCrossEntropyLoss)
         struct: dict = {}
 
@@ -393,7 +483,7 @@ class StepExecutor:
                 (_, (new_aux, raw_outs, loss_arr)), grads = \
                     jax.value_and_grad(loss_on, has_aux=True)(list(param_raws))
                 if zero_update is not None:
-                    # ZeRO-1: bucketed reduce-scatter → sharded slot update →
+                    # ZeRO: bucketed reduce-scatter → sharded slot update →
                     # all-gather. Grads are NOT returned in this mode: a
                     # replicated grad output would force the very all-reduce
                     # the reduce-scatter exists to avoid.
@@ -401,6 +491,15 @@ class StepExecutor:
                         list(param_raws), list(grads), zstates, zres,
                         lr, wd, rescale, clip, t)
                     new_states, out_grads = list(state_raws), None
+                    if pt:
+                        sub_w, sub_st = pt_update(
+                            [new_params[i] for i in pt],
+                            [grads[i] for i in pt],
+                            [state_raws[i] or () for i in pt],
+                            lr, wd, rescale, clip, t)
+                        for j, i in enumerate(pt):
+                            new_params[i] = sub_w[j]
+                            new_states[i] = sub_st[j]
                 else:
                     new_params, new_states = update_all(
                         param_raws, grads, state_raws, lr, wd, rescale,
@@ -527,6 +626,16 @@ class StepExecutor:
             entry["avals"] = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
                 if hasattr(a, "shape") else a, step_args)
+            if self._zero_mesh is not None:
+                # per-device residency accounting, from the placed shardings
+                from .parallel import fsdp as fsdp_mod
+                slots = [s for st in list(tr._states) + list(tr._zero_states)
+                         for s in (st or ()) if hasattr(s, "dtype")]
+                slots += [r for r in tr._zero_residuals if r is not None]
+                grad_bytes = sum(fsdp_mod.replicated_bytes(a)
+                                 for a in param_raws)
+                fsdp_mod.measure_memory(self._zero_stage, self._zero_mesh,
+                                        param_raws, slots, grad_bytes)
         # one span per dispatch on the unified step timeline: the first call
         # of a signature IS the trace+lower+compile (step/compile, tagged
         # with the signature fingerprint), cache hits are step/execute

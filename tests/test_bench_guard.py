@@ -46,7 +46,7 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert doc["loss_end"] < doc["loss_start"]       # it actually trained
     # every fallback scenario must keep emitting its keys
     assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
-            "compile_caches", "mfu", "trace", "ratchet"} <= set(doc)
+            "compile_caches", "mfu", "trace", "fsdp", "ratchet"} <= set(doc)
     # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
     # mid-epoch crash survived by a supervised restart, final params equal
     # to the fault-free baseline
@@ -60,6 +60,32 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert zdp["dp"] >= 1
     assert zdp["zero1"]["opt_state_bytes_per_device"] > 0
     assert zdp["replicated"]["step_ms"] > 0 and zdp["zero1"]["step_ms"] > 0
+    # fsdp leg (ISSUE 9): the MXTPU_ZERO_STAGE ladder ran all three stages,
+    # stage 3 shrank param+slot residency, and the final loss stayed
+    # bit-identical across stages (dim-0-only sharding contract)
+    fsdp = doc["fsdp"]
+    assert "error" not in fsdp, fsdp
+    assert fsdp["dp"] >= 1
+    for stage in ("stage1", "stage2", "stage3"):
+        assert fsdp[stage]["step_ms"] > 0
+        assert fsdp[stage]["param_bytes_per_device"] > 0
+        assert fsdp[stage]["slot_bytes_per_device"] > 0
+    assert fsdp["loss_bit_parity"] is True
+    # the shrink rides the ratchet: present under the smoke harness key
+    assert doc["ratchet"]["current"]["fsdp_param_slot_shrink"] \
+        == fsdp["param_slot_shrink"]
+    if fsdp["dp"] > 1:   # ring legs are (N-1)/N: zero at dp=1
+        assert fsdp["param_slot_shrink"] > 1.0
+        for stage in ("stage1", "stage2", "stage3"):
+            assert fsdp[stage]["comm_bytes_per_step"] > 0
+        assert fsdp["stage3"]["param_bytes_per_device"] \
+            < fsdp["stage1"]["param_bytes_per_device"]
+        assert fsdp["stage2"]["grad_bytes_per_device"] \
+            <= fsdp["stage1"]["grad_bytes_per_device"]
+    # the comm leg's all_to_all anomaly probe shipped its point timing
+    a2a = doc.get("comm", {}).get("all_to_all_probe")
+    if a2a is not None:
+        assert a2a["shard_map_ms"] > 0 and a2a["jit_reshard_ms"] > 0
     # MFU block (ISSUE 6 ratchet inputs): nonzero mfu, steps/s, tail latency
     mfu = doc["mfu"]
     assert mfu["mfu"] is not None and mfu["mfu"] > 0

@@ -92,3 +92,41 @@ def test_zero_fit_traces_once_across_epochs():
             f"ZeRO fit step-traced {step['traces']} times across 3 epochs — "
             f"the sharded path added retraces: {stats}")
         assert step["hits"] >= 3 * 4 - 1
+
+
+def test_stage3_fit_traces_once_across_epochs(monkeypatch):
+    """FSDP guard (ISSUE 9): at MXTPU_ZERO_STAGE=3 the per-layer param
+    all-gathers are GSPMD-inserted inside the ONE compiled step — sharded
+    param/slot placement may not introduce per-batch, per-epoch, or
+    per-layer retraces."""
+    from mxtpu.gluon import nn
+    from mxtpu.io import NDArrayIter
+
+    monkeypatch.setenv("MXTPU_ZERO_STAGE", "3")
+    rs = np.random.RandomState(0)
+    X = rs.rand(64, 16).astype(np.float32)
+    y = rs.randint(0, 4, 64).astype(np.float32)
+    with engine.bulk(engine.DEFAULT_BULK_SIZE):
+        profiler.reset_compile_stats()
+        profiler.reset_memory_stats()
+        mx.rng.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="tanh", in_units=16),
+                nn.Dense(4, in_units=32))
+        net.initialize(init=mx.initializer.Xavier())
+        mod = mx.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+        it = NDArrayIter(X, y, batch_size=16, shuffle=False)
+        mod.fit(it, num_epoch=3, kvstore="device", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+        assert mod._step_exec._zero_stage == 3, \
+            "MXTPU_ZERO_STAGE=3 fit did not engage the fsdp path"
+        mem = profiler.get_memory_stats()
+        assert mem["stage"] == 3
+        assert mem["param_bytes_per_device"] < mem["replicated_param_bytes"]
+        stats = profiler.get_compile_stats()
+        step = stats.get("module_step", {"traces": 0, "hits": 0})
+        assert step["traces"] <= 1, (
+            f"stage-3 fit step-traced {step['traces']} times across 3 "
+            f"epochs — FSDP placement added retraces: {stats}")
+        assert step["hits"] >= 3 * 4 - 1
