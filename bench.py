@@ -31,6 +31,10 @@ Headline metrics (img/s, MFU, steps/s) ratchet against
 regressions beyond MXTPU_BENCH_RATCHET_TOL are reported, never fatal). The
 ``"mfu"`` and ``"trace"`` blocks come from ``mxtpu.observability`` — see
 docs/observability.md.
+
+Scenario-only CLI: ``bench.py resilience`` (fault-injection/supervised
+resume) and ``bench.py serving`` (Poisson-arrival continuous-batching
+latency/goodput — see docs/serving.md) each emit their own one-line JSON.
 """
 
 from __future__ import annotations
@@ -1452,10 +1456,14 @@ def apply_ratchet(doc: dict, harness: str):
         fsdp_block = doc.get("fsdp")
         fsdp_shrink = fsdp_block.get("param_slot_shrink") \
             if isinstance(fsdp_block, dict) else None
+        serving_block = doc.get("serving")
+        serving_goodput = serving_block.get("goodput_tok_s") \
+            if isinstance(serving_block, dict) else None
         metrics = {}
         for key, val in (("img_s", doc.get("value")), ("mfu", mfu_val),
                          ("steps_per_sec", block.get("steps_per_sec")),
-                         ("fsdp_param_slot_shrink", fsdp_shrink)):
+                         ("fsdp_param_slot_shrink", fsdp_shrink),
+                         ("serving_goodput", serving_goodput)):
             if isinstance(val, (int, float)) and val > 0:
                 metrics[key] = val
         path = _ratchet_path()
@@ -1498,6 +1506,140 @@ def apply_ratchet(doc: dict, harness: str):
         doc["ratchet"] = {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_serving(smoke: bool = False):
+    """Online-serving scenario (ISSUE 10): Poisson arrivals of generation
+    requests against ``ServingEngine`` (continuous batching over a fixed
+    slot batch) versus a serial per-request ``generate`` baseline replaying
+    the *same* trace.
+
+    Methodology: every request's solo ``generate`` latency is measured
+    first (post-compile), giving the serial server's service times. The
+    serial leg is then an exact virtual-clock FIFO replay — no sleeps:
+    ``end_i = max(arrival_i, end_{i-1}) + service_i`` — while the engine
+    leg replays the identical arrival offsets with real sleeps against the
+    live scheduler thread. Arrivals are drawn at ~2.2x the serial server's
+    capacity, so the serial queue grows without bound while the slot batch
+    keeps up; *goodput* counts only tokens of requests finishing inside a
+    deadline of a few solo service times. Greedy decode is asserted
+    bit-exact against the solo outputs (``decode_match``) so the speedup is
+    never bought with drift. All compiles happen in warmup, off the clock."""
+    import jax  # noqa: F401  (backend selection happens at import)
+
+    import mxtpu as mx
+    from mxtpu import nd, profiler
+    from mxtpu.gluon.model_zoo import transformer_lm
+    from mxtpu.serving import ServingEngine
+
+    mx.rng.seed(0)
+    vocab = 50
+    net = transformer_lm("tiny", vocab_size=vocab)
+    net.initialize()
+
+    # prompt lengths all land in the first 32-token prefill bucket and every
+    # total lands in ONE scan bucket, so the whole trace costs exactly one
+    # generate / one prefill / one decode program (asserted via the compile
+    # ratchet in tests/test_serving_guard.py). max_new is deliberately large
+    # relative to the 32-token prefill bucket: prefill is a serialized B=1
+    # scan (one per admission), so decode — the part the slot batch
+    # parallelizes — must carry most of each request's tokens for the
+    # continuous-batching win to be about batching rather than bucketing.
+    n_req = 24 if smoke else 32
+    max_new = 160
+    slots = 8
+    load_factor = 1.8          # offered load vs measured serial capacity
+    deadline_factor = 6.0
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(1, vocab, size=int(n)).tolist()
+               for n in rs.randint(8, 32, size=n_req)]
+
+    # -- solo reference pass: warms the generate program, records the
+    # per-request service time and the bit-exact greedy continuation
+    # (np.asarray inside the timed region: dispatch is async, only the
+    # host readback waits for the result)
+    refs, t_solo = [], []
+    for p in prompts:
+        arr = nd.array(np.array([p], np.int32))
+        np.asarray(net.generate(arr, max_new).data)    # compile, off-clock
+        t0 = time.perf_counter()
+        out = np.asarray(net.generate(arr, max_new).data)
+        t_solo.append(time.perf_counter() - t0)
+        refs.append(out[0, len(p):].tolist())
+    service = float(np.mean(t_solo))
+    deadline_s = deadline_factor * service
+
+    gaps = rs.exponential(service / load_factor, size=n_req)
+    gaps[0] = 0.0
+    arrivals = np.cumsum(gaps)
+
+    # -- serial baseline: virtual-clock FIFO over the measured service times
+    serial_end, serial_ok_tokens, serial_lat = 0.0, 0, []
+    for i in range(n_req):
+        start = max(float(arrivals[i]), serial_end)
+        serial_end = start + t_solo[i]
+        lat = serial_end - float(arrivals[i])
+        serial_lat.append(lat)           # per-request generate: all tokens
+        if lat <= deadline_s:            # arrive at completion
+            serial_ok_tokens += max_new
+    serial_span = max(serial_end, float(arrivals[-1]))
+    serial_goodput = serial_ok_tokens / serial_span if serial_span else 0.0
+
+    # -- engine leg: same arrival offsets, real sleeps, live scheduler
+    engine = ServingEngine(net, slots=slots, queue_depth=n_req + 2, chunk=16)
+    engine.start()
+    longest = max(prompts, key=len)
+    engine.submit(longest, max_new).result(timeout=300)   # warm prefill +
+    profiler.reset_serving_stats()                        # decode, off-clock
+    t_base = time.monotonic()
+    reqs = []
+    for i in range(n_req):
+        wait = float(arrivals[i]) - (time.monotonic() - t_base)
+        if wait > 0:
+            time.sleep(wait)
+        reqs.append(engine.submit(prompts[i], max_new))
+    outs = [r.result(timeout=600) for r in reqs]
+    span = time.monotonic() - t_base
+    stats = profiler.get_serving_stats()
+    engine.stop()
+
+    decode_match = all(o == r for o, r in zip(outs, refs))
+    ttft = np.array([r.t_first_token - r.t_submit for r in reqs])
+    lat = np.array([r.t_done - r.t_submit for r in reqs])
+    per_tok = lat / max_new
+    ok_tokens = int(sum(max_new for v in lat if v <= deadline_s))
+    goodput = ok_tokens / span if span else 0.0
+    doc = {
+        "requests": n_req,
+        "max_new": max_new,
+        "slots": slots,
+        "chunk": engine.chunk,
+        "offered_load_vs_serial": load_factor,
+        "deadline_ms": deadline_s * 1e3,
+        "solo_service_ms": service * 1e3,
+        "ttft_p50_ms": float(np.percentile(ttft, 50) * 1e3),
+        "ttft_p99_ms": float(np.percentile(ttft, 99) * 1e3),
+        "per_token_p50_ms": float(np.percentile(per_tok, 50) * 1e3),
+        "per_token_p99_ms": float(np.percentile(per_tok, 99) * 1e3),
+        "goodput_tok_s": goodput,
+        "serial_goodput_tok_s": serial_goodput,
+        "goodput_vs_serial": goodput / serial_goodput
+        if serial_goodput else float("inf"),
+        "serial_ttft_p50_ms": float(np.percentile(serial_lat, 50) * 1e3),
+        "deadline_met": int(sum(1 for v in lat if v <= deadline_s)),
+        "serial_deadline_met": int(
+            sum(1 for v in serial_lat if v <= deadline_s)),
+        "decode_match": bool(decode_match),
+        "slot_occupancy": stats.get("slot_occupancy"),
+        "decode_steps": stats.get("decode_steps"),
+        "kv_promotions": stats.get("kv_promotions"),
+        "completed": stats.get("completed"),
+    }
+    log(f"[serving] {n_req} reqs x {max_new} tok, {slots} slots: goodput "
+        f"{goodput:.1f} tok/s vs serial {serial_goodput:.1f} "
+        f"({doc['goodput_vs_serial']:.2f}x), ttft p50 "
+        f"{doc['ttft_p50_ms']:.1f} ms, match={decode_match}")
+    return doc
+
+
 def _sanitize_requested() -> bool:
     """``--sanitize`` flag (forwarded through the cpu-fallback re-exec)."""
     return "--sanitize" in sys.argv
@@ -1519,6 +1661,25 @@ def _emit_resilience_only(smoke: bool) -> None:
            "unit": "params_match",
            "platform": jax.default_backend(),
            "resilience": resil}
+    print(json.dumps(doc))
+
+
+def _serving_only() -> bool:
+    """``bench.py serving`` — run just the online-serving latency/goodput
+    scenario and emit a serving-only JSON line (rides the same cpu-fallback
+    re-exec as every other flag)."""
+    return "serving" in sys.argv[1:]
+
+
+def _emit_serving_only(smoke: bool) -> None:
+    import jax
+    serving = run_leg("serving", bench_serving, smoke=smoke)
+    doc = {"metric": "serving_goodput_tok_s",
+           "value": (serving.get("goodput_tok_s", 0.0)
+                     if isinstance(serving, dict) else 0.0),
+           "unit": "deadline-met tokens/sec",
+           "platform": jax.default_backend(),
+           "serving": serving}
     print(json.dumps(doc))
 
 
@@ -1799,6 +1960,9 @@ def bench_cpu_fallback():
     if _resilience_only():
         _emit_resilience_only(smoke)
         return
+    if _serving_only():
+        _emit_serving_only(smoke)
+        return
     train = run_leg("train", _fallback_train_leg, smoke)
     mod = train.pop("module", None) if isinstance(train, dict) else None
     # the checkpoint + input-pipeline + zero_dp + trace scenarios reuse the
@@ -1813,6 +1977,7 @@ def bench_cpu_fallback():
     fsdp = run_leg("fsdp", bench_fsdp, steps=4 if smoke else 12,
                    hidden=128 if smoke else 512)
     resil = run_leg("resilience", bench_resilience, smoke=smoke)
+    serving = run_leg("serving", bench_serving, smoke=smoke)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer, smoke=smoke) \
         if _sanitize_requested() else None
@@ -1835,6 +2000,7 @@ def bench_cpu_fallback():
         "zero_dp": zdp,
         "fsdp": fsdp,
         "resilience": resil,
+        "serving": serving,
         "trace": trace,
         "compile_caches": caches,
     }
@@ -1885,6 +2051,9 @@ def main():
     if _resilience_only():
         _emit_resilience_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
         return
+    if _serving_only():
+        _emit_serving_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
+        return
     # every scenario runs under run_leg crash containment: retries with
     # backoff on transient backend errors (UNAVAILABLE / init failures), an
     # {"error": ...} leg entry otherwise — the scoreboard always ships
@@ -1912,6 +2081,7 @@ def main():
     zdp = run_leg("zero_dp", bench_zero_dp)
     fsdp = run_leg("fsdp", bench_fsdp)
     resil = run_leg("resilience", bench_resilience)
+    serving = run_leg("serving", bench_serving)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer) \
         if _sanitize_requested() else None
@@ -1949,6 +2119,7 @@ def main():
         "zero_dp": zdp,
         "fsdp": fsdp,
         "resilience": resil,
+        "serving": serving,
         "trace": trace,
         "compile_caches": _compile_caches(),
     }

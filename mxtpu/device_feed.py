@@ -310,6 +310,32 @@ class DeviceFeed(DataIter):
         profiler.record_feed_consume(stall_ms)
         return payload
 
+    def poll(self, timeout: float = 0.0):
+        """Non-blocking consumer: the next staged batch if one is ready
+        within ``timeout`` seconds, else ``None``. Producer errors re-raise
+        and end-of-stream raises ``StopIteration`` exactly like
+        :meth:`next`. This is the serving-engine admission path: the
+        scheduler thread drains whatever requests the staging producer has
+        made device-resident between decode steps without ever blocking the
+        in-flight slot batch."""
+        gen = self._ensure()
+        t0 = time.perf_counter()
+        try:
+            if timeout > 0:
+                kind, payload = gen.queue.get(timeout=timeout)
+            else:
+                kind, payload = gen.queue.get_nowait()
+        except queue.Empty:
+            if gen.error is not None:
+                raise gen.error
+            return None
+        if kind == "error":
+            raise payload
+        if kind == "end":
+            raise StopIteration
+        profiler.record_feed_consume((time.perf_counter() - t0) * 1e3)
+        return payload
+
     # -- lifecycle ---------------------------------------------------------
     def close(self):
         """Stop the current producer generation and drop its queue (the

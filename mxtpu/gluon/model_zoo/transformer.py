@@ -132,11 +132,21 @@ class TransformerLM(HybridBlock):
             out["head_b"] = raw(self.head.bias)
         return out
 
-    def _build_generate(self, B: int, P: int, TOT: int, greedy: bool):
-        """One compiled decode program for (batch B, prompt bucket P, scan
-        bucket TOT): the TRUE prompt length arrives as a traced scalar, so
-        natural-length prompts share programs per bucket instead of
-        recompiling per length."""
+    def serving_step(self, S: int, TOT: int):
+        """The engine-facing step-callable: one decode step over an
+        ``S``-slot batch with PER-SLOT positions.
+
+        Returns ``step(params, caches, tok, p) -> (new_caches, logits)``
+        where ``caches`` is the static ``(L, 2, S, H, TOT, D)`` KV cache,
+        ``tok`` is the ``(S,)`` int32 token fed at per-slot position ``p``
+        (``(S,)`` int32, clipped into the cache), and ``logits`` is
+        ``(S, vocab)`` for position ``p + 1``. Every op is row-independent
+        (per-slot causal mask, per-slot KV scatter), so one slot's output is
+        bit-identical regardless of what the other slots hold — the property
+        the continuous-batching engine's bit-exactness contract rests on.
+        ``_build_generate`` scans this same callable with ``p`` broadcast to
+        a single position, so solo ``generate`` and the serving engine share
+        one implementation of the decode math."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -144,7 +154,6 @@ class TransformerLM(HybridBlock):
         H = self.blocks[0].attn._heads
         U = self._units
         D = U // H
-        L = len(self.blocks)
         scale = 1.0 / math.sqrt(D)
 
         def ln(x, g, b, eps=1e-5):
@@ -152,74 +161,127 @@ class TransformerLM(HybridBlock):
             v = jnp.var(x, axis=-1, keepdims=True)
             return (x - m) * lax.rsqrt(v + eps) * g + b
 
-        total = TOT
-
-        def step_fn(params, carry, t):
-            caches, tok, key = carry        # caches: (L,2,B,H,TOT,D)
-            x = params["embed"][tok] + params["pos"][t]        # (B, U)
+        def step(params, caches, tok, p):
+            rows = jnp.arange(S)
+            pc = jnp.clip(p, 0, TOT - 1)
+            x = params["embed"][tok] + params["pos"][pc]       # (S, U)
+            mask = jnp.arange(TOT)[None, :] <= pc[:, None]     # (S, TOT)
             new_caches = caches
             for i, lp in enumerate(params["layers"]):
                 h = ln(x, lp["ln1_g"], lp["ln1_b"])
-                q = (h @ lp["qw"].T + lp["qb"]).reshape(B, H, D)
-                k = (h @ lp["kw"].T + lp["kb"]).reshape(B, H, D)
-                v = (h @ lp["vw"].T + lp["vb"]).reshape(B, H, D)
-                new_caches = lax.dynamic_update_slice(
-                    new_caches,
-                    jnp.stack([k, v])[None, :, :, :, None, :],
-                    (i, 0, 0, 0, t, 0))
-                K = new_caches[i, 0]        # (B, H, total, D)
+                q = (h @ lp["qw"].T + lp["qb"]).reshape(S, H, D)
+                k = (h @ lp["kw"].T + lp["kb"]).reshape(S, H, D)
+                v = (h @ lp["vw"].T + lp["vb"]).reshape(S, H, D)
+                # per-slot scatter: slot s writes only its own cache row at
+                # its own position — dead/retired slots can't corrupt peers
+                new_caches = new_caches.at[i, 0, rows, :, pc].set(k)
+                new_caches = new_caches.at[i, 1, rows, :, pc].set(v)
+                K = new_caches[i, 0]        # (S, H, TOT, D)
                 V = new_caches[i, 1]
                 s = jnp.einsum("bhd,bhtd->bht", q, K) * scale
-                mask = jnp.arange(total) <= t
-                s = jnp.where(mask[None, None, :], s, -1e30)
-                p = jax.nn.softmax(s, axis=-1)
-                ctx = jnp.einsum("bht,bhtd->bhd", p, V).reshape(B, U)
+                s = jnp.where(mask[:, None, :], s, -1e30)
+                att = jax.nn.softmax(s, axis=-1)
+                ctx = jnp.einsum("bht,bhtd->bhd", att, V).reshape(S, U)
                 x = x + ctx @ lp["ow"].T + lp["ob"]
                 g = ln(x, lp["ln2_g"], lp["ln2_b"])
-                g = jax.nn.gelu(g @ lp["f1w"].T + lp["f1b"], approximate=False)
+                g = jax.nn.gelu(g @ lp["f1w"].T + lp["f1b"],
+                                approximate=False)
                 x = x + g @ lp["f2w"].T + lp["f2b"]
             h = ln(x, params["ln_f_g"], params["ln_f_b"])
             if self._tie:
-                logits = h @ params["embed"].T                  # (B, vocab)
+                logits = h @ params["embed"].T                  # (S, vocab)
             else:
                 logits = h @ params["head_w"].T + params["head_b"]
-            if greedy:
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            else:
-                key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(sub, logits, axis=-1) \
-                    .astype(jnp.int32)
-            return (new_caches, nxt, key), nxt
+            return new_caches, logits
 
-        def run(params, prompt, t0, key):
-            caches0 = jnp.zeros((L, 2, B, H, TOT, D),
-                                params["embed"].dtype)
+        return step
 
-            def body(carry, t):
-                # prompt positions are FORCED; generated positions feed back
-                caches, prev, key = carry
-                tok = jnp.where(t < t0, prompt[:, jnp.minimum(t, P - 1)],
-                                prev)
-                new_carry, nxt = step_fn(params, (caches, tok, key), t)
-                return new_carry, nxt
+    def _build_generate(self, B: int, P: int, TOT: int, greedy: bool):
+        """One compiled decode program for (batch B, prompt bucket P, scan
+        bucket TOT): the TRUE prompt length arrives as a traced scalar, so
+        natural-length prompts share programs per bucket instead of
+        recompiling per length. The scan body is :meth:`serving_step` with
+        every slot at the same position; the greedy program takes no rng
+        key (argmax needs none — dropping it keeps the donation/signature
+        surface minimal)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
 
-            init = (caches0, jnp.zeros((B,), jnp.int32), key)
-            _, outs = lax.scan(body, init, jnp.arange(TOT))
-            return outs.T                                       # (B, TOT)
+        H = self.blocks[0].attn._heads
+        D = self._units // H
+        L = len(self.blocks)
+        step = self.serving_step(B, TOT)
+
+        def body_tok(params, caches, prev, prompt, t0, t):
+            # prompt positions are FORCED; generated positions feed back
+            tok = jnp.where(t < t0, prompt[:, jnp.minimum(t, P - 1)], prev)
+            pos = jnp.full((B,), t, jnp.int32)
+            return step(params, caches, tok, pos)
+
+        if greedy:
+            def run(params, prompt, t0):
+                caches0 = jnp.zeros((L, 2, B, H, TOT, D),
+                                    params["embed"].dtype)
+
+                def body(carry, t):
+                    caches, prev = carry
+                    new_caches, logits = body_tok(params, caches, prev,
+                                                  prompt, t0, t)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (new_caches, nxt), nxt
+
+                init = (caches0, jnp.zeros((B,), jnp.int32))
+                _, outs = lax.scan(body, init,
+                                   jnp.arange(TOT, dtype=jnp.int32))
+                return outs.T                                   # (B, TOT)
+        else:
+            def run(params, prompt, t0, key):
+                caches0 = jnp.zeros((L, 2, B, H, TOT, D),
+                                    params["embed"].dtype)
+
+                def body(carry, t):
+                    caches, prev, key = carry
+                    new_caches, logits = body_tok(params, caches, prev,
+                                                  prompt, t0, t)
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub, logits, axis=-1) \
+                        .astype(jnp.int32)
+                    return (new_caches, nxt, key), nxt
+
+                init = (caches0, jnp.zeros((B,), jnp.int32), key)
+                _, outs = lax.scan(body, init,
+                                   jnp.arange(TOT, dtype=jnp.int32))
+                return outs.T                                   # (B, TOT)
 
         return jax.jit(run)
+
+    def length_bucket(self, n: int) -> int:
+        """32-token length bucket (capped at ``max_len``) — programs are
+        shared per bucket; the serving KV admission uses the same rounding
+        so engine caches and solo ``generate`` key identically."""
+        return min(self._max_len, -(-n // 32) * 32)
+
+    @staticmethod
+    def batch_bucket(b: int) -> int:
+        """Power-of-two batch bucket (1 stays 1): ragged last batches pad up
+        instead of compiling a fresh decode program per exact batch size."""
+        return 1 if b <= 1 else 1 << (b - 1).bit_length()
 
     def generate(self, tokens, max_new_tokens: int, greedy: bool = True,
                  seed: int = 0):
         """Autoregressive continuation: returns ``(B, T0 + max_new_tokens)``
         int tokens (prompt + generated). One compiled ``lax.scan`` over a
         static KV cache — the prompt prefills through the same step program,
-        so decode costs one dispatch total, not one per token."""
+        so decode costs one dispatch total, not one per token. Programs key
+        on (batch bucket, prompt bucket, scan bucket): ragged batches pad to
+        the next power of two and masked rows are sliced off the output."""
         import jax
         import jax.numpy as jnp
 
         from ... import autograd
         from ...ndarray.ndarray import NDArray
+        from ...step_cache import ProgramCache
         raw = tokens.data if isinstance(tokens, NDArray) else jnp.asarray(tokens)
         B, T0 = raw.shape
         if T0 < 1:
@@ -233,23 +295,23 @@ class TransformerLM(HybridBlock):
             raise ValueError(f"prompt {T0} + {max_new_tokens} new exceeds "
                              f"max_len {self._max_len}")
 
-        def bucket(n):                      # share programs per 32-bucket
-            return min(self._max_len, -(-n // 32) * 32)
-
-        P, TOT = bucket(T0), bucket(total)
-        key = (B, P, TOT, bool(greedy))
+        BB = self.batch_bucket(B)
+        P, TOT = self.length_bucket(T0), self.length_bucket(total)
+        key = (BB, P, TOT, bool(greedy))
         cache = getattr(self, "_gen_fns", None)
         if cache is None:
-            cache = self._gen_fns = {}
-        fn = cache.get(key)
-        if fn is None:
-            fn = cache[key] = self._build_generate(B, P, TOT, greedy)
-        padded = jnp.zeros((B, P), jnp.int32).at[:, :T0].set(
+            cache = self._gen_fns = ProgramCache("generate")
+        fn = cache.get_or_build(
+            key, lambda: self._build_generate(BB, P, TOT, greedy))
+        padded = jnp.zeros((BB, P), jnp.int32).at[:B, :T0].set(
             raw.astype(jnp.int32))
-        outs = fn(self._gen_params(), padded, jnp.int32(T0),
-                  jax.random.key(seed))
+        if greedy:
+            outs = fn(self._gen_params(), padded, jnp.int32(T0))
+        else:
+            outs = fn(self._gen_params(), padded, jnp.int32(T0),
+                      jax.random.key(seed))
         # outs[t] is the token sampled AFTER position t; stitch prompt + tail
-        gen = outs[:, T0 - 1:total - 1]
+        gen = outs[:B, T0 - 1:total - 1]
         return NDArray(jnp.concatenate([raw.astype(jnp.int32), gen], axis=1))
 
 

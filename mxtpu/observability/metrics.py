@@ -304,6 +304,72 @@ def reset_resilience_stats():
 
 
 # ---------------------------------------------------------------------------
+# serving observability (mxtpu.serving engine counters)
+# ---------------------------------------------------------------------------
+
+_SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
+                 "cancelled": 0, "rejected": 0, "expired": 0,
+                 "prefills": 0, "decode_steps": 0, "tokens_out": 0,
+                 "kv_promotions": 0,
+                 "queue_depth_max": 0, "slots": 0,
+                 "slot_occupancy_sum": 0.0, "occupancy_samples": 0,
+                 "ttft_ms_total": 0.0, "ttft_ms_last": 0.0,
+                 "queue_wait_ms_total": 0.0, "queue_wait_ms_last": 0.0}
+_serving = dict(_SERVING_ZERO)
+
+
+def record_serving(key: str, n=1):
+    """One serving-engine event (``mxtpu.serving.engine``): request
+    lifecycle counts (submitted/admitted/completed/cancelled/rejected/
+    expired), prefill and decode-step dispatches, tokens emitted, KV-bucket
+    promotions, latency accumulators. ``*_last`` keys assign, ``*_max`` keys
+    take the high-water mark, everything else accumulates."""
+    with _stats_lock:
+        if key.endswith("_last"):
+            _serving[key] = n
+            base = key[:-5] + "_total"
+            if base in _serving:
+                _serving[base] += n
+        elif key.endswith("_max"):
+            if n > _serving[key]:
+                _serving[key] = n
+        elif key == "slots":
+            _serving[key] = int(n)
+        else:
+            _serving[key] += n
+
+
+def record_serving_occupancy(active_slots: int, total_slots: int):
+    """One decode-step occupancy sample (active slots / capacity) — the
+    utilization series behind ``get_serving_stats()['slot_occupancy']``."""
+    with _stats_lock:
+        _serving["slots"] = int(total_slots)
+        _serving["slot_occupancy_sum"] += \
+            active_slots / max(1, total_slots)
+        _serving["occupancy_samples"] += 1
+
+
+def get_serving_stats() -> dict:
+    """Serving-engine counters (request lifecycle, decode steps, tokens out,
+    TTFT/queue-wait accumulators, mean slot occupancy, KV promotions) — the
+    observability contract of :class:`mxtpu.serving.ServingEngine`.
+    ``bench.py serving`` reads these; ``docs/serving.md`` has the diagnosis
+    guide (e.g. rejected≫0 → raise queue depth; occupancy≈1 with queue
+    growth → raise MXTPU_SERVING_SLOTS)."""
+    with _stats_lock:
+        out = dict(_serving)
+    samples = out.pop("occupancy_samples")
+    occ_sum = out.pop("slot_occupancy_sum")
+    out["slot_occupancy"] = (occ_sum / samples) if samples else 0.0
+    return out
+
+
+def reset_serving_stats():
+    with _stats_lock:
+        _serving.update(_SERVING_ZERO)
+
+
+# ---------------------------------------------------------------------------
 # sanitizer observability (mxtpu.analysis.sanitize counters)
 # ---------------------------------------------------------------------------
 

@@ -257,12 +257,18 @@ class Watchdog:
     Default stall policy: render + log the :class:`StallReport`, run the
     registered emergency save (in a side thread, bounded by ``grace_s``),
     then ``os._exit(87)`` so the supervisor restarts from the last commit.
-    Pass ``on_stall`` to fully replace that policy (tests; embedders)."""
+    Pass ``on_stall`` to fully replace that policy (tests; embedders).
+
+    ``source`` picks which heartbeat gates the deadline (default ``"step"``
+    for training loops; the serving engine arms one on ``"serving"`` so a
+    wedged decode dispatch aborts the same way a wedged train step does).
+    Non-gating sources still land in the report either way."""
 
     def __init__(self, deadline_s: Optional[float] = None,
                  poll_s: Optional[float] = None,
                  on_stall: Optional[Callable[[StallReport], None]] = None,
-                 grace_s: Optional[float] = None):
+                 grace_s: Optional[float] = None,
+                 source: str = "step"):
         if deadline_s is None:
             raw = os.environ.get(ENV_DEADLINE, "")
             deadline_s = float(raw) if raw else None
@@ -270,6 +276,7 @@ class Watchdog:
             raise ValueError(
                 f"Watchdog needs a positive deadline (arg or {ENV_DEADLINE})")
         self.deadline_s = float(deadline_s)
+        self.source = source
         self.poll_s = poll_s if poll_s is not None \
             else max(0.05, min(self.deadline_s / 4.0, 1.0))
         self.on_stall = on_stall
@@ -329,7 +336,7 @@ class Watchdog:
     def _step_age(self) -> float:
         now = time.monotonic()
         with self._lock:
-            last = self._beats.get("step", self._t_start)
+            last = self._beats.get(self.source, self._t_start)
         return now - last
 
     def _monitor(self) -> None:
@@ -344,10 +351,11 @@ class Watchdog:
             beats = {src: {"count": self._counts.get(src, 0),
                            "age_s": now - t}
                      for src, t in self._beats.items()}
-            if "step" not in beats:
-                beats["step"] = {"count": 0, "age_s": now - self._t_start}
-        return StallReport(self.deadline_s, beats["step"]["age_s"], beats,
-                           _span_tails(), _thread_stacks())
+            if self.source not in beats:
+                beats[self.source] = {"count": 0,
+                                      "age_s": now - self._t_start}
+        return StallReport(self.deadline_s, beats[self.source]["age_s"],
+                           beats, _span_tails(), _thread_stacks())
 
     def _handle_stall(self) -> None:
         report = self._build_report()

@@ -1,0 +1,27 @@
+"""mxtpu.serving — the online serving subsystem.
+
+Two serving modes, one package:
+
+* **Offline / throughput** — :class:`ChainedPredictor` (the original
+  ``mxtpu/serving.py`` surface, unchanged): one compiled scan over a stack
+  of pre-collected batches, amortizing the per-call dispatch floor.
+* **Online / latency** — :class:`ServingEngine`: continuous batching over a
+  fixed slot batch with bucketed KV admission, prefill/decode split,
+  deadlines, cancellation, and explicit backpressure. ``submit()`` from any
+  thread; greedy output is bit-exact with per-request
+  ``TransformerLM.generate``.
+
+See ``docs/serving.md`` for architecture, knobs, and the latency/goodput
+methodology behind ``bench.py serving``.
+"""
+
+from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING,
+                  DeadlineExceeded, QueueFullError, RequestCancelled,
+                  ServingRequest)
+from .chained import ChainedPredictor
+from .engine import ServingEngine
+from . import kv
+
+__all__ = ["ChainedPredictor", "ServingEngine", "ServingRequest",
+           "QueueFullError", "RequestCancelled", "DeadlineExceeded",
+           "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "kv"]

@@ -1,0 +1,188 @@
+"""Request objects and error surface for the serving engine.
+
+A :class:`ServingRequest` is the handle ``ServingEngine.submit()`` returns:
+the caller blocks on :meth:`result`, iterates :meth:`stream` for tokens as
+they decode, or calls :meth:`cancel`. All cross-thread state lives behind
+the request's own condition variable — the scheduler thread delivers tokens
+and terminal states through :meth:`_emit`/:meth:`_finish`, submitters only
+ever read.
+
+Backpressure is explicit: a full admission queue raises
+:exc:`QueueFullError` from ``submit()`` (recorded as ``rejected`` in
+``get_serving_stats()``) instead of growing without bound — the caller
+decides whether to shed, retry, or block.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["ServingRequest", "QueueFullError", "RequestCancelled",
+           "DeadlineExceeded", "PENDING", "RUNNING", "DONE", "CANCELLED",
+           "EXPIRED"]
+
+PENDING = "pending"        # admitted to the queue, not yet prefilled
+RUNNING = "running"        # occupying a decode slot (or mid-prefill)
+DONE = "done"              # every requested token delivered
+CANCELLED = "cancelled"    # caller cancelled (or the engine shut down)
+EXPIRED = "expired"        # deadline passed before completion
+
+_TERMINAL = frozenset({DONE, CANCELLED, EXPIRED})
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — the submit was rejected, not queued."""
+
+
+class RequestCancelled(RuntimeError):
+    """result() on a request that was cancelled before completing."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """result() on a request whose deadline passed before completing."""
+
+
+_ids = itertools.count()
+
+
+class ServingRequest:
+    """One in-flight generation request.
+
+    ``prompt`` is the token-id list, ``max_new`` the number of tokens to
+    generate, ``deadline_s`` an optional completion budget measured from
+    submit time (the engine retires the request as :data:`EXPIRED` at the
+    first step boundary past it; partial tokens are kept)."""
+
+    def __init__(self, prompt, max_new: int,
+                 deadline_s: Optional[float] = None):
+        self.id = next(_ids)
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("empty prompt (give a BOS token for "
+                             "unconditional generation)")
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        self.max_new = int(max_new)
+        self.t_submit = time.monotonic()
+        self.deadline = None if deadline_s is None \
+            else self.t_submit + float(deadline_s)
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.state = PENDING
+        self.error: Optional[BaseException] = None
+        self._tokens: List[int] = []
+        self._cancel = False
+        self._cond = threading.Condition()
+
+    # -- caller side --------------------------------------------------------
+    @property
+    def total(self) -> int:
+        return len(self.prompt) + self.max_new
+
+    def done(self) -> bool:
+        with self._cond:
+            return self.state in _TERMINAL
+
+    def cancel(self) -> None:
+        """Ask the engine to drop this request at the next step boundary
+        (immediately if still queued). Idempotent; a no-op once terminal."""
+        with self._cond:
+            self._cancel = True
+            self._cond.notify_all()
+
+    def tokens(self) -> List[int]:
+        """Generated tokens delivered so far (prompt excluded)."""
+        with self._cond:
+            return list(self._tokens)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until terminal; returns the full generated-token list.
+        Raises :exc:`RequestCancelled` / :exc:`DeadlineExceeded` (carrying
+        any partial tokens on ``.args[1]``) for the non-DONE terminals, and
+        ``TimeoutError`` if ``timeout`` elapses first."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self.state not in _TERMINAL:
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    raise TimeoutError(
+                        f"request {self.id} not finished in {timeout}s")
+                self._cond.wait(timeout=left)
+            if self.state == DONE:
+                return list(self._tokens)
+            if self.error is not None:
+                raise self.error
+            if self.state == CANCELLED:
+                raise RequestCancelled(
+                    f"request {self.id} cancelled", list(self._tokens))
+            raise DeadlineExceeded(
+                f"request {self.id} missed its deadline", list(self._tokens))
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield generated tokens as the engine delivers them; returns when
+        the request goes terminal (raising like :meth:`result` for the
+        non-DONE terminals). ``timeout`` bounds each wait for the NEXT
+        token, not the whole stream."""
+        seen = 0
+        while True:
+            with self._cond:
+                if seen == len(self._tokens) and self.state not in _TERMINAL:
+                    if not self._cond.wait(timeout=timeout):
+                        raise TimeoutError(
+                            f"request {self.id}: no token in {timeout}s")
+                fresh = self._tokens[seen:]
+                state = self.state
+                err = self.error
+            for t in fresh:
+                seen += 1
+                yield t
+            if state in _TERMINAL and seen == len(self.tokens()):
+                if state == CANCELLED:
+                    raise RequestCancelled(
+                        f"request {self.id} cancelled", self.tokens())
+                if state == EXPIRED:
+                    raise DeadlineExceeded(
+                        f"request {self.id} missed its deadline",
+                        self.tokens())
+                if err is not None:
+                    raise err
+                return
+
+    # -- engine (scheduler-thread) side -------------------------------------
+    def _cancelled(self) -> bool:
+        with self._cond:
+            return self._cancel
+
+    def _expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def _emit(self, toks, now: float) -> int:
+        """Deliver generated tokens (capped at ``max_new``); returns how
+        many the request still wants after this delivery."""
+        with self._cond:
+            room = self.max_new - len(self._tokens)
+            fresh = [int(t) for t in toks[:room]]
+            if fresh and self.t_first_token is None:
+                self.t_first_token = now
+            self._tokens.extend(fresh)
+            remaining = self.max_new - len(self._tokens)
+            self._cond.notify_all()
+        return remaining
+
+    def _finish(self, state: str, now: float,
+                error: Optional[BaseException] = None) -> None:
+        with self._cond:
+            if self.state in _TERMINAL:
+                return
+            self.state = state
+            self.error = error
+            self.t_done = now
+            self._cond.notify_all()
+
+    def _set_state(self, state: str) -> None:
+        with self._cond:
+            if self.state not in _TERMINAL:
+                self.state = state

@@ -18,14 +18,15 @@ its own trace time, which leaks tracers when traced inside the outer jit
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Sequence, Tuple
+from typing import Iterable, List, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from . import autograd
-from .ndarray.ndarray import NDArray
+from .. import autograd
+from ..ndarray.ndarray import NDArray
+from ..step_cache import ProgramCache
 
 __all__ = ["ChainedPredictor"]
 
@@ -36,6 +37,10 @@ class ChainedPredictor:
     ``chain`` batches are stacked to ``(chain, B, ...)`` and one compiled
     ``lax.scan`` produces all outputs; programs are cached per
     (chain, batch shape, dtype) — a short tail chain compiles once more.
+    The cache is a bounded LRU (``MXTPU_SERVING_PROGRAM_CACHE``) counted
+    under ``serving_chained`` in ``profiler.get_compile_stats()``, so
+    serving-side shape churn neither grows without limit nor hides from the
+    retrace forensics.
     """
 
     def __init__(self, block, chain: int = 8):
@@ -49,28 +54,26 @@ class ChainedPredictor:
                 "block.hybridize(False) first")
         self._block = block
         self.chain = int(chain)
-        self._fns: Dict[Tuple, object] = {}
+        self._fns = ProgramCache("serving_chained")
 
     def _fn(self, n: int, shape: Tuple[int, ...], dtype):
         key = (n,) + tuple(shape) + (str(dtype),)
-        got = self._fns.get(key)
-        if got is not None:
-            return got
         block = self._block
 
-        def run(stack):
-            def step(carry, xb):
-                with autograd.predict_mode():
-                    out = block(NDArray(xb))
-                outs = (tuple(o.data for o in out)
-                        if isinstance(out, (tuple, list)) else (out.data,))
-                return carry, outs
-            _, outs = lax.scan(step, jnp.zeros((), jnp.float32), stack)
-            return outs
+        def build():
+            def run(stack):
+                def step(carry, xb):
+                    with autograd.predict_mode():
+                        out = block(NDArray(xb))
+                    outs = (tuple(o.data for o in out)
+                            if isinstance(out, (tuple, list))
+                            else (out.data,))
+                    return carry, outs
+                _, outs = lax.scan(step, jnp.zeros((), jnp.float32), stack)
+                return outs
+            return jax.jit(run)
 
-        fn = jax.jit(run)
-        self._fns[key] = fn
-        return fn
+        return self._fns.get_or_build(key, build)
 
     def predict_stack(self, stack) -> List[NDArray]:
         """(n, B, ...) stacked batches → list over outputs of (n, B, ...)."""

@@ -1,0 +1,377 @@
+"""Continuous-batching serving engine (Orca-style step-boundary scheduling).
+
+:class:`ServingEngine` is the online front door over a decode-capable model
+(anything exposing ``serving_step`` / ``_gen_params`` — ``TransformerLM`` in
+the zoo): callers ``submit()`` token prompts from any thread; one scheduler
+thread runs the slot batch.
+
+The data path, end to end:
+
+1. **Admission** — ``submit()`` drops the request into a bounded queue
+   (full → :exc:`QueueFullError`, the backpressure contract). A
+   :class:`DeviceFeed` producer stages each prompt device-resident (padded
+   to its 32-token bucket) so admission never pays a host→device transfer
+   inside the decode loop; the scheduler drains it with the non-blocking
+   ``poll()``.
+2. **Prefill** — the prompt runs through a separate B=1 chunked program
+   (``kv.build_prefill``, keyed per prompt bucket) producing the request's
+   KV page plus its first token(s); the page is merged into a free slot row
+   of the engine's static ``(L, 2, slots, H, TOT, D)`` cache. TTFT is
+   prefill latency — a long prompt never stalls the in-flight slot batch.
+3. **Decode** — ``kv.build_decode`` runs ``chunk`` greedy steps over ALL
+   slots per dispatch; per-slot token/position/active/limit arrays are
+   traced inputs, so requests retiring and joining between dispatches reuse
+   the same compiled program (ONE trace per (slots, TOT bucket) — the
+   compile-guard contract). Finished/cancelled/expired requests retire at
+   chunk boundaries and their slots are immediately re-admissible.
+
+Guardrails: every dispatch heartbeats the resilience watchdog on the
+``serving`` source (arm with ``MXTPU_SERVING_STALL_S``), spans land in the
+unified trace under ``serving/*``, and counters in
+``profiler.get_serving_stats()``.
+
+Knobs: ``MXTPU_SERVING_SLOTS`` (slot-batch capacity, default 4),
+``MXTPU_SERVING_QUEUE`` (admission queue depth, default 16),
+``MXTPU_SERVING_CHUNK`` (decode steps per dispatch, default 8),
+``MXTPU_SERVING_PROGRAM_CACHE`` (LRU bound on the program caches).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import profiler
+from ..device_feed import DeviceFeed
+from ..ndarray.ndarray import NDArray
+from ..observability import tracer
+from ..resilience.watchdog import Watchdog, heartbeat
+from ..step_cache import ProgramCache
+from . import kv
+from .api import (CANCELLED, DONE, EXPIRED, RUNNING, QueueFullError,
+                  ServingRequest)
+
+__all__ = ["ServingEngine"]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, str(default))))
+    except ValueError:
+        return default
+
+
+class ServingEngine:
+    """Online continuous-batching server over one decode-capable model.
+
+    Greedy decoding only (the bit-exactness contract is argmax vs solo
+    ``generate``); sampling requests belong on a per-request ``generate``
+    path until the engine grows per-slot rng lanes."""
+
+    def __init__(self, model, slots: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 chunk: Optional[int] = None,
+                 stall_deadline_s: Optional[float] = None):
+        self._model = model
+        self.slots = slots if slots else _env_int("MXTPU_SERVING_SLOTS", 4)
+        self.queue_depth = queue_depth if queue_depth \
+            else _env_int("MXTPU_SERVING_QUEUE", 16)
+        self.chunk = chunk if chunk else _env_int("MXTPU_SERVING_CHUNK", 8)
+        if stall_deadline_s is None:
+            raw = os.environ.get("MXTPU_SERVING_STALL_S", "")
+            stall_deadline_s = float(raw) if raw else None
+        self._stall_deadline_s = stall_deadline_s
+        self._submit_q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        self._start_lock = threading.Lock()
+        self._decode_fns = ProgramCache("serving_decode")
+        self._prefill_fns = ProgramCache("serving_prefill")
+        self._stop = threading.Event()
+        self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._feed: Optional[DeviceFeed] = None
+        self._wd: Optional[Watchdog] = None
+        self._error: Optional[BaseException] = None
+        # slot state (scheduler-thread-owned; riders of the decode trace)
+        self._params = None
+        self._caches = None
+        self._TOT: Optional[int] = None
+        self._tok = np.zeros(self.slots, np.int32)
+        self._p = np.zeros(self.slots, np.int32)
+        self._limit = np.zeros(self.slots, np.int32)
+        self._active = np.zeros(self.slots, bool)
+        self._left = np.zeros(self.slots, np.int64)
+        self._reqs: List[Optional[ServingRequest]] = [None] * self.slots
+
+    # -- public surface ------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        with self._start_lock:
+            if self._thread is not None:
+                return self
+            self._materialize_params()
+            profiler.record_serving("slots", self.slots)
+            self._feed = DeviceFeed(self._staging_source(), depth=2)
+            if self._stall_deadline_s:
+                self._wd = Watchdog(deadline_s=self._stall_deadline_s,
+                                    source="serving").start()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="mxtpu-serving-scheduler")
+            self._thread.start()
+            self._started.set()
+        return self
+
+    def submit(self, prompt, max_new_tokens: int,
+               deadline_s: Optional[float] = None) -> ServingRequest:
+        """Enqueue one generation request; returns its handle immediately.
+        Raises :exc:`QueueFullError` when the admission queue is at
+        capacity (backpressure, not silent growth) and ``ValueError`` for
+        requests the model can't hold."""
+        if self._stop.is_set():
+            raise RuntimeError("ServingEngine is stopped")
+        req = ServingRequest(prompt, max_new_tokens, deadline_s)
+        if req.total > self._model._max_len:
+            raise ValueError(
+                f"prompt {len(req.prompt)} + {req.max_new} new exceeds "
+                f"max_len {self._model._max_len}")
+        if self._thread is None:
+            self.start()
+        try:
+            self._submit_q.put_nowait(req)
+        except queue.Full:
+            profiler.record_serving("rejected")
+            tracer.instant("serving/reject", cat="serving",
+                           args={"id": req.id})
+            raise QueueFullError(
+                f"admission queue full ({self.queue_depth}); request "
+                f"{req.id} rejected") from None
+        profiler.record_serving("submitted")
+        profiler.record_serving("queue_depth_max", self._submit_q.qsize())
+        return req
+
+    def stats(self) -> dict:
+        return profiler.get_serving_stats()
+
+    def stop(self) -> None:
+        """Stop the scheduler; queued and in-flight requests are finished
+        as CANCELLED so no caller blocks forever."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+        if self._feed is not None:
+            self._feed.close()
+        if self._wd is not None:
+            self._wd.stop()
+        if self._error is not None:
+            raise self._error
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self.stop()          # a latched scheduler error surfaces here
+        else:
+            try:
+                self.stop()
+            except BaseException:   # mxtpu: ignore[R005] — the body's
+                pass                # exception wins over teardown's
+        return False
+
+    # -- staging (DeviceFeed producer thread) --------------------------------
+    def _staging_source(self):
+        """Blocking iterator the DeviceFeed producer pulls: pops submitted
+        requests and pads their prompt to its 32-token bucket so the feed
+        stages a device-resident ``(1, PB)`` int32 array per request."""
+        while True:
+            try:
+                req = self._submit_q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            PB = kv.bucket32(len(req.prompt), self._model._max_len)
+            padded = np.zeros((1, PB), np.int32)
+            padded[0, :len(req.prompt)] = req.prompt
+            yield (req, NDArray(padded))
+
+    # -- scheduler thread ----------------------------------------------------
+    def _materialize_params(self) -> None:
+        pars = self._model.collect_params().values()
+        if any(p._data is None for p in pars):
+            from .. import autograd
+            with autograd.predict_mode():
+                self._model(NDArray(np.zeros((1, 1), np.int32)))
+        self._params = self._model._gen_params()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                heartbeat("serving")
+                busy = bool(self._active.any())
+                self._admit(wait_s=0.0 if busy else 0.02)
+                if self._active.any():
+                    self._decode_chunk()
+        except BaseException as e:
+            self._error = e
+        finally:
+            self._shutdown_sweep()
+
+    def _free_slot(self) -> Optional[int]:
+        idle = np.flatnonzero(~self._active)
+        return int(idle[0]) if idle.size else None
+
+    def _admit(self, wait_s: float) -> None:
+        while True:
+            slot = self._free_slot()
+            if slot is None or self._feed is None:
+                return
+            try:
+                item = self._feed.poll(timeout=wait_s)
+            except StopIteration:
+                return
+            if item is None:
+                return
+            wait_s = 0.0
+            req, staged = item
+            now = time.monotonic()
+            if req._cancelled():
+                req._finish(CANCELLED, now)
+                profiler.record_serving("cancelled")
+                continue
+            if req._expired(now):
+                req._finish(EXPIRED, now)
+                profiler.record_serving("expired")
+                continue
+            self._prefill(req, staged, slot, now)
+
+    def _prefill(self, req: ServingRequest, staged, slot: int,
+                 now: float) -> None:
+        model = self._model
+        t0 = len(req.prompt)
+        PB = staged.shape[1]
+        req._set_state(RUNNING)
+        profiler.record_serving("admitted")
+        profiler.record_serving("queue_wait_ms_last",
+                                (now - req.t_submit) * 1e3)
+        self._ensure_capacity(kv.bucket32(req.total, model._max_len))
+        with tracer.span("serving/prefill", cat="serving",
+                         args={"id": req.id, "t0": t0, "bucket": PB}):
+            fn = self._prefill_fns.get_or_build(
+                (PB,), lambda: kv.build_prefill(model, PB))
+            page, outs = fn(self._params, staged.data, jnp.int32(t0))
+            outs_np = np.asarray(outs)
+        done_t = time.monotonic()
+        # prefill emits the tokens for positions t0..PB (see kv.py); a short
+        # request can therefore complete at admission without taking a slot
+        left = req._emit(outs_np[t0 - 1:].tolist(), done_t)
+        delivered = req.max_new - left
+        profiler.record_serving("prefills")
+        profiler.record_serving("tokens_out", delivered)
+        profiler.record_serving("ttft_ms_last",
+                                (done_t - req.t_submit) * 1e3)
+        if left == 0:
+            req._finish(DONE, done_t)
+            profiler.record_serving("completed")
+            return
+        self._caches = kv.merge_page(self._caches, page, slot)
+        self._tok[slot] = outs_np[-1]        # the token at position PB
+        self._p[slot] = PB                   # next position to feed
+        self._limit[slot] = req.total - 1
+        self._active[slot] = True
+        self._left[slot] = left
+        self._reqs[slot] = req
+
+    def _ensure_capacity(self, need: int) -> None:
+        if self._TOT is None:
+            self._TOT = need
+            self._caches = kv.empty_cache(self._model, self.slots, need)
+        elif need > self._TOT:
+            with tracer.span("serving/kv_promote", cat="serving",
+                             args={"from": self._TOT, "to": need}):
+                self._caches = kv.promote(self._caches, need)
+            self._TOT = need
+            profiler.record_serving("kv_promotions")
+
+    def _decode_chunk(self) -> None:
+        n_active = int(self._active.sum())
+        with tracer.span("serving/decode", cat="serving",
+                         args={"active": n_active, "tot": self._TOT}):
+            key = (self.slots, self._TOT, self.chunk)
+            fn = self._decode_fns.get_or_build(
+                key, lambda: kv.build_decode(self._model, *key))
+            caches, tok, p, toks, lives = fn(
+                self._params, self._caches, jnp.asarray(self._tok),
+                jnp.asarray(self._p), jnp.asarray(self._active),
+                jnp.asarray(self._limit))
+            toks_np = np.asarray(toks)
+            lives_np = np.asarray(lives)
+        self._caches = caches
+        self._tok = np.array(tok)   # owned copies: the slot state is
+        self._p = np.array(p)       # mutated at retire/admit boundaries
+        now = time.monotonic()
+        profiler.record_serving("decode_steps")
+        profiler.record_serving_occupancy(n_active, self.slots)
+        for slot in np.flatnonzero(self._active):
+            req = self._reqs[slot]
+            fresh = toks_np[lives_np[:, slot], slot]
+            if fresh.size:
+                left = req._emit(fresh.tolist(), now)
+                profiler.record_serving("tokens_out",
+                                        int(self._left[slot] - left))
+                self._left[slot] = left
+            if self._left[slot] == 0:
+                self._retire(slot, DONE, now)
+            elif req._cancelled():
+                self._retire(slot, CANCELLED, now)
+            elif req._expired(now):
+                self._retire(slot, EXPIRED, now)
+
+    def _retire(self, slot: int, state: str, now: float) -> None:
+        req = self._reqs[slot]
+        req._finish(state, now)
+        profiler.record_serving({DONE: "completed", CANCELLED: "cancelled",
+                                 EXPIRED: "expired"}[state])
+        tracer.instant("serving/retire", cat="serving",
+                       args={"id": req.id, "state": state})
+        self._reqs[slot] = None
+        self._active[slot] = False
+        self._tok[slot] = 0
+        self._p[slot] = 0
+        self._limit[slot] = 0
+        self._left[slot] = 0
+
+    def _shutdown_sweep(self) -> None:
+        """Terminal sweep: nothing submitted may block forever — in-slot,
+        staged, and still-queued requests all finish CANCELLED."""
+        self._stop.set()     # scheduler may exit via error with stop unset
+        now = time.monotonic()
+        for slot in np.flatnonzero(self._active):
+            self._retire(int(slot), CANCELLED, now)
+        # staged by the feed but never admitted: drain until the producer's
+        # end marker (it sees the stop flag within its 0.1s poll)
+        deadline = time.monotonic() + 5.0
+        while self._feed is not None and time.monotonic() < deadline:
+            try:
+                item = self._feed.poll(timeout=0.2)
+            except StopIteration:
+                break
+            except Exception:   # producer died mid-teardown: nothing to drain
+                break
+            if item is None:
+                continue
+            item[0]._finish(CANCELLED, now)
+            profiler.record_serving("cancelled")
+        while True:                    # never even staged
+            try:
+                req = self._submit_q.get_nowait()
+            except queue.Empty:
+                break
+            req._finish(CANCELLED, now)
+            profiler.record_serving("cancelled")

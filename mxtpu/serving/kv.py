@@ -1,0 +1,148 @@
+"""Bucketed KV-cache admission — the paged-memory half of the serving engine.
+
+The continuous-batching decode loop (engine.py) runs over ONE static
+``(L, 2, slots, H, TOT, D)`` KV cache; this module owns every decision about
+that array's shape and contents:
+
+* **32-token buckets** — ``TOT`` is always ``bucket32(n)`` of the longest
+  admitted request's total length, the same rounding ``TransformerLM
+  .generate`` keys its programs on, so the engine and solo decode share
+  bucket geometry (and a mixed-length request stream shares a handful of
+  compiled programs instead of one per length).
+* **Per-slot pages** — each request owns one slot row of the cache
+  (``[:, :, s]``); :meth:`TransformerLM.serving_step` scatters strictly
+  per-slot, so admission is just "overwrite row s with the prefilled page".
+* **Promotion** — when an incoming request's total length outgrows ``TOT``,
+  :func:`promote` zero-pads the cache into the next bucket; decode re-keys
+  on the new ``TOT`` and compiles at most once per bucket ever seen.
+* **Prefill/decode split** — long prompts prefill through a separate B=1
+  program over their OWN prompt bucket (:func:`build_prefill`) instead of
+  stalling the slot batch; the produced page is merged into the slot row by
+  :func:`merge_page`. The prefill scan body is exactly ``_build_generate``'s
+  greedy body, which is what makes engine output bit-exact with solo
+  ``generate`` by construction rather than by test luck.
+
+Decode-step semantics (shared with ``generate`` via ``serving_step``):
+feeding position ``p`` consumes the token AT ``p``, writes its K/V at ``p``,
+and emits the token FOR ``p + 1``. A request with prompt length ``t0`` and
+``max_new`` generated tokens spans positions ``0 .. total-1``
+(``total = t0 + max_new``); the last position worth feeding is
+``total - 2``, so a slot is *live* while ``p < limit`` with
+``limit = total - 1``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["bucket32", "cache_dims", "empty_cache", "promote", "merge_page",
+           "build_prefill", "build_decode"]
+
+
+def bucket32(n: int, max_len: int) -> int:
+    """32-token length bucket, capped at the model's position table."""
+    return min(max_len, -(-n // 32) * 32)
+
+
+def cache_dims(model) -> Tuple[int, int, int]:
+    """``(L, H, D)`` of the model's KV cache (layers, heads, head dim)."""
+    H = model.blocks[0].attn._heads
+    return len(model.blocks), H, model._units // H
+
+
+def empty_cache(model, slots: int, TOT: int, dtype=jnp.float32):
+    L, H, D = cache_dims(model)
+    return jnp.zeros((L, 2, slots, H, TOT, D), dtype)
+
+
+def promote(caches, TOT_new: int):
+    """Zero-pad the cache into a bigger TOT bucket (request outgrew its
+    page). Positions past the old TOT are unwritten by definition, so the
+    pad is content-preserving; per-slot state (p/limit/tok) is untouched."""
+    L, two, S, H, TOT_old, D = caches.shape
+    if TOT_new <= TOT_old:
+        return caches
+    return jnp.zeros((L, two, S, H, TOT_new, D), caches.dtype) \
+        .at[..., :TOT_old, :].set(caches)
+
+
+def merge_page(caches, page, slot: int):
+    """Install a prefilled ``(L, 2, 1, H, PB, D)`` page as slot row ``slot``
+    of the engine cache (zeroing the row's tail past PB — stale K/V from
+    the slot's previous tenant must not survive admission)."""
+    PB = page.shape[4]
+    row = jnp.zeros(caches.shape[:2] + caches.shape[3:], caches.dtype) \
+        .at[..., :PB, :].set(page[:, :, 0])
+    return caches.at[:, :, slot].set(row)
+
+
+def build_prefill(model, PB: int):
+    """One compiled B=1 prefill program for prompt bucket ``PB``: scans
+    :meth:`serving_step` over positions ``0..PB-1``, forcing prompt tokens
+    while ``t < t0`` and feeding back the greedy argmax beyond — byte-for-
+    byte the greedy ``_build_generate`` body, so the page AND the emitted
+    tokens match what solo ``generate`` would have produced.
+
+    Returns ``prefill(params, prompt (1, PB) int32, t0) ->
+    (page (L,2,1,H,PB,D), outs (PB,) int32)`` where ``outs[t]`` is the
+    token for position ``t + 1``; the valid generated tokens are
+    ``outs[t0-1 : PB]`` (positions ``t0..PB``), i.e. prefill always hands
+    the request its first ``PB - t0 + 1`` tokens at admission — TTFT is
+    prefill latency, and a short request may complete without ever
+    occupying a decode slot."""
+    L, H, D = cache_dims(model)
+    step = model.serving_step(1, PB)
+
+    def run(params, prompt, t0):
+        page0 = jnp.zeros((L, 2, 1, H, PB, D), params["embed"].dtype)
+
+        def body(carry, t):
+            page, prev = carry
+            tok = jnp.where(t < t0, prompt[:, jnp.minimum(t, PB - 1)], prev)
+            pos = jnp.full((1,), t, jnp.int32)
+            new_page, logits = step(params, page, tok, pos)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (new_page, nxt), nxt
+
+        init = (page0, jnp.zeros((1,), jnp.int32))
+        (page, _), outs = lax.scan(body, init,
+                                   jnp.arange(PB, dtype=jnp.int32))
+        return page, outs[:, 0]
+
+    return jax.jit(run)
+
+
+def build_decode(model, S: int, TOT: int, chunk: int):
+    """One compiled continuous-batching decode program for (slots ``S``,
+    KV bucket ``TOT``): ``chunk`` greedy steps over the slot batch with all
+    per-slot state — token, position, active flag, live limit — riding as
+    TRACED arrays, so requests joining/retiring between dispatches never
+    retrace (the compile-guard test pins exactly one trace per (S, TOT)).
+
+    Returns ``decode(params, caches, tok, p, active, limit) ->
+    (caches, tok, p, toks (chunk, S), lives (chunk, S))``. Per inner step a
+    slot is live while ``active & (p < limit)``; dead slots freeze (token
+    and position held, their rewrites land only in their own already-
+    retired row) and the host consumes ``toks[j, s]`` only where
+    ``lives[j, s]``."""
+    step = model.serving_step(S, TOT)
+
+    def run(params, caches, tok, p, active, limit):
+        def body(carry, _):
+            caches, tok, p = carry
+            live = active & (p < limit)
+            new_caches, logits = step(params, caches, tok, p)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            tok2 = jnp.where(live, nxt, tok)
+            p2 = jnp.where(live, p + 1, p)
+            return (new_caches, tok2, p2), (nxt, live)
+
+        (caches, tok, p), (toks, lives) = lax.scan(
+            body, (caches, tok, p), None, length=chunk)
+        return caches, tok, p, toks, lives
+
+    return jax.jit(run)

@@ -20,14 +20,17 @@ loop retrace?" (the reference's equivalent forensic is engine bulk logging).
 
 from __future__ import annotations
 
+import os
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["CacheStats", "cache_stats", "snapshot", "reset_stats",
-           "StepExecutor", "build_update_all", "optimizer_fingerprint"]
+           "ProgramCache", "StepExecutor", "build_update_all",
+           "optimizer_fingerprint"]
 
 
 # ---------------------------------------------------------------------------
@@ -95,6 +98,71 @@ def reset_stats(name: Optional[str] = None):
         for st in targets:
             st.hits = 0
             st.misses = 0
+
+
+# ---------------------------------------------------------------------------
+# bounded signature→program caches (serving-side compile caches)
+# ---------------------------------------------------------------------------
+
+
+def _program_cache_capacity(env: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(env, str(default))))
+    except ValueError:
+        return default
+
+
+class ProgramCache:
+    """Bounded LRU signature→compiled-program cache, registered in the
+    compile-cache registry above.
+
+    ``ChainedPredictor._fns`` and ``TransformerLM._gen_fns`` used to be bare
+    dicts: under serving-side shape churn (a new batch shape / prompt bucket
+    per stream) they grew without limit AND were invisible to
+    ``profiler.get_compile_stats()``. This wrapper bounds them (LRU eviction,
+    capacity from ``MXTPU_SERVING_PROGRAM_CACHE``, default 64) and counts
+    every hit/trace in the named registry entry, so a retrace-leaking serving
+    loop shows up in the same forensics table as the training step."""
+
+    def __init__(self, name: str, capacity: Optional[int] = None,
+                 env: str = "MXTPU_SERVING_PROGRAM_CACHE"):
+        self.name = name
+        self.capacity = capacity if capacity is not None \
+            else _program_cache_capacity(env, 64)
+        self.evictions = 0
+        self._fns: "OrderedDict[Any, Any]" = OrderedDict()
+        self._stats = cache_stats(name)
+
+    def __len__(self) -> int:
+        return len(self._fns)
+
+    def __contains__(self, key) -> bool:
+        return key in self._fns
+
+    def get(self, key):
+        """Cache lookup; counts a hit and refreshes LRU order on success."""
+        fn = self._fns.get(key)
+        if fn is not None:
+            self._fns.move_to_end(key)
+            self._stats.hit()
+        return fn
+
+    def put(self, key, fn):
+        """Insert a freshly traced program (counts a trace); evicts the
+        least-recently-used entry beyond capacity."""
+        self._stats.miss()
+        self._fns[key] = fn
+        self._fns.move_to_end(key)
+        while len(self._fns) > self.capacity:
+            self._fns.popitem(last=False)
+            self.evictions += 1
+        return fn
+
+    def get_or_build(self, key, build):
+        fn = self.get(key)
+        if fn is None:
+            fn = self.put(key, build())
+        return fn
 
 
 # ---------------------------------------------------------------------------

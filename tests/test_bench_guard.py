@@ -46,7 +46,8 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert doc["loss_end"] < doc["loss_start"]       # it actually trained
     # every fallback scenario must keep emitting its keys
     assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
-            "compile_caches", "mfu", "trace", "fsdp", "ratchet"} <= set(doc)
+            "compile_caches", "mfu", "trace", "fsdp", "serving",
+            "ratchet"} <= set(doc)
     # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
     # mid-epoch crash survived by a supervised restart, final params equal
     # to the fault-free baseline
@@ -82,6 +83,22 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
             < fsdp["stage1"]["param_bytes_per_device"]
         assert fsdp["stage2"]["grad_bytes_per_device"] \
             <= fsdp["stage1"]["grad_bytes_per_device"]
+    # serving leg (ISSUE 10): Poisson-arrival continuous batching beat the
+    # serial per-request baseline on the same trace, decode stayed bit-exact
+    # with solo generate, and goodput rides the ratchet
+    serving = doc["serving"]
+    assert "error" not in serving, serving
+    assert serving["decode_match"] is True
+    assert serving["goodput_tok_s"] > 0
+    assert serving["serial_goodput_tok_s"] > 0
+    # headline acceptance is >= 2x; tier-1 asserts a loaded-machine-safe
+    # floor, the full margin is visible in the emitted doc
+    assert serving["goodput_vs_serial"] >= 1.5, serving
+    assert serving["ttft_p99_ms"] >= serving["ttft_p50_ms"] > 0
+    assert serving["completed"] == serving["requests"]
+    assert 0 < serving["slot_occupancy"] <= 1
+    assert doc["ratchet"]["current"]["serving_goodput"] \
+        == serving["goodput_tok_s"]
     # the comm leg's all_to_all anomaly probe shipped its point timing
     a2a = doc.get("comm", {}).get("all_to_all_probe")
     if a2a is not None:
@@ -146,6 +163,20 @@ def test_bench_resilience_scenario_cli(tmp_path):
     assert resil["params_match"] is True
     assert resil["attempts"] == resil["restarts"] + 1
     assert resil["restart_latency_ms"] > 0
+
+
+def test_bench_serving_scenario_cli(tmp_path):
+    """``bench.py serving`` (ISSUE 10): the serving-only CLI path must exit
+    0 and emit a single serving JSON doc — Poisson arrivals, p50/p99 TTFT,
+    goodput vs the serial virtual-clock baseline, bit-exact decode."""
+    doc, _ = _run_fallback_bench(tmp_path, args=("serving",))
+    assert doc["metric"] == "serving_goodput_tok_s"
+    assert doc["value"] > 0
+    serving = doc["serving"]
+    assert serving["decode_match"] is True
+    assert serving["goodput_vs_serial"] >= 1.5, serving
+    assert serving["deadline_ms"] > 0
+    assert serving["per_token_p99_ms"] >= serving["per_token_p50_ms"] > 0
 
 
 def test_bench_sanitized_leg_exits_zero_with_no_violations(tmp_path):

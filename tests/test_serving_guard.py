@@ -1,0 +1,155 @@
+"""Serving-engine guard (ISSUE 10): continuous-batched greedy decode must be
+bit-exact with solo ``TransformerLM.generate`` under staggered arrivals, and
+the engine must compile at most one program per (slots, KV-bucket) — slot
+churn (requests joining/retiring mid-decode) must never retrace.
+
+Engine instances are deliberately scarce here: every ``ServingEngine`` owns
+fresh ``jax.jit`` wrappers, so each instance pays its own XLA compiles. The
+API-surface tests (cancel / deadline / backpressure / stats) share one
+single-slot engine, and the backpressure test never starts its scheduler at
+all — a queue that nobody drains is the only deterministic way to observe
+``QueueFullError``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, profiler
+from mxtpu.gluon.model_zoo import transformer_lm
+from mxtpu.serving import (DeadlineExceeded, QueueFullError, RequestCancelled,
+                           ServingEngine)
+from mxtpu.step_cache import ProgramCache
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.rng.seed(0)
+    model = transformer_lm("tiny", vocab_size=VOCAB)
+    model.initialize()
+    return model
+
+
+def _solo(model, prompt, max_new):
+    out = model.generate(nd.array(np.array([prompt], np.int32)), max_new)
+    return np.asarray(out.data)[0, len(prompt):].tolist()
+
+
+def test_continuous_batching_bit_exact_single_program(net):
+    # mixed lengths, all prompts in the 32-token prefill bucket; the last
+    # request's total fits inside the prefill bucket and must complete at
+    # admission without ever occupying a decode slot
+    rs = np.random.RandomState(3)
+    trace = [(rs.randint(1, VOCAB, size=n).tolist(), new)
+             for n, new in [(3, 40), (17, 30), (9, 45), (26, 35), (5, 12)]]
+    refs = [_solo(net, p, m) for p, m in trace]
+
+    before = profiler.get_compile_stats()
+    base_decode = before.get("serving_decode", {}).get("traces", 0)
+    base_prefill = before.get("serving_prefill", {}).get("traces", 0)
+    with ServingEngine(net, slots=2, queue_depth=8, chunk=4) as eng:
+        def run_trace():
+            reqs = []
+            for i, (p, m) in enumerate(trace):
+                reqs.append(eng.submit(p, m))
+                time.sleep(0.02 * (i % 3))   # staggered joins mid-decode
+            return [r.result(timeout=300) for r in reqs]
+
+        assert run_trace() == refs
+        caches = profiler.get_compile_stats()
+        decode0 = caches["serving_decode"]["traces"]
+        prefill0 = caches["serving_prefill"]["traces"]
+        # every request keys the same (slots=2, TOT=64) decode program and
+        # the same (PB=32) prefill program — exactly one trace each
+        assert decode0 == base_decode + 1
+        assert prefill0 == base_prefill + 1
+
+        # an identical second wave churns the same slots through the same
+        # buckets: zero new traces, only hits
+        hits0 = caches["serving_decode"]["hits"]
+        assert run_trace() == refs
+        caches = profiler.get_compile_stats()
+        assert caches["serving_decode"]["traces"] == decode0
+        assert caches["serving_prefill"]["traces"] == prefill0
+        assert caches["serving_decode"]["hits"] > hits0
+
+
+def test_engine_api_cancel_deadline_stats(net):
+    with ServingEngine(net, slots=1, queue_depth=8, chunk=4) as eng:
+        # a single busy slot serializes admissions: r2 sits queued behind r1
+        # long enough for its cancel (and r3's already-passed deadline) to
+        # land at the admission check, deterministically
+        r1 = eng.submit([1, 2, 3], 40)
+        r2 = eng.submit([4, 5, 6], 40)
+        r3 = eng.submit([7, 8, 9], 40, deadline_s=1e-4)
+        r2.cancel()
+        assert r1.result(timeout=300) == _solo(net, [1, 2, 3], 40)
+        with pytest.raises(RequestCancelled):
+            r2.result(timeout=300)
+        with pytest.raises(DeadlineExceeded):
+            r3.result(timeout=300)
+
+        # stream() hands tokens over as decode delivers them
+        r4 = eng.submit([1, 2, 3], 40)
+        assert list(r4.stream(timeout=300)) == _solo(net, [1, 2, 3], 40)
+
+        stats = eng.stats()
+        assert stats["completed"] >= 2
+        assert stats["cancelled"] >= 1
+        assert stats["expired"] >= 1
+        assert stats["tokens_out"] >= 80
+
+    # stopped engines reject instead of hanging
+    with pytest.raises(RuntimeError):
+        eng.submit([1], 1)
+
+
+def test_engine_backpressure_queue_full(net):
+    eng = ServingEngine(net, slots=1, queue_depth=1, chunk=4)
+    eng.start = lambda: eng          # nobody drains: rejection deterministic
+    eng.submit([1, 2, 3], 4)
+    with pytest.raises(QueueFullError):
+        eng.submit([1, 2, 3], 4)
+    assert profiler.get_serving_stats()["rejected"] >= 1
+
+
+def test_engine_rejects_oversized_request(net):
+    eng = ServingEngine(net, slots=1)
+    with pytest.raises(ValueError):
+        eng.submit([1] * 10, net._max_len)   # total exceeds max_len
+
+
+def test_program_cache_lru_bound():
+    pc = ProgramCache("test_lru_guard", capacity=2)
+    pc.put("a", 1)
+    pc.put("b", 2)
+    assert pc.get("a") == 1                  # refresh: "b" is now LRU
+    pc.put("c", 3)
+    assert len(pc) == 2
+    assert pc.evictions == 1
+    assert "b" not in pc
+    assert "a" in pc and "c" in pc
+    assert pc.get_or_build("a", lambda: 99) == 1
+
+
+def test_program_cache_env_capacity(monkeypatch):
+    monkeypatch.setenv("MXTPU_SERVING_PROGRAM_CACHE", "3")
+    assert ProgramCache("test_lru_env").capacity == 3
+    monkeypatch.setenv("MXTPU_SERVING_PROGRAM_CACHE", "not-a-number")
+    assert ProgramCache("test_lru_env2").capacity == 64
+
+
+def test_generate_batch_bucket_bit_exact(net):
+    # B=3 pads to the B=4 bucket; masked rows are sliced off and every real
+    # row matches its solo B=1 decode bit-for-bit
+    rs = np.random.RandomState(5)
+    prompts = rs.randint(1, VOCAB, size=(3, 9)).astype(np.int32)
+    out = net.generate(nd.array(prompts), 20)
+    assert out.shape == (3, 29)
+    got = np.asarray(out.data)
+    for i in range(3):
+        assert got[i, 9:].tolist() == _solo(net, prompts[i].tolist(), 20)
