@@ -1683,6 +1683,28 @@ def _emit_serving_only(smoke: bool) -> None:
     print(json.dumps(doc))
 
 
+def _elastic_only() -> bool:
+    """``bench.py elastic`` — run just the live-resize + zero-drop-handoff
+    scenario and emit an elastic-only JSON line (rides the same cpu-fallback
+    re-exec as every other flag)."""
+    return "elastic" in sys.argv[1:]
+
+
+def _emit_elastic_only(smoke: bool) -> None:
+    import jax
+    elastic = run_leg("elastic", bench_elastic, smoke=smoke)
+    ok = (isinstance(elastic, dict)
+          and elastic.get("steps_lost") == 0
+          and elastic.get("params_match_cold_resume")
+          and elastic.get("serving", {}).get("requests_dropped") == 0)
+    doc = {"metric": "elastic_zero_loss_resize",
+           "value": 1.0 if ok else 0.0,
+           "unit": "steps_lost==0 and requests_dropped==0",
+           "platform": jax.default_backend(),
+           "elastic": elastic}
+    print(json.dumps(doc))
+
+
 def bench_sanitizer(smoke: bool = False):
     """One sanitized leg per scenario (``--sanitize``): the LeNet fused-step
     train loop, the checkpoint manager, and the device-feed input pipeline
@@ -1941,6 +1963,177 @@ def bench_resilience(smoke: bool = False):
     return out
 
 
+def bench_elastic(smoke: bool = False):
+    """Live-elasticity scenario (ISSUE 11), both halves of the contract:
+
+    * **training** — one ZeRO fit live-shrinks dp N→N/2 mid-epoch via
+      ``resilience.ElasticRun`` (no restart). Reports the in-place resize
+      latency and proves ``steps_lost == 0`` (every step boundary visited
+      exactly once) plus bit-exactness with a cold checkpoint-resume taken
+      at the resize boundary on the survivor mesh;
+    * **serving** — mid-flight requests survive a
+      ``ServingEngine.drain()``/``adopt()`` handoff onto a second engine
+      with ``requests_dropped == 0`` and greedy decode bit-exact vs solo
+      ``generate``.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    import mxtpu as mx
+    from mxtpu import nd, parallel, profiler
+    from mxtpu.checkpoint import CheckpointManager
+    from mxtpu.gluon import nn
+    from mxtpu.gluon.model_zoo import transformer_lm
+    from mxtpu.io import NDArrayIter
+    from mxtpu.resilience import ElasticRun
+    from mxtpu.serving import ServingEngine
+
+    ndev = len(jax.devices())
+    from_dp, to_dp = ndev, max(1, ndev // 2)
+    epochs, nbatch, batch = 2, 4, 16
+    hidden = 32 if smoke else 128
+    rs = np.random.RandomState(11)
+    X = rs.randn(nbatch * batch, 10).astype(np.float32)
+    y = rs.randint(0, 3, nbatch * batch).astype(np.float32)
+
+    def _net():
+        mx.rng.seed(11)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(hidden, activation="tanh", in_units=10),
+                nn.Dense(3, in_units=hidden))
+        net.initialize(init=mx.initializer.Xavier())
+        return net
+
+    def _params(mod):
+        arg, aux = mod.get_params()
+        return [np.asarray(v.data)
+                for v in list(arg.values()) + list(aux.values())]
+
+    fit_kw = dict(num_epoch=epochs, kvstore="device", optimizer="sgd",
+                  optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                  eval_metric="ce")
+
+    def _live(save_dir):
+        """ElasticRun fit: commit a checkpoint at (0, 1) — the cold-resume
+        anchor — then live-shrink at the SAME step boundary."""
+        parallel.set_default_mesh(parallel.make_mesh((from_dp,), ("dp",)))
+        mod = mx.Module(_net(), data_names=("data",),
+                        label_names=("softmax_label",))
+        mgr = CheckpointManager(save_dir)
+        er = ElasticRun(mod)
+        seen = set()
+
+        def _cb(param):
+            seen.add((param.epoch, param.nbatch))
+            if (param.epoch, param.nbatch) == (0, 1):
+                mgr.save(step=1, module=mod,
+                         trainer=getattr(mod, "_trainer", None),
+                         epoch=param.epoch, nbatch=param.nbatch,
+                         blocking=True)
+                er.request_resize(to_dp)
+        try:
+            it = NDArrayIter(X, y, batch_size=batch, shuffle=False)
+            er.fit(it, batch_end_callback=_cb, **fit_kw)
+            mgr.wait_until_finished()
+        finally:
+            mgr.close()
+            parallel.set_default_mesh(None)
+        return _params(mod), er, seen
+
+    def _cold(save_dir):
+        parallel.set_default_mesh(parallel.make_mesh((to_dp,), ("dp",)))
+        mod = mx.Module(_net(), data_names=("data",),
+                        label_names=("softmax_label",))
+        try:
+            it = NDArrayIter(X, y, batch_size=batch, shuffle=False)
+            mod.fit(it, resume_from=save_dir, **fit_kw)
+        finally:
+            parallel.set_default_mesh(None)
+        return _params(mod)
+
+    root = tempfile.mkdtemp(prefix="mxtpu-bench-elastic-")
+    zprev = os.environ.get("MXTPU_ZERO")
+    t0 = time.perf_counter()
+    try:
+        os.environ["MXTPU_ZERO"] = "1"
+        profiler.reset_resilience_stats()
+        live, er, seen = _live(root)
+        cold = _cold(root)
+    finally:
+        if zprev is None:
+            os.environ.pop("MXTPU_ZERO", None)
+        else:
+            os.environ["MXTPU_ZERO"] = zprev
+        shutil.rmtree(root, ignore_errors=True)
+    steps_lost = epochs * nbatch - len(seen)
+    match = (len(live) == len(cold)
+             and all(a.shape == b.shape and np.array_equal(a, b)
+                     for a, b in zip(live, cold)))
+    rstats = profiler.get_resilience_stats()
+
+    # -- serving half: drain two decoding slots + one queued request, adopt
+    # them on a fresh engine, and read every result back bit-exact
+    mx.rng.seed(0)
+    vocab = 50
+    net = transformer_lm("tiny", vocab_size=vocab)
+    net.initialize()
+    srs = np.random.RandomState(7)
+    trace = [(srs.randint(1, vocab, size=n).tolist(), new)
+             for n, new in [(3, 96), (17, 80), (9, 112)]]
+    refs = [np.asarray(net.generate(
+        nd.array(np.array([p], np.int32)), m).data)[0, len(p):].tolist()
+        for p, m in trace]
+    profiler.reset_serving_stats()
+    eng = ServingEngine(net, slots=2, queue_depth=8, chunk=4).start()
+    reqs = [eng.submit(p, m) for p, m in trace]
+    tw = time.monotonic()
+    while profiler.get_serving_stats()["prefills"] < 2:
+        if time.monotonic() - tw > 120:
+            raise AssertionError("serving prefill never happened")
+        time.sleep(0.02)
+    td = time.perf_counter()
+    handoff = eng.drain()
+    drain_ms = (time.perf_counter() - td) * 1e3
+    eng2 = ServingEngine(net, slots=2, queue_depth=8, chunk=4)
+    eng2.adopt(handoff)
+    outs = [r.result(timeout=300) for r in reqs]
+    eng2.stop()
+    sstats = profiler.get_serving_stats()
+    dropped = sstats["cancelled"] + sstats["expired"]
+    decode_match = outs == refs
+
+    out = {
+        "from_dp": from_dp,
+        "to_dp": to_dp,
+        "resizes": er.resizes,
+        "resize_latency_ms": rstats["resize_latency_ms_last"],
+        "steps_lost": steps_lost,
+        "restart_fallbacks": rstats["restart_fallbacks"],
+        "params_match_cold_resume": bool(match),
+        "serving": {
+            "in_flight": handoff.in_flight,
+            "drained": sstats["drained"],
+            "adopted": sstats["adopted"],
+            "requests_dropped": dropped,
+            "drain_ms": drain_ms,
+            "decode_match": bool(decode_match),
+        },
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+    log(f"[elastic] live dp{from_dp}->dp{to_dp} in "
+        f"{rstats['resize_latency_ms_last']:.1f} ms, steps lost "
+        f"{steps_lost}, cold-resume match={match}; serving handoff "
+        f"{sstats['drained']} drained/{sstats['adopted']} adopted, "
+        f"{dropped} dropped in {drain_ms:.1f} ms, match={decode_match}")
+    if er.resizes != 1 or steps_lost != 0 or not match:
+        raise AssertionError(f"live resize contract violated: {out}")
+    if dropped != 0 or not decode_match:
+        raise AssertionError(f"zero-drop handoff contract violated: {out}")
+    return out
+
+
 def bench_cpu_fallback():
     """Reduced harness for hosts where the TPU backend won't initialize
     (BENCH_r05 regression: rc=1 'Unable to initialize backend'). Emits the
@@ -1963,6 +2156,9 @@ def bench_cpu_fallback():
     if _serving_only():
         _emit_serving_only(smoke)
         return
+    if _elastic_only():
+        _emit_elastic_only(smoke)
+        return
     train = run_leg("train", _fallback_train_leg, smoke)
     mod = train.pop("module", None) if isinstance(train, dict) else None
     # the checkpoint + input-pipeline + zero_dp + trace scenarios reuse the
@@ -1978,6 +2174,7 @@ def bench_cpu_fallback():
                    hidden=128 if smoke else 512)
     resil = run_leg("resilience", bench_resilience, smoke=smoke)
     serving = run_leg("serving", bench_serving, smoke=smoke)
+    elastic = run_leg("elastic", bench_elastic, smoke=smoke)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer, smoke=smoke) \
         if _sanitize_requested() else None
@@ -2001,6 +2198,7 @@ def bench_cpu_fallback():
         "fsdp": fsdp,
         "resilience": resil,
         "serving": serving,
+        "elastic": elastic,
         "trace": trace,
         "compile_caches": caches,
     }
@@ -2054,6 +2252,9 @@ def main():
     if _serving_only():
         _emit_serving_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
         return
+    if _elastic_only():
+        _emit_elastic_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
+        return
     # every scenario runs under run_leg crash containment: retries with
     # backoff on transient backend errors (UNAVAILABLE / init failures), an
     # {"error": ...} leg entry otherwise — the scoreboard always ships
@@ -2082,6 +2283,7 @@ def main():
     fsdp = run_leg("fsdp", bench_fsdp)
     resil = run_leg("resilience", bench_resilience)
     serving = run_leg("serving", bench_serving)
+    elastic = run_leg("elastic", bench_elastic)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer) \
         if _sanitize_requested() else None
@@ -2120,6 +2322,7 @@ def main():
         "fsdp": fsdp,
         "resilience": resil,
         "serving": serving,
+        "elastic": elastic,
         "trace": trace,
         "compile_caches": _compile_caches(),
     }

@@ -141,6 +141,15 @@ class DeviceFeed(DataIter):
         self._warned_uneven = False
 
     # -- placement ---------------------------------------------------------
+    def set_placement(self, placement) -> None:
+        """Re-home the device boundary (live elasticity: the elastic
+        controller points the feed at the survivor mesh mid-run). Safe to
+        call from any thread at any time: the producer reads ``_placement``
+        per array, so batches staged before the swap keep their OLD
+        sharding — ``parallel.shard_batch`` re-places those transparently
+        when the step consumes them, so no staged batch is lost."""
+        self._placement = placement
+
     def _target_for(self, raw):
         """Resolve the placement target for one array (or None to pass a
         custom-callable result through)."""

@@ -11,34 +11,125 @@ Env contract (reference DMLC names kept for launcher parity, tools/launch.py):
   DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT — coordinator host/port
   DMLC_NUM_WORKER                      — number of processes
   DMLC_WORKER_ID                       — this process's rank
+
+Rendezvous is factored behind a :class:`Transport` seam so the join/leave/
+re-join protocol is testable without a real pod: the default
+:class:`JaxTransport` talks to ``jax.distributed``; tests install a mock via
+:func:`set_transport` and drive rank loss + re-rendezvous in-process
+(``tests/test_elastic_guard.py``). The elastic story rides on two properties
+pinned here:
+
+* ``shutdown()`` → ``initialize()`` **re-entry** — both are idempotent and
+  keep the module flag synced with the transport's live connection, so a
+  rank can leave the pod and re-join (one :func:`rejoin` call) without a
+  process restart;
+* a monotone :func:`generation` counter — every successful ``initialize``
+  bumps it, so layers above (KVStore, elastic controller) can detect that
+  the pod membership changed under them and re-derive rank/size.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 import jax
 
 __all__ = ["initialize", "auto_initialize", "is_initialized", "rank", "size",
-           "shutdown"]
+           "shutdown", "rejoin", "generation",
+           "Transport", "JaxTransport", "get_transport", "set_transport"]
 
+_lock = threading.Lock()
 _initialized = False
+_generation = 0
 
 
-def _pod_connected() -> bool:
-    """Whether ``jax.distributed`` already holds a live coordinator client
-    (connected by us or by someone calling ``jax.distributed.initialize``
-    directly). Deliberately NOT ``jax.process_count()``: that would
-    initialize the local XLA backend, after which a first
-    ``jax.distributed.initialize`` is forbidden — the predicate must be
-    safe to call from ``initialize()`` itself."""
-    try:
-        from jax._src import distributed as _jax_distributed
-        return _jax_distributed.global_state.client is not None
-    except Exception:  # jax internals moved — fall back to the module flag
-        return False
+# -- the rendezvous transport seam -------------------------------------------
 
+class Transport:
+    """What a rendezvous backend must provide. The contract is deliberately
+    tiny — connect/disconnect plus identity — because everything *above* the
+    pod connection (collectives, exchange, KVStore) goes through XLA, not
+    through this seam."""
+
+    def connect(self, coordinator_address: Optional[str],
+                num_processes: Optional[int],
+                process_id: Optional[int]) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        raise NotImplementedError
+
+    def connected(self) -> bool:
+        raise NotImplementedError
+
+    def process_index(self) -> int:
+        raise NotImplementedError
+
+    def process_count(self) -> int:
+        raise NotImplementedError
+
+
+class JaxTransport(Transport):
+    """The real thing: ``jax.distributed`` against the pod coordinator."""
+
+    def connect(self, coordinator_address, num_processes, process_id) -> None:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+    def disconnect(self) -> None:
+        jax.distributed.shutdown()
+
+    def connected(self) -> bool:
+        """Whether ``jax.distributed`` already holds a live coordinator
+        client (connected by us or by someone calling
+        ``jax.distributed.initialize`` directly). Deliberately NOT
+        ``jax.process_count()``: that would initialize the local XLA
+        backend, after which a first ``jax.distributed.initialize`` is
+        forbidden — the predicate must be safe to call from
+        ``initialize()`` itself."""
+        try:
+            from jax._src import distributed as _jax_distributed
+            return _jax_distributed.global_state.client is not None
+        except Exception:  # jax internals moved — fall back to module flag
+            return False
+
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    def process_count(self) -> int:
+        return jax.process_count()
+
+
+_transport: Transport = JaxTransport()
+
+
+def get_transport() -> Transport:
+    return _transport
+
+
+def set_transport(transport: Transport) -> Transport:
+    """Install a rendezvous backend (tests: a mock coordinator), returning
+    the previous one so callers can restore it. Resets the initialized flag
+    — the new transport's ``connected()`` is the source of truth from here."""
+    global _transport, _initialized
+    with _lock:
+        prev, _transport = _transport, transport
+        _initialized = False
+    return prev
+
+
+def generation() -> int:
+    """Monotone rendezvous generation: bumped by every successful
+    ``initialize`` (including re-joins), 0 before the first. Layers that
+    cache rank/size or per-pod programs compare generations to notice that
+    membership changed."""
+    return _generation
+
+
+# -- lifecycle ---------------------------------------------------------------
 
 def is_initialized() -> bool:
     """Whether the pod connection is up. An externally-connected pod counts,
@@ -48,7 +139,7 @@ def is_initialized() -> bool:
     still reached ``jax.distributed.initialize``, which rejects late
     calls."""
     global _initialized
-    if not _initialized and _pod_connected():
+    if not _initialized and _transport.connected():
         _initialized = True
     return _initialized
 
@@ -56,24 +147,28 @@ def is_initialized() -> bool:
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None):
-    """Connect this process to the pod (jax.distributed.initialize wrapper).
+    """Connect this process to the pod (rendezvous through the installed
+    :class:`Transport`; by default ``jax.distributed.initialize``).
 
+    Idempotent — a second call on a live connection is a no-op, INCLUDING
+    after :func:`shutdown` ran in between: the shutdown→initialize re-entry
+    pair is the rank leave/re-join protocol live elasticity depends on.
     Transient bring-up failures (coordinator not yet listening, connection
     races during a gang start) are retried per ``resilience.retry_transient``;
     logic errors (bad addresses, double init) escalate immediately."""
-    global _initialized
+    global _initialized, _generation
     if is_initialized():   # also syncs the flag for externally-connected pods
         return
     from .resilience import fault_point, retry_transient
 
     def _connect():
         fault_point("dist.initialize")
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
+        _transport.connect(coordinator_address, num_processes, process_id)
 
     retry_transient(_connect, label="dist.initialize")
-    _initialized = True
+    with _lock:
+        _initialized = True
+        _generation += 1
 
 
 def auto_initialize() -> bool:
@@ -95,7 +190,7 @@ def auto_initialize() -> bool:
         try:
             initialize(f"{uri}:{port}", int(n), wid)
         except RuntimeError as e:
-            if _pod_connected():
+            if _transport.connected():
                 _initialized = True  # someone else already connected the pod
                 return True
             raise RuntimeError(
@@ -107,15 +202,34 @@ def auto_initialize() -> bool:
 
 
 def rank() -> int:
-    return jax.process_index()
+    return _transport.process_index()
 
 
 def size() -> int:
-    return jax.process_count()
+    return _transport.process_count()
 
 
 def shutdown():
+    """Leave the pod. Idempotent: a no-op when nothing is connected, so
+    teardown paths can call it unconditionally. After shutdown the module is
+    back in its pre-initialize state — :func:`initialize` may be called
+    again (re-join), which bumps :func:`generation`."""
     global _initialized
-    if _initialized:
-        jax.distributed.shutdown()
-        _initialized = False
+    if is_initialized():   # syncs the flag for externally-connected pods
+        _transport.disconnect()
+        with _lock:
+            _initialized = False
+
+
+def rejoin(coordinator_address: Optional[str] = None,
+           num_processes: Optional[int] = None,
+           process_id: Optional[int] = None) -> int:
+    """Leave and re-enter the pod in one call — the re-rendezvous a rank
+    performs after the coordinator reports membership change (peer loss, or
+    this rank rejoining after an elastic shrink). Returns the new
+    :func:`generation`. ``num_processes``/``process_id`` normally differ
+    from the previous join — that is the point."""
+    shutdown()
+    initialize(coordinator_address=coordinator_address,
+               num_processes=num_processes, process_id=process_id)
+    return _generation

@@ -273,7 +273,13 @@ _RESIL_ZERO = {"faults_injected": 0,
                "watchdog_stalls": 0, "emergency_saves": 0,
                "restarts": 0, "steps_lost": 0,
                "restart_latency_ms_total": 0.0,
-               "restart_latency_ms_last": 0.0}
+               "restart_latency_ms_last": 0.0,
+               # live elasticity (mxtpu.resilience.elastic): in-place mesh
+               # resizes completed vs process-restart fallbacks taken when an
+               # in-place adoption raised
+               "live_resizes": 0, "restart_fallbacks": 0,
+               "resize_latency_ms_total": 0.0,
+               "resize_latency_ms_last": 0.0}
 _resil = dict(_RESIL_ZERO)
 
 
@@ -311,6 +317,9 @@ _SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
                  "cancelled": 0, "rejected": 0, "expired": 0,
                  "prefills": 0, "decode_steps": 0, "tokens_out": 0,
                  "kv_promotions": 0,
+                 # live elasticity: requests carried across an engine
+                 # drain()/adopt() handoff (zero-drop contract)
+                 "drained": 0, "adopted": 0,
                  "queue_depth_max": 0, "slots": 0,
                  "slot_occupancy_sum": 0.0, "occupancy_samples": 0,
                  "ttft_ms_total": 0.0, "ttft_ms_last": 0.0,

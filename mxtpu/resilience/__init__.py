@@ -4,11 +4,13 @@ elastic resume supervisor (ROADMAP item 4; see ``docs/resilience.md``).
 The reference MXNet's dependency engine kept making progress under async
 chaos inside one process; this package is the same discipline at the *job*
 level: schedule failures deterministically (:mod:`.faults`), retry what is
-transient (:mod:`.retry`), detect what hangs (:mod:`.watchdog`), and restart
+transient (:mod:`.retry`), detect what hangs (:mod:`.watchdog`), restart
 what dies — resuming from the last committed checkpoint at whatever dp size
-is available (:mod:`.supervisor`).
+is available (:mod:`.supervisor`) — and, when the mesh merely *shrinks or
+grows*, resize in place without restarting at all (:mod:`.elastic`).
 """
 
+from .elastic import ElasticRun, ResizeError, elastic_watchdog
 from .faults import (FaultPlan, InjectedFault, fault_point, get_fault_plan,
                      reset_fault_plan)
 from .retry import RetryError, classify_error, is_transient, retry_transient
@@ -22,4 +24,5 @@ __all__ = [
     "RetryError", "classify_error", "is_transient", "retry_transient",
     "Watchdog", "StallReport", "heartbeat", "WATCHDOG_EXIT_CODE",
     "supervise", "RestartContext", "SuperviseResult", "GiveUpError",
+    "ElasticRun", "ResizeError", "elastic_watchdog",
 ]

@@ -1,0 +1,251 @@
+"""Live elasticity — in-place mesh resize mid-run (ROADMAP item 4).
+
+PR 7's supervisor made preemption survivable by *restarting* ``fit`` from the
+last committed checkpoint; this module removes the restart. An
+:class:`ElasticRun` wraps one ``Module.fit`` call and, on a preemption signal
+or an explicit scale event (:meth:`ElasticRun.request_resize`), pauses the
+loop at the next step boundary and re-homes the live training state onto the
+survivor/expanded mesh **inside the same fit call**:
+
+1. build the new mesh over the surviving device prefix and make it the
+   process default (``parallel.set_default_mesh``);
+2. point the ``DeviceFeed`` staging boundary at it
+   (:meth:`DeviceFeed.set_placement` — batches already staged on the old
+   mesh are re-placed transparently by ``shard_batch``, so none are lost);
+3. ``StepExecutor.adopt_mesh``: host-land the bucketed ZeRO optimizer slots,
+   re-adopt them at the new data size via ``ZeroLayout.adopt_states`` (the
+   SAME de-interleave/re-pack path a cold dp-N→dp-M checkpoint resume
+   takes), re-place stage-3 resident params + their per-param slots, and
+   drop the program cache so the next step traces once on the new mesh.
+
+Update counters, the RNG stream, and the batch cursor are untouched, so the
+post-resize trajectory is bit-exact with a cold checkpoint-resume taken at
+the same step boundary onto the same mesh (``tests/test_elastic_guard.py``
+pins this).
+
+Failure containment: the whole resize runs under the ``elastic`` heartbeat
+source — arm ``MXTPU_ELASTIC_STALL_S`` and a hung adoption becomes a
+:class:`~.watchdog.StallReport` + emergency save instead of a silent wedge —
+and behind the ``elastic.resize`` fault seam. Any error is wrapped in
+:class:`ResizeError` after restoring the previous mesh, so
+``supervisor.supervise`` can record the attempt as a ``restart_fallback``
+and take the PR 7 restart path.
+
+Knobs (the ``MXTPU_ELASTIC_*`` map, ``docs/resilience.md``):
+
+* ``MXTPU_ELASTIC_STALL_S``  — deadline for one resize/drain (unset = no
+  elastic watchdog; the step watchdog, if armed, is restored afterwards)
+* ``MXTPU_ELASTIC_SIGNAL_DP`` — dp target a signal-triggered resize shrinks
+  to (default: half the current data size, floor 1)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import signal as signal_mod
+import threading
+import time
+from typing import Callable, Optional, Union
+
+from .faults import fault_point
+from .watchdog import Watchdog, heartbeat
+
+__all__ = ["ElasticRun", "ResizeError", "elastic_watchdog", "ENV_STALL",
+           "ENV_SIGNAL_DP"]
+
+ENV_STALL = "MXTPU_ELASTIC_STALL_S"
+ENV_SIGNAL_DP = "MXTPU_ELASTIC_SIGNAL_DP"
+
+_log = logging.getLogger("mxtpu.resilience")
+
+
+class ResizeError(RuntimeError):
+    """An in-place resize (or serving drain/adopt) failed. The previous mesh
+    was restored before raising; ``supervisor.supervise`` classifies this as
+    a restart fallback (``restart_fallbacks`` counter) and restarts from the
+    last committed checkpoint."""
+
+
+@contextlib.contextmanager
+def elastic_watchdog():
+    """Arm a deadline on the ``elastic`` heartbeat source for the duration
+    of one resize/drain window when ``MXTPU_ELASTIC_STALL_S`` is set (no-op
+    otherwise). Nested-arm safe: a step/serving watchdog armed outside is
+    restored on exit (``Watchdog.stop`` hands back the previous active)."""
+    raw = os.environ.get(ENV_STALL, "")
+    if not raw:
+        yield None
+        return
+    wd = Watchdog(deadline_s=float(raw), source="elastic").start()
+    try:
+        yield wd
+    finally:
+        wd.stop()
+
+
+class ElasticRun:
+    """Run one ``Module.fit`` with live mesh elasticity.
+
+    ::
+
+        er = ElasticRun(mod)
+        er.install_signal_handler(signal.SIGTERM)       # preemption → shrink
+        er.fit(train_iter, num_epoch=..., kvstore="device", ...)  # same args
+        # ... or from any thread / a batch_end_callback:
+        er.request_resize(4)                            # dp8 → dp4, live
+
+    Requires a ZeRO/FSDP-engaged fit (``kvstore='device'``/``dist_sync`` with
+    an elementwise optimizer) — that is the configuration whose state is
+    re-bucketable in place; anything else has no mesh to resize and raises
+    :class:`ResizeError` at the first resize attempt (the supervisor then
+    falls back to a restart).
+
+    ``mesh_factory(dp) -> Mesh`` customizes mesh construction for multi-axis
+    (dp×fsdp×tp) runs; the default builds a 1-axis mesh with the current
+    default mesh's first axis name over the first ``dp`` devices.
+    """
+
+    def __init__(self, module, mesh_factory: Optional[Callable] = None):
+        self._module = module
+        self._mesh_factory = mesh_factory
+        self._lock = threading.Lock()
+        self._pending: Optional[int] = None
+        self._feed = None
+        self.resizes = 0
+        self.last_resize_ms: Optional[float] = None
+
+    # -- triggers (any thread / signal handler) -----------------------------
+    def request_resize(self, dp: Optional[int] = None) -> None:
+        """Ask for a live resize to ``dp`` data-parallel devices at the next
+        step boundary (idempotent until served; last writer wins). ``dp``
+        None means "re-read ``jax.devices()``" — the scale-out case where
+        the platform grew the pod."""
+        with self._lock:
+            self._pending = -1 if dp is None else int(dp)
+
+    def install_signal_handler(self,
+                               signum: int = signal_mod.SIGTERM,
+                               dp: Union[None, int, Callable[[], int]] = None
+                               ) -> None:
+        """Route a preemption notice into :meth:`request_resize` (main
+        thread only — Python signal contract). ``dp`` may be a fixed target,
+        a callable resolved at signal time, or None for the default shrink
+        (``MXTPU_ELASTIC_SIGNAL_DP``, else half the current data size)."""
+        def _handler(_sig, _frm):
+            target = dp() if callable(dp) else dp
+            if target is None:
+                raw = os.environ.get(ENV_SIGNAL_DP, "")
+                if raw:
+                    target = int(raw)
+                else:
+                    from ..parallel.mesh import data_size, get_default_mesh
+                    target = max(1, data_size(get_default_mesh()) // 2)
+            _log.warning("elastic: signal %d → live shrink to dp=%d",
+                         _sig, target)
+            self.request_resize(target)
+        signal_mod.signal(signum, _handler)
+
+    # -- the wrapped fit ----------------------------------------------------
+    def fit(self, train_data, **fit_kwargs):
+        """``Module.fit`` with the elastic boundary installed: the train
+        iterator is pre-wrapped in a ``DeviceFeed`` placed on the current
+        default mesh (so this controller owns the staging handle to re-place
+        on resize), and a batch-end callback serves pending resize requests
+        at step boundaries. All other arguments pass through unchanged."""
+        from ..device_feed import DeviceFeed, maybe_device_feed
+        from ..parallel.mesh import get_default_mesh
+        feed = maybe_device_feed(train_data, placement=get_default_mesh())
+        self._feed = feed if isinstance(feed, DeviceFeed) else None
+        cbs = fit_kwargs.pop("batch_end_callback", None)
+        cbs = list(cbs) if isinstance(cbs, (list, tuple)) \
+            else ([cbs] if cbs is not None else [])
+        cbs.append(self._on_batch_end)
+        try:
+            return self._module.fit(feed, batch_end_callback=cbs,
+                                    **fit_kwargs)
+        finally:
+            self._feed = None
+
+    def _on_batch_end(self, _param) -> None:
+        with self._lock:
+            target = self._pending
+            self._pending = None
+        if target is None:
+            return
+        self.resize_now(target if target > 0 else None)
+
+    # -- the resize itself --------------------------------------------------
+    def resize_now(self, dp: Optional[int] = None) -> None:
+        """Perform the in-place resize immediately (caller must be at a step
+        boundary — normally reached via :meth:`request_resize` + the batch
+        callback). Raises :class:`ResizeError` on any failure, with the
+        previous mesh restored."""
+        import jax
+        from ..observability import metrics, tracer
+        from ..parallel.mesh import (data_size, get_default_mesh, make_mesh,
+                                     set_default_mesh)
+        old_mesh = get_default_mesh()
+        if dp is None:
+            dp = len(jax.devices())
+        t0 = time.perf_counter()
+        with tracer.span("resilience/resize", cat="resilience",
+                         args={"from_dp": data_size(old_mesh), "to_dp": dp}):
+            with elastic_watchdog():
+                try:
+                    heartbeat("elastic")
+                    fault_point("elastic.resize")
+                    exec_ = getattr(self._module, "_step_exec", None)
+                    if exec_ is None or exec_._zero_mesh is None:
+                        raise ResizeError(
+                            "live resize needs a ZeRO/FSDP-engaged fused "
+                            "step (kvstore device/dist_sync + elementwise "
+                            "optimizer); none is active")
+                    devices = jax.devices()
+                    if dp < 1 or dp > len(devices):
+                        raise ResizeError(
+                            f"resize target dp={dp} outside the available "
+                            f"{len(devices)} device(s)")
+                    if self._mesh_factory is not None:
+                        new_mesh = self._mesh_factory(dp)
+                    elif len(old_mesh.axis_names) == 1:
+                        new_mesh = make_mesh((dp,), old_mesh.axis_names,
+                                             devices[:dp])
+                    else:
+                        raise ResizeError(
+                            f"default mesh has axes {old_mesh.axis_names}; "
+                            "a multi-axis resize needs mesh_factory")
+                    set_default_mesh(new_mesh)
+                    if self._feed is not None:
+                        self._feed.set_placement(new_mesh)
+                    exec_.adopt_mesh(new_mesh)
+                    heartbeat("elastic")
+                except ResizeError:
+                    self._restore(old_mesh)
+                    raise
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:
+                    # anything mid-adoption (injected fault, placement
+                    # error, layout mismatch): restore the old mesh so the
+                    # supervisor's fallback restart starts from sane state
+                    self._restore(old_mesh)
+                    raise ResizeError(
+                        f"in-place resize to dp={dp} failed: "
+                        f"{type(e).__name__}: {e}") from e
+        ms = (time.perf_counter() - t0) * 1e3
+        self.resizes += 1
+        self.last_resize_ms = ms
+        metrics.record_resilience("live_resizes")
+        metrics.record_resilience("resize_latency_ms_total", ms)
+        metrics.record_resilience("resize_latency_ms_last", ms)
+        _log.info("elastic: live resize %d → %d devices in %.1f ms "
+                  "(no restart, 0 steps lost)",
+                  data_size(old_mesh), dp, ms)
+
+    def _restore(self, old_mesh) -> None:
+        from ..parallel.mesh import set_default_mesh
+        set_default_mesh(old_mesh)
+        if self._feed is not None:
+            self._feed.set_placement(old_mesh)

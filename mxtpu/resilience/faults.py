@@ -16,6 +16,8 @@ Seams (``fault_point(site)`` calls) live in:
 * ``collective``      — array-level collectives entry (``allreduce_array``)
 * ``exchange``        — cross-process host-value exchange
 * ``dist.initialize`` — multi-process runtime bring-up
+* ``elastic.resize``  — top of a live in-place mesh resize (``ElasticRun``)
+* ``serving.drain``   — serving drain/handoff, after admission stops
 
 Grammar (entries split on ``,`` or ``;``; fields split on ``:``)::
 

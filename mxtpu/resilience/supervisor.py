@@ -73,6 +73,8 @@ class RestartContext:
     dp: Optional[int] = None          # device count this attempt runs at
     prev_error: Optional[str] = None  # why the previous attempt died
     manager: Optional[object] = None  # inline mode: the live CheckpointManager
+    elastic: Optional[object] = None  # inline mode: the live ElasticRun, so
+    #   fit_fn can route train_data through it (live resize before restart)
 
     @property
     def restarts(self) -> int:
@@ -171,7 +173,8 @@ def supervise(fit_fn: Callable[[RestartContext], object],
               dp_schedule: Union[None, Sequence[int],
                                  Callable[[int], Optional[int]]] = None,
               restart_backoff_s: float = 0.1,
-              attempt_timeout_s: Optional[float] = None) -> SuperviseResult:
+              attempt_timeout_s: Optional[float] = None,
+              elastic=None) -> SuperviseResult:
     """Run ``fit_fn`` under the elastic restart loop.
 
     ``manager``/``directory`` name the checkpoint root resumption reads from
@@ -179,7 +182,12 @@ def supervise(fit_fn: Callable[[RestartContext], object],
     restart is a fresh start). ``max_restarts`` bounds restarts beyond the
     first attempt (env ``MXTPU_MAX_RESTARTS``, default 3); exhaustion raises
     :class:`GiveUpError`. ``attempt_timeout_s`` (process mode) kills a child
-    that outlives it — a last-resort backstop under the watchdog."""
+    that outlives it — a last-resort backstop under the watchdog.
+
+    ``elastic`` (inline mode) is an :class:`~.elastic.ElasticRun` handed to
+    each attempt via ``ctx.elastic``: resizes are served live in place, and
+    only a :class:`~.elastic.ResizeError` — live adoption failed — falls
+    through to this restart loop (counted as a ``restart_fallback``)."""
     if mode not in ("inline", "process"):
         raise ValueError(f"mode must be 'inline' or 'process', got {mode!r}")
     if max_restarts is None:
@@ -192,7 +200,10 @@ def supervise(fit_fn: Callable[[RestartContext], object],
     watchdog.ensure_commit_hook()
     if mode == "inline":
         return _supervise_inline(fit_fn, manager, directory, max_restarts,
-                                 dp_schedule, restart_backoff_s)
+                                 dp_schedule, restart_backoff_s, elastic)
+    if elastic is not None:
+        raise ValueError("elastic= is inline-only (an ElasticRun holds live "
+                         "module state and cannot ship to a spawn child)")
     return _supervise_process(fit_fn, directory, max_restarts, dp_schedule,
                               restart_backoff_s, attempt_timeout_s)
 
@@ -200,7 +211,7 @@ def supervise(fit_fn: Callable[[RestartContext], object],
 # -- inline mode -------------------------------------------------------------
 
 def _supervise_inline(fit_fn, manager, directory, max_restarts, dp_schedule,
-                      backoff_s) -> SuperviseResult:
+                      backoff_s, elastic=None) -> SuperviseResult:
     from ..observability import tracer
     res = SuperviseResult()
     prev_error: Optional[str] = None
@@ -214,7 +225,8 @@ def _supervise_inline(fit_fn, manager, directory, max_restarts, dp_schedule,
         ctx = RestartContext(attempt=attempt, directory=directory,
                              resume_step=_latest_committed(manager, directory),
                              dp=_dp_for_attempt(dp_schedule, attempt),
-                             prev_error=prev_error, manager=manager)
+                             prev_error=prev_error, manager=manager,
+                             elastic=elastic)
         with _EnvScope({faults.ENV_ATTEMPT: attempt}):
             try:
                 with tracer.span("resilience/attempt", cat="resilience",
@@ -227,6 +239,14 @@ def _supervise_inline(fit_fn, manager, directory, max_restarts, dp_schedule,
             except BaseException as exc:
                 prev_error = f"{type(exc).__name__}: {exc}"
                 res.errors.append(prev_error)
+                from .elastic import ResizeError
+                if isinstance(exc, ResizeError):
+                    # live in-place adoption failed — this restart is the
+                    # fallback path, and the scoreboard should say so
+                    from ..observability import metrics
+                    metrics.record_resilience("restart_fallbacks")
+                    _log.warning("supervise[inline]: live resize failed "
+                                 "(%s) — falling back to restart", exc)
                 snap = watchdog.progress_snapshot()
                 lost = max(0, snap["steps"]
                            - max(snap["committed_steps"], base_steps))

@@ -291,6 +291,7 @@ class Watchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._t_start = 0.0
+        self._prev_active: Optional["Watchdog"] = None
 
     # -- lifecycle --
     def start(self) -> "Watchdog":
@@ -301,6 +302,10 @@ class Watchdog:
         self._stop.clear()
         self._thread = threading.Thread(target=self._monitor,
                                         name="mxtpu-watchdog", daemon=True)
+        # nested arming (a temporary "elastic" watchdog over a resize/drain
+        # window while the per-step watchdog stays armed): remember who was
+        # active so stop() restores them instead of leaving no watchdog
+        self._prev_active = _active if _active is not self else None
         _active = self
         self._thread.start()
         return self
@@ -313,7 +318,8 @@ class Watchdog:
             t.join(timeout=5.0)
         self._thread = None
         if _active is self:
-            _active = None
+            _active = self._prev_active
+        self._prev_active = None
 
     def __enter__(self):
         return self.start()

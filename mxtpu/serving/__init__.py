@@ -19,9 +19,10 @@ from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING,
                   DeadlineExceeded, QueueFullError, RequestCancelled,
                   ServingRequest)
 from .chained import ChainedPredictor
-from .engine import ServingEngine
+from .engine import ServingEngine, ServingHandoff
 from . import kv
 
-__all__ = ["ChainedPredictor", "ServingEngine", "ServingRequest",
+__all__ = ["ChainedPredictor", "ServingEngine", "ServingHandoff",
+           "ServingRequest",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
            "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "kv"]

@@ -30,6 +30,14 @@ Guardrails: every dispatch heartbeats the resilience watchdog on the
 unified trace under ``serving/*``, and counters in
 ``profiler.get_serving_stats()``.
 
+Live elasticity (ROADMAP item 4, ``docs/resilience.md``): ``drain()`` stops
+admission, parks the scheduler at a chunk boundary, and freezes every
+in-flight request — its KV page, next-token/position/limit slot state, and
+handle — into a :class:`ServingHandoff`; ``adopt()`` on a fresh engine (same
+model, survivor mesh) reinstalls the pages and resumes decoding the SAME
+request handles bit-exactly, with zero drops. Queued-but-unprefilled
+requests ride along and are re-staged on the adopting engine.
+
 Knobs: ``MXTPU_SERVING_SLOTS`` (slot-batch capacity, default 4),
 ``MXTPU_SERVING_QUEUE`` (admission queue depth, default 16),
 ``MXTPU_SERVING_CHUNK`` (decode steps per dispatch, default 8),
@@ -42,6 +50,7 @@ import os
 import queue
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import List, Optional
 
 import numpy as np
@@ -52,13 +61,32 @@ from .. import profiler
 from ..device_feed import DeviceFeed
 from ..ndarray.ndarray import NDArray
 from ..observability import tracer
+from ..resilience.elastic import elastic_watchdog
+from ..resilience.faults import fault_point
 from ..resilience.watchdog import Watchdog, heartbeat
 from ..step_cache import ProgramCache
 from . import kv
 from .api import (CANCELLED, DONE, EXPIRED, RUNNING, QueueFullError,
                   ServingRequest)
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "ServingHandoff"]
+
+
+@dataclass
+class ServingHandoff:
+    """Frozen in-flight serving state from :meth:`ServingEngine.drain`,
+    consumable by :meth:`ServingEngine.adopt` on a fresh engine. Everything
+    is host-resident (pages are numpy), so the handoff survives the source
+    mesh disappearing entirely."""
+    tot: int                                  # KV bucket length of each page
+    entries: List[dict] = field(default_factory=list)   # per in-flight slot:
+    #   req / page (L,2,1,H,tot,D np) / tok / p / limit / left
+    pending: List[ServingRequest] = field(default_factory=list)  # admitted,
+    #   never prefilled — re-staged verbatim by adopt()
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.entries) + len(self.pending)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -93,6 +121,7 @@ class ServingEngine:
         self._decode_fns = ProgramCache("serving_decode")
         self._prefill_fns = ProgramCache("serving_prefill")
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._started = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._feed: Optional[DeviceFeed] = None
@@ -132,6 +161,9 @@ class ServingEngine:
         Raises :exc:`QueueFullError` when the admission queue is at
         capacity (backpressure, not silent growth) and ``ValueError`` for
         requests the model can't hold."""
+        if self._draining.is_set():
+            raise RuntimeError(
+                "ServingEngine is draining — submit to the adopting engine")
         if self._stop.is_set():
             raise RuntimeError("ServingEngine is stopped")
         req = ServingRequest(prompt, max_new_tokens, deadline_s)
@@ -170,6 +202,122 @@ class ServingEngine:
             self._wd.stop()
         if self._error is not None:
             raise self._error
+
+    def drain(self) -> ServingHandoff:
+        """Zero-drop handoff, half one: stop admission (``submit`` raises),
+        park the scheduler at its chunk boundary, and freeze every live
+        request — KV page, slot cursors, handle — into a host-resident
+        :class:`ServingHandoff` for :meth:`adopt` on a successor engine.
+        No request is cancelled; callers blocked in ``result()`` simply keep
+        waiting across the handoff. Runs under the ``elastic`` heartbeat
+        source (``MXTPU_ELASTIC_STALL_S``) and the ``serving.drain`` fault
+        seam; on any failure the normal cancel-everything sweep runs before
+        the error propagates, so the no-caller-blocks-forever contract holds
+        even when the handoff itself dies."""
+        if self._thread is None:
+            raise RuntimeError("ServingEngine is not started")
+        with tracer.span("serving/drain", cat="serving"), elastic_watchdog():
+            heartbeat("elastic")
+            self._draining.set()      # submit() now raises
+            self._stop.set()          # scheduler exits at the chunk boundary
+            self._thread.join(timeout=60)
+            if self._error is not None:
+                raise self._error     # sweep already ran in the scheduler
+            try:
+                fault_point("serving.drain")
+                now = time.monotonic()
+                entries: List[dict] = []
+                for slot in np.flatnonzero(self._active):
+                    slot = int(slot)
+                    req = self._reqs[slot]
+                    if req._cancelled():
+                        self._retire(slot, CANCELLED, now)
+                        continue
+                    if req._expired(now):
+                        self._retire(slot, EXPIRED, now)
+                        continue
+                    entries.append({
+                        "req": req,
+                        # one slot row, host-landed: survives the old mesh
+                        "page": np.asarray(
+                            self._caches[:, :, slot:slot + 1]),
+                        "tok": int(self._tok[slot]),
+                        "p": int(self._p[slot]),
+                        "limit": int(self._limit[slot]),
+                        "left": int(self._left[slot]),
+                    })
+                heartbeat("elastic")
+                # staged by the feed but never prefilled: keep the handles,
+                # drop the staged arrays (adopt() re-stages them). The
+                # producer drains _submit_q before ending, so polling to
+                # StopIteration collects every admitted request.
+                pending: List[ServingRequest] = []
+                deadline = time.monotonic() + 10.0
+                while self._feed is not None \
+                        and time.monotonic() < deadline:
+                    try:
+                        item = self._feed.poll(timeout=0.2)
+                    except StopIteration:
+                        break
+                    if item is not None:
+                        pending.append(item[0])
+                while True:            # belt and braces: producer died early
+                    try:
+                        pending.append(self._submit_q.get_nowait())
+                    except queue.Empty:
+                        break
+                heartbeat("elastic")
+            except BaseException:
+                self._shutdown_sweep()
+                raise
+        if self._feed is not None:
+            self._feed.close()
+        if self._wd is not None:
+            self._wd.stop()
+        handoff = ServingHandoff(tot=self._TOT or 0, entries=entries,
+                                 pending=pending)
+        profiler.record_serving("drained", handoff.in_flight)
+        tracer.instant("serving/drained", cat="serving",
+                       args={"in_slots": len(entries),
+                             "pending": len(pending)})
+        return handoff
+
+    def adopt(self, handoff: ServingHandoff) -> "ServingEngine":
+        """Zero-drop handoff, half two: on a FRESH engine (same model,
+        survivor mesh), reinstall each drained slot — KV page merged into a
+        slot row, cursors restored — then start the scheduler and re-stage
+        the pending requests. The adopted :class:`ServingRequest` handles
+        are the originals, and ``_emit`` accounting is cumulative, so decode
+        resumes exactly where the source engine stopped: greedy output stays
+        bit-exact with an uninterrupted solo ``generate``."""
+        with self._start_lock:
+            if self._thread is not None:
+                raise RuntimeError(
+                    "adopt() needs a fresh engine (call before start/submit)")
+            if len(handoff.entries) > self.slots:
+                raise ValueError(
+                    f"handoff carries {len(handoff.entries)} in-flight "
+                    f"slots but this engine has {self.slots}")
+            if handoff.entries:
+                self._materialize_params()
+                self._ensure_capacity(handoff.tot)
+                for i, e in enumerate(handoff.entries):
+                    self._caches = kv.merge_page(
+                        self._caches, jnp.asarray(e["page"]), i)
+                    self._tok[i] = e["tok"]
+                    self._p[i] = e["p"]
+                    self._limit[i] = e["limit"]
+                    self._left[i] = e["left"]
+                    self._active[i] = True
+                    self._reqs[i] = e["req"]
+        self.start()
+        for req in handoff.pending:
+            self._submit_q.put(req)     # blocking is fine: consumer is live
+        profiler.record_serving("adopted", handoff.in_flight)
+        tracer.instant("serving/adopted", cat="serving",
+                       args={"in_slots": len(handoff.entries),
+                             "pending": len(handoff.pending)})
+        return self
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -221,7 +369,10 @@ class ServingEngine:
         except BaseException as e:
             self._error = e
         finally:
-            self._shutdown_sweep()
+            # a clean drain hands its in-flight state to adopt(); anything
+            # else (stop, scheduler error) must cancel so nobody blocks
+            if self._error is not None or not self._draining.is_set():
+                self._shutdown_sweep()
 
     def _free_slot(self) -> Optional[int]:
         idle = np.flatnonzero(~self._active)

@@ -327,6 +327,7 @@ class StepExecutor:
         self._zero_mesh = None
         self._zero_stage = 0
         self._param_sh = None
+        self._strict_adopt = False
         if trainer.zero_requested():
             from .parallel.mesh import get_default_mesh
             from .parallel.fsdp import zero_stage
@@ -397,6 +398,14 @@ class StepExecutor:
                                           saved_meta.get("layout", {}),
                                           self._zero_mesh)
             tr._zero_restore = None
+            if adopted is None and self._strict_adopt:
+                # live resize: a silent fresh-state fallback would continue
+                # training with zeroed momentum — fail so the elastic
+                # controller's caller takes the process-restart path instead
+                raise RuntimeError(
+                    "in-place mesh adoption failed: live ZeRO optimizer "
+                    "slots do not match the re-bucketed layout on the new "
+                    "mesh")
             if adopted is None:
                 import warnings
                 warnings.warn(
@@ -450,6 +459,84 @@ class StepExecutor:
                 if hasattr(s, "dtype") else s
                 for s in st)
             tr._states[i] = unique_buffers(placed) if donate else placed
+
+    # -- live elasticity ---------------------------------------------------
+    def adopt_mesh(self, mesh) -> None:
+        """Re-home the fused step onto ``mesh`` IN PLACE, mid-run (live
+        elasticity, ROADMAP item 4): the optimizer keeps its exact state —
+        bucketed ZeRO slots are host-landed, staged through the same
+        ``trainer._zero_restore`` ritual a checkpoint restore uses, and
+        re-adopted via ``ZeroLayout.adopt_states`` at the NEW data size;
+        per-param (stage-3 passthrough) slots re-place with their param's
+        new resident sharding. The program cache is dropped (the next step
+        traces once on the new mesh) and update counters / RNG are untouched,
+        so the continuation is bit-exact with a cold checkpoint-resume onto
+        the same mesh.
+
+        Must be called at a step boundary (no step in flight). A bucket-
+        layout mismatch on the new mesh raises — the caller (``ElasticRun``)
+        falls back to a process restart rather than continuing with silently
+        zeroed momentum."""
+        if self._zero_mesh is None:
+            raise RuntimeError(
+                "adopt_mesh requires a ZeRO/FSDP-engaged step (kvstore "
+                "device/dist_sync with an elementwise optimizer); the "
+                "replicated eager path has no mesh to resize")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .checkpoint.snapshot import _to_host
+        from .parallel.data_parallel import _place
+        tr = self.trainer
+        # 1. host-land the bucketed ZeRO slots, keyed exactly like a
+        #    checkpoint (zopt:{b}:{j} / zres:{b}) so the adoption below is
+        #    the SAME de-interleave/re-pack path a dp-N→dp-M resume takes
+        if tr._zero_layout is not None:
+            zarrays, zslots = {}, []
+            for b, st in enumerate(tr._zero_states):
+                zslots.append(len(st))
+                for j, s in enumerate(st):
+                    zarrays[f"zopt:{b}:{j}"] = _to_host(s)
+            for b, r in enumerate(tr._zero_residuals or []):
+                if r is not None:
+                    zarrays[f"zres:{b}"] = _to_host(r)
+            tr._zero_restore = ({"layout": tr._zero_layout.describe(),
+                                 "slots": zslots}, zarrays)
+            tr._zero_layout = None
+            tr._zero_states = []
+            tr._zero_residuals = []
+        # 2. host-land per-param slots (the stage-3 passthrough set) before
+        #    their shardings go stale with the old mesh
+        host_states = [
+            None if st is None else
+            tuple(_to_host(s) if hasattr(s, "dtype") else s for s in st)
+            for st in tr._states]
+        # 3. re-home: new mesh, recomputed param shardings, cold program
+        #    cache (the signature includes shardings, so the first step on
+        #    the new mesh must trace — dropping the cache just makes the
+        #    old-mesh programs collectable)
+        self._zero_mesh = mesh
+        self._param_sh = None
+        self._cache.clear()
+        self._last_sig = None
+        self._ensure_placed()
+        repl = NamedSharding(mesh, P())
+        donate = donation_supported()
+        for i, st in enumerate(host_states):
+            if st is None:
+                continue
+            shape = tuple(self._param_handles[i]._data._data.shape)
+            placed = tuple(
+                _place(s, self._param_sh[i]
+                       if getattr(s, "shape", None) == shape else repl)
+                if hasattr(s, "dtype") else s
+                for s in st)
+            tr._states[i] = unique_buffers(placed) if donate else placed
+        # 4. adopt the staged slots onto the new layout — strict: a layout
+        #    mismatch raises instead of silently resetting optimizer state
+        self._strict_adopt = True
+        try:
+            self._ensure_zero_states()
+        finally:
+            self._strict_adopt = False
 
     # -- signature ---------------------------------------------------------
     def _ensure_states(self):

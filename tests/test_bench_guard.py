@@ -47,7 +47,7 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     # every fallback scenario must keep emitting its keys
     assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
             "compile_caches", "mfu", "trace", "fsdp", "serving",
-            "ratchet"} <= set(doc)
+            "elastic", "ratchet"} <= set(doc)
     # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
     # mid-epoch crash survived by a supervised restart, final params equal
     # to the fault-free baseline
@@ -99,6 +99,19 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert 0 < serving["slot_occupancy"] <= 1
     assert doc["ratchet"]["current"]["serving_goodput"] \
         == serving["goodput_tok_s"]
+    # elastic leg (ISSUE 11): one live in-place dp shrink mid-fit — no
+    # restart, no steps lost, bit-exact with a cold resume — and a serving
+    # drain/adopt handoff that dropped nothing
+    elastic = doc["elastic"]
+    assert "error" not in elastic, elastic
+    assert elastic["resizes"] == 1
+    assert elastic["resize_latency_ms"] > 0
+    assert elastic["steps_lost"] == 0
+    assert elastic["restart_fallbacks"] == 0
+    assert elastic["params_match_cold_resume"] is True
+    assert elastic["serving"]["requests_dropped"] == 0
+    assert elastic["serving"]["decode_match"] is True
+    assert elastic["serving"]["drained"] == elastic["serving"]["adopted"]
     # the comm leg's all_to_all anomaly probe shipped its point timing
     a2a = doc.get("comm", {}).get("all_to_all_probe")
     if a2a is not None:
@@ -177,6 +190,21 @@ def test_bench_serving_scenario_cli(tmp_path):
     assert serving["goodput_vs_serial"] >= 1.5, serving
     assert serving["deadline_ms"] > 0
     assert serving["per_token_p99_ms"] >= serving["per_token_p50_ms"] > 0
+
+
+def test_bench_elastic_scenario_cli(tmp_path):
+    """``bench.py elastic`` (ISSUE 11): the elastic-only CLI path must exit
+    0 and emit a single elastic JSON doc — live dp shrink with zero steps
+    lost and cold-resume parity, serving handoff with zero drops."""
+    doc, _ = _run_fallback_bench(tmp_path, args=("elastic",))
+    assert doc["metric"] == "elastic_zero_loss_resize"
+    assert doc["value"] == 1.0
+    elastic = doc["elastic"]
+    assert elastic["steps_lost"] == 0
+    assert elastic["resize_latency_ms"] > 0
+    assert elastic["params_match_cold_resume"] is True
+    assert elastic["serving"]["requests_dropped"] == 0
+    assert elastic["serving"]["decode_match"] is True
 
 
 def test_bench_sanitized_leg_exits_zero_with_no_violations(tmp_path):

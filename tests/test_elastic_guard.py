@@ -1,0 +1,616 @@
+"""Live-elasticity guard (ISSUE 11 acceptance): a preemption/scale event must
+be survivable WITHOUT restarting ``fit`` — ``resilience.ElasticRun`` pauses
+at a step boundary, re-buckets the ZeRO optimizer state in place onto the
+survivor mesh (``ZeroLayout.adopt_states``), re-places the feed + params, and
+continues the same fit call. Pinned contracts:
+
+* live dp8→dp4 shrink is **bit-exact** (rtol=0) with a cold checkpoint-resume
+  at the same step on the same mesh — and tolerance-equal with the
+  uninterrupted dp8 run (the dp reduction order changes at the shrink, same
+  documented tolerance as the crash matrix's halved-dp cells);
+* ``ServingEngine.drain()``/``adopt()`` carries every in-flight request
+  across engines with zero drops, greedy output bit-exact vs solo
+  ``generate``;
+* ``dist`` rendezvous (join / rank loss / re-join) drives a mock transport —
+  ``shutdown()``→``initialize()`` re-entry with a monotone generation;
+* ``tools/launch.py`` ssh mode emits the DMLC_* env contract per host;
+* a fault at the ``elastic.resize`` seam falls back to the supervisor's
+  restart path (``restart_fallbacks`` counter), and the full SIGKILL
+  mid-resize cell rides ``-m slow``.
+
+NOTE: this module is imported by multiprocessing *spawn* children (process
+mode pickles ``_elastic_supervised_fit`` by reference), so it must not import
+conftest at module level — conftest would force the 8-device XLA flag onto
+children whose device count the supervisor controls.
+"""
+
+import contextlib
+import importlib.util
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import dist, nd, parallel, profiler
+from mxtpu.checkpoint import CheckpointManager
+from mxtpu.gluon import nn
+from mxtpu.io import NDArrayIter
+from mxtpu.resilience import (ElasticRun, ResizeError, elastic, faults,
+                              supervise, watchdog)
+
+EPOCHS = 2
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures/helpers (same idioms as test_resilience_guard)
+# ---------------------------------------------------------------------------
+
+
+def _mlp():
+    mx.rng.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="tanh", in_units=10),
+            nn.Dense(3, in_units=32))
+    net.initialize(init=mx.initializer.Xavier())
+    return net
+
+
+def _data():
+    rs = np.random.RandomState(11)
+    return (rs.randn(64, 10).astype(np.float32),
+            rs.randint(0, 3, 64).astype(np.float32))
+
+
+def _positional_params(mod):
+    arg, aux = mod.get_params()
+    return [v.asnumpy() for v in list(arg.values()) + list(aux.values())]
+
+
+def _assert_params_equal(got, want, rtol=1e-6, atol=0.0):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=rtol, atol=atol)
+
+
+@contextlib.contextmanager
+def _zero_mesh(n):
+    """MXTPU_ZERO=1 + an (n,)-device ("dp",) default mesh for the duration."""
+    import jax
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
+    os.environ["MXTPU_ZERO"] = "1"
+    parallel.set_default_mesh(parallel.make_mesh((n,), ("dp",)))
+    try:
+        yield
+    finally:
+        parallel.set_default_mesh(None)
+        os.environ.pop("MXTPU_ZERO", None)
+
+
+def _elastic_zero_fit(save_dir, shrink_to=None, shrink_at=(0, 1),
+                      resume_from=None):
+    """One ZeRO fit under ElasticRun on the CURRENT default mesh. At batch
+    ``shrink_at`` it commits a blocking checkpoint (the cold-resume anchor)
+    and requests a live resize to ``shrink_to`` devices — served by the
+    elastic batch-end callback at the SAME step boundary. On a resumed run
+    the shrink batch is skipped, so no second resize fires."""
+    X, y = _data()
+    mod = mx.Module(_mlp(), data_names=("data",),
+                    label_names=("softmax_label",))
+    mgr = CheckpointManager(save_dir)
+    er = ElasticRun(mod)
+
+    def _cb(param):
+        if shrink_to is not None and (param.epoch, param.nbatch) == shrink_at:
+            mgr.save(step=1, module=mod,
+                     trainer=getattr(mod, "_trainer", None),
+                     epoch=param.epoch, nbatch=param.nbatch, blocking=True)
+            er.request_resize(shrink_to)
+
+    try:
+        it = NDArrayIter(X, y, batch_size=16, shuffle=False)
+        er.fit(it, num_epoch=EPOCHS, kvstore="device", optimizer="sgd",
+               optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+               eval_metric="ce", batch_end_callback=_cb,
+               resume_from=resume_from)
+        mgr.wait_until_finished()
+    finally:
+        mgr.close()
+    return _positional_params(mod), er
+
+
+def _plain_zero_fit(resume_from=None):
+    """The same fit without elasticity (baseline / cold-resume runner)."""
+    X, y = _data()
+    mod = mx.Module(_mlp(), data_names=("data",),
+                    label_names=("softmax_label",))
+    it = NDArrayIter(X, y, batch_size=16, shuffle=False)
+    mod.fit(it, num_epoch=EPOCHS, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="ce", resume_from=resume_from)
+    return _positional_params(mod)
+
+
+def _elastic_supervised_fit(ctx):
+    """Process-mode attempt body (module-level: spawn pickles by reference).
+    Attempt 1 runs at the child's full device count and live-shrinks to half;
+    a resumed attempt (post-SIGKILL, respawned at the shrunk device count by
+    dp_schedule) skips the shrink batch and just continues."""
+    import jax
+    os.environ["MXTPU_ZERO"] = "1"
+    ndev = len(jax.devices())
+    parallel.set_default_mesh(parallel.make_mesh((ndev,), ("dp",)))
+    try:
+        params, _er = _elastic_zero_fit(ctx.directory,
+                                        shrink_to=max(1, ndev // 2),
+                                        resume_from=ctx.resume_from())
+    finally:
+        parallel.set_default_mesh(None)
+    np.savez(os.path.join(ctx.directory, "result.npz"), *params)
+
+
+def _result_params(directory):
+    data = np.load(os.path.join(directory, "result.npz"))
+    return [data[k] for k in data.files]
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    monkeypatch.delenv(elastic.ENV_STALL, raising=False)
+    monkeypatch.setenv("MXTPU_RETRY_BACKOFF_S", "0.01")
+    faults.reset_fault_plan()
+    profiler.reset_resilience_stats()
+    profiler.reset_serving_stats()
+    watchdog.reset_heartbeats()
+    yield
+    faults.reset_fault_plan()
+    watchdog.set_progress_beacon(None)
+
+
+def _arm(monkeypatch, plan):
+    monkeypatch.setenv(faults.ENV_PLAN, plan)
+    faults.reset_fault_plan()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: live dp8→dp4 shrink, same fit call, bit-exact vs cold resume
+# ---------------------------------------------------------------------------
+
+
+def test_live_shrink_dp8_to_dp4_bit_exact_vs_cold_resume(tmp_path,
+                                                         monkeypatch):
+    """The acceptance run: one fit call shrinks dp8→dp4 mid-epoch without a
+    restart; its continuation is bit-exact (rtol=0) with a cold dp4
+    checkpoint-resume from the shrink-point commit, and tolerance-equal with
+    the uninterrupted dp8 run. Counters, heartbeats, and the
+    ``resilience/resize`` span must all leave fingerprints."""
+    from mxtpu.observability import export, tracer
+    monkeypatch.setenv(elastic.ENV_STALL, "300")  # arm the elastic watchdog
+    was_on = tracer.enabled()
+    tracer.start()
+    try:
+        with _zero_mesh(8):
+            live, er = _elastic_zero_fit(str(tmp_path), shrink_to=4)
+        names = {e.get("name") for e in export.collect_events()}
+    finally:
+        if not was_on:
+            tracer.stop()
+            tracer.reset()
+    assert er.resizes == 1 and er.last_resize_ms > 0
+    stats = profiler.get_resilience_stats()
+    assert stats["live_resizes"] == 1
+    assert stats["restart_fallbacks"] == 0 and stats["restarts"] == 0
+    assert stats["resize_latency_ms_last"] > 0
+    assert "resilience/resize" in names
+    assert watchdog.beat_counts().get("elastic", 0) >= 2
+    assert watchdog.active() is None  # elastic watchdog disarmed after
+
+    # cold resume: fresh process-state equivalent — new module, dp4 mesh,
+    # restore the shrink-point commit, run the remaining batches
+    with _zero_mesh(4):
+        cold = _plain_zero_fit(resume_from=str(tmp_path))
+    _assert_params_equal(live, cold, rtol=0.0, atol=0.0)
+
+    # vs uninterrupted dp8: the dp reduction order changed at the shrink, so
+    # parity is the documented tolerance (same contract as the crash
+    # matrix's halved-dp cells), not bit-exact
+    with _zero_mesh(8):
+        base = _plain_zero_fit()
+    _assert_params_equal(live, base, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_live_grow_dp4_to_dp8_bit_exact_vs_cold_resume(tmp_path):
+    """Scale-out works with the same machinery: dp4→dp8 mid-epoch, again
+    bit-exact with a cold dp8 resume from the grow-point commit."""
+    with _zero_mesh(4):
+        live, er = _elastic_zero_fit(str(tmp_path), shrink_to=8)
+    assert er.resizes == 1
+    assert profiler.get_resilience_stats()["live_resizes"] == 1
+    with _zero_mesh(8):
+        cold = _plain_zero_fit(resume_from=str(tmp_path))
+    _assert_params_equal(live, cold, rtol=0.0, atol=0.0)
+
+
+def test_resize_without_zero_step_raises():
+    """No ZeRO-engaged fused step → nothing to re-bucket: resize_now must
+    raise ResizeError (the supervisor's cue to restart instead)."""
+    mod = mx.Module(_mlp(), data_names=("data",),
+                    label_names=("softmax_label",))
+    er = ElasticRun(mod)
+    with pytest.raises(ResizeError):
+        er.resize_now(4)
+    assert profiler.get_resilience_stats()["live_resizes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: resize fault → supervisor restart fallback
+# ---------------------------------------------------------------------------
+
+
+def test_resize_fault_falls_back_to_supervised_restart(tmp_path, monkeypatch):
+    """A crash injected at the ``elastic.resize`` seam aborts the in-place
+    path; ``supervise`` records a ``restart_fallback`` and restarts from the
+    shrink-point commit. The resumed attempt skips the shrink batch, so it
+    finishes at dp8 — bit-exact with the uninterrupted dp8 run."""
+    _arm(monkeypatch, "site=elastic.resize:at=1:kind=crash:attempt=1")
+    run_dir = str(tmp_path / "run")
+    seen = []
+    sentinel = object()
+    with _zero_mesh(8):
+        base = _plain_zero_fit()
+
+        def _fit(ctx):
+            seen.append(ctx.elastic)
+            params, _er = _elastic_zero_fit(run_dir, shrink_to=4,
+                                            resume_from=ctx.resume_from())
+            return params
+
+        res = supervise(_fit, directory=run_dir, restart_backoff_s=0.01,
+                        elastic=sentinel)
+    assert res.attempts == 2 and res.restarts == 1
+    assert seen == [sentinel, sentinel]   # ctx carries the elastic handle
+    assert "ResizeError" in res.errors[0]
+    assert "injected crash" in res.errors[0]
+    stats = profiler.get_resilience_stats()
+    assert stats["faults_injected"] == 1
+    assert stats["restart_fallbacks"] == 1
+    assert stats["live_resizes"] == 0
+    assert stats["restarts"] == 1
+    _assert_params_equal(res.result, base)
+
+
+def test_elastic_is_inline_only():
+    with pytest.raises(ValueError):
+        supervise(lambda ctx: None, mode="process", elastic=object())
+
+
+# ---------------------------------------------------------------------------
+# satellite: elastic watchdog nesting
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_watchdog_nests_inside_step_watchdog(monkeypatch):
+    """Arming the elastic deadline around a resize must not clobber an
+    armed step watchdog — stop() restores the previously active one."""
+    wd_step = watchdog.Watchdog(deadline_s=300).start()
+    try:
+        assert watchdog.active() is wd_step
+        monkeypatch.setenv(elastic.ENV_STALL, "300")
+        with elastic.elastic_watchdog() as wd_e:
+            assert wd_e is not None
+            assert watchdog.active() is wd_e
+            watchdog.heartbeat("elastic")
+        assert watchdog.active() is wd_step
+    finally:
+        wd_step.stop()
+    assert watchdog.active() is None
+    assert watchdog.beat_counts()["elastic"] >= 1
+    # unset env → no-op context
+    monkeypatch.delenv(elastic.ENV_STALL)
+    with elastic.elastic_watchdog() as wd_none:
+        assert wd_none is None and watchdog.active() is None
+
+
+# ---------------------------------------------------------------------------
+# serving drain/adopt: zero drops, bit-exact continuation
+# ---------------------------------------------------------------------------
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.rng.seed(0)
+    from mxtpu.gluon.model_zoo import transformer_lm
+    model = transformer_lm("tiny", vocab_size=VOCAB)
+    model.initialize()
+    return model
+
+
+def _solo(model, prompt, max_new):
+    out = model.generate(nd.array(np.array([prompt], np.int32)), max_new)
+    return np.asarray(out.data)[0, len(prompt):].tolist()
+
+
+def test_serving_drain_adopt_zero_drops_bit_exact(net):
+    """Mid-flight requests (two decoding in slots, one still queued) survive
+    a drain → adopt handoff onto a second engine: zero cancels/expires, and
+    every result is bit-exact with solo ``generate``. Admission during the
+    drain is refused, not silently dropped."""
+    from mxtpu.serving import ServingEngine
+    rs = np.random.RandomState(7)
+    trace = [(rs.randint(1, VOCAB, size=n).tolist(), new)
+             for n, new in [(3, 40), (17, 30), (9, 45)]]
+    refs = [_solo(net, p, m) for p, m in trace]
+
+    eng = ServingEngine(net, slots=2, queue_depth=8, chunk=4).start()
+    reqs = [eng.submit(p, m) for p, m in trace]
+    t0 = time.monotonic()
+    while profiler.get_serving_stats()["prefills"] < 2:  # both slots busy
+        assert time.monotonic() - t0 < 300, "prefill never happened"
+        time.sleep(0.02)
+    handoff = eng.drain()
+    with pytest.raises(RuntimeError):
+        eng.submit([1], 5)
+    assert handoff.in_flight >= 1          # fast decode may finish some
+    stats = profiler.get_serving_stats()
+    assert stats["cancelled"] == 0 and stats["expired"] == 0
+    assert stats["drained"] == handoff.in_flight
+
+    eng2 = ServingEngine(net, slots=2, queue_depth=8, chunk=4)
+    eng2.adopt(handoff)
+    outs = [r.result(timeout=300) for r in reqs]
+    eng2.stop()
+    assert outs == refs                    # zero drops, bit-exact
+    stats = profiler.get_serving_stats()
+    assert stats["cancelled"] == 0 and stats["expired"] == 0
+    assert stats["adopted"] == handoff.in_flight
+    assert stats["completed"] == len(trace)
+
+
+def test_serving_drain_fault_sweeps_instead_of_blocking(net, monkeypatch):
+    """A fault at the ``serving.drain`` seam aborts the handoff — the
+    cancel-everything sweep must still run so no caller blocks forever."""
+    from mxtpu.serving import RequestCancelled, ServingEngine
+    _arm(monkeypatch, "site=serving.drain:at=1:kind=crash")
+    eng = ServingEngine(net, slots=1, queue_depth=8, chunk=4).start()
+    r = eng.submit([1, 2, 3], 40)
+    with pytest.raises(faults.InjectedFault):
+        eng.drain()
+    with pytest.raises(RequestCancelled):
+        r.result(timeout=60)
+    assert profiler.get_resilience_stats()["faults_injected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# rendezvous: mock transport — join, rank loss, re-join
+# ---------------------------------------------------------------------------
+
+
+class _MockCoordinator:
+    """In-process stand-in for the pod coordinator: tracks members per
+    (address, world-size) gang and refuses a rank joining twice."""
+
+    def __init__(self):
+        self.members = {}
+        self.joins = 0
+
+    def join(self, pid, world):
+        if pid in self.members:
+            raise RuntimeError(f"rank {pid} already joined")
+        if pid is not None and world is not None and pid >= world:
+            raise RuntimeError(f"rank {pid} outside world {world}")
+        self.members[pid] = world
+        self.joins += 1
+
+    def leave(self, pid):
+        self.members.pop(pid, None)
+
+
+class _MockTransport(dist.Transport):
+    def __init__(self, coord, fail_first=0):
+        self.coord = coord
+        self.fail_first = fail_first
+        self.pid = None
+        self.world = None
+        self._connected = False
+
+    def connect(self, coordinator_address, num_processes, process_id):
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            raise RuntimeError("UNAVAILABLE: coordinator not listening")
+        self.coord.join(process_id, num_processes)
+        self.pid, self.world = process_id, num_processes
+        self._connected = True
+
+    def disconnect(self):
+        self.coord.leave(self.pid)
+        self._connected = False
+
+    def connected(self):
+        return self._connected
+
+    def process_index(self):
+        return self.pid or 0
+
+    def process_count(self):
+        return self.world or 1
+
+
+@pytest.fixture
+def mock_transport():
+    coord = _MockCoordinator()
+    t = _MockTransport(coord)
+    prev = dist.set_transport(t)
+    try:
+        yield coord, t
+    finally:
+        dist.set_transport(prev)
+
+
+def test_rendezvous_join_is_idempotent_and_bumps_generation(mock_transport):
+    coord, t = mock_transport
+    g0 = dist.generation()
+    assert not dist.is_initialized()
+    dist.initialize("coord:1", 2, 0)
+    assert dist.is_initialized()
+    assert dist.rank() == 0 and dist.size() == 2
+    assert dist.generation() == g0 + 1
+    dist.initialize("coord:1", 2, 0)      # second call: no-op, no re-join
+    assert coord.joins == 1 and dist.generation() == g0 + 1
+
+
+def test_rendezvous_shutdown_initialize_reentry(mock_transport):
+    """The leave/re-join protocol: shutdown is idempotent, and a rank can
+    re-enter the pod afterwards (new world size, new generation)."""
+    coord, t = mock_transport
+    dist.initialize("coord:1", 2, 1)
+    g1 = dist.generation()
+    dist.shutdown()
+    assert not dist.is_initialized() and not t.connected()
+    dist.shutdown()                        # idempotent: no double-leave
+    dist.initialize("coord:1", 1, 0)       # re-entry at a new world size
+    assert dist.is_initialized() and dist.size() == 1
+    assert dist.generation() == g1 + 1
+    dist.shutdown()
+
+
+def test_rendezvous_rank_loss_and_rejoin(mock_transport, monkeypatch):
+    """Peer loss → the survivor re-rendezvouses at the shrunk world size in
+    one ``rejoin`` call; a transient coordinator flake during the re-join is
+    absorbed by the shared retry policy."""
+    monkeypatch.setenv("MXTPU_RETRY_BACKOFF_S", "0.001")
+    coord, t = mock_transport
+    dist.initialize("coord:1", 2, 0)
+    g1 = dist.generation()
+    # rank 1 dies; the coordinator tells us the gang is now world=1.
+    # make the first reconnect flaky — retry_transient must absorb it
+    t.fail_first = 1
+    g2 = dist.rejoin("coord:1", 1, 0)
+    assert g2 == g1 + 1
+    assert dist.is_initialized() and dist.size() == 1 and dist.rank() == 0
+    assert profiler.get_resilience_stats()["retries"] == 1
+    assert coord.members == {0: 1}
+    dist.shutdown()
+
+
+def test_rendezvous_fault_seam_fires_on_mock(mock_transport, monkeypatch):
+    """The ``dist.initialize`` fault seam keeps working through the
+    transport seam (crash kind escalates, no join happens)."""
+    coord, t = mock_transport
+    _arm(monkeypatch, "site=dist.initialize:at=1:kind=crash")
+    with pytest.raises(Exception):
+        dist.initialize("coord:1", 2, 0)
+    assert not dist.is_initialized() and coord.joins == 0
+
+
+# ---------------------------------------------------------------------------
+# launcher: ssh mode — rank plan, quoting, env contract
+# ---------------------------------------------------------------------------
+
+
+def _launch_mod():
+    path = os.path.join(ROOT, "tools", "launch.py")
+    spec = importlib.util.spec_from_file_location("mxtpu_tools_launch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_host_plan_rank_blocks_and_coordinator():
+    launch = _launch_mod()
+    plan = launch.host_plan(["h0", "h1"], workers_per_host=2, port=1234)
+    assert [(h, r) for h, r, _ in plan] == [("h0", 0), ("h0", 1),
+                                            ("h1", 2), ("h1", 3)]
+    for _h, r, env in plan:
+        assert env["DMLC_PS_ROOT_URI"] == "h0"      # hosts[0] coordinates
+        assert env["DMLC_PS_ROOT_PORT"] == "1234"
+        assert env["DMLC_NUM_WORKER"] == "4"
+        assert env["DMLC_WORKER_ID"] == str(r)
+        assert env["DMLC_ROLE"] == "worker"
+    # root_uri override for hosts not resolvable by their listed name
+    plan = launch.host_plan(["h0"], root_uri="10.0.0.5")
+    assert plan[0][2]["DMLC_PS_ROOT_URI"] == "10.0.0.5"
+    with pytest.raises(ValueError):
+        launch.host_plan([])
+    with pytest.raises(ValueError):
+        launch.host_plan(["h0"], workers_per_host=0)
+
+
+def test_ssh_command_survives_double_shell_evaluation():
+    import shlex
+    launch = _launch_mod()
+    env = {"DMLC_WORKER_ID": "0", "A": "x y"}
+    argv = launch.ssh_command("h0", env, ["python", "train.py",
+                                          "--msg", "hello world"])
+    assert argv[0] == "ssh" and argv[1] == "h0"
+    # what the remote shell re-splits must be the original word list
+    assert shlex.split(argv[2]) == ["env", "A=x y", "DMLC_WORKER_ID=0",
+                                    "python", "train.py", "--msg",
+                                    "hello world"]
+
+
+def test_launch_ssh_emits_env_contract(tmp_path):
+    """End-to-end with a fake ssh (runs the remote command locally): every
+    worker boots with the full DMLC_* contract, block-ranked across hosts."""
+    launch = _launch_mod()
+    fake = tmp_path / "fake-ssh"
+    fake.write_text("#!/bin/sh\nshift\nexec /bin/sh -c \"$1\"\n")
+    fake.chmod(0o755)
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    snippet = (
+        "import json, os, sys\n"
+        "env = {k: v for k, v in os.environ.items()"
+        " if k.startswith('DMLC_')}\n"
+        "p = os.path.join(sys.argv[1], env['DMLC_WORKER_ID'] + '.json')\n"
+        "open(p, 'w').write(json.dumps(env))\n")
+    rc = launch.launch_ssh(["hostA", "hostB"],
+                           [sys.executable, "-c", snippet, str(outdir)],
+                           workers_per_host=2, port=7777, ssh_bin=str(fake))
+    assert rc == 0
+    assert sorted(os.listdir(outdir)) == ["0.json", "1.json",
+                                          "2.json", "3.json"]
+    for wid in range(4):
+        with open(outdir / f"{wid}.json") as f:
+            env = json.load(f)
+        assert env["DMLC_PS_ROOT_URI"] == "hostA"
+        assert env["DMLC_PS_ROOT_PORT"] == "7777"
+        assert env["DMLC_NUM_WORKER"] == "4"
+        assert env["DMLC_WORKER_ID"] == str(wid)
+        assert env["DMLC_ROLE"] == "worker"
+        assert env["DMLC_NUM_SERVER"] == "0"
+
+
+# ---------------------------------------------------------------------------
+# -m slow: SIGKILL mid-resize — process-mode fallback equals the live shrink
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sigkill_mid_resize_restart_equals_live_shrink(tmp_path, monkeypatch):
+    """The hard-loss cell: the child is SIGKILLed AT the resize seam (after
+    the shrink-point commit), the supervisor respawns it at dp4, and the
+    cold continuation lands on exactly the params the live in-place shrink
+    produces — the two elasticity paths are interchangeable."""
+    with _zero_mesh(8):
+        want, _er = _elastic_zero_fit(str(tmp_path / "want"), shrink_to=4)
+    _arm(monkeypatch, "site=elastic.resize:at=1:kind=kill:attempt=1")
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir, exist_ok=True)
+    res = supervise(_elastic_supervised_fit, directory=run_dir,
+                    mode="process", dp_schedule=[8, 4],
+                    restart_backoff_s=0.05, attempt_timeout_s=300)
+    assert res.restarts == 1
+    assert -signal.SIGKILL in res.exit_codes and res.exit_codes[-1] == 0
+    assert profiler.get_resilience_stats()["restarts"] == 1
+    _assert_params_equal(_result_params(run_dir), want, rtol=0.0, atol=0.0)

@@ -357,7 +357,10 @@ def test_resilience_stats_shape_and_reset():
                           "escalations", "watchdog_stalls", "emergency_saves",
                           "restarts", "steps_lost",
                           "restart_latency_ms_total",
-                          "restart_latency_ms_last"}
+                          "restart_latency_ms_last",
+                          "live_resizes", "restart_fallbacks",
+                          "resize_latency_ms_total",
+                          "resize_latency_ms_last"}
     assert all(v == 0 for v in stats.values())
     profiler.record_resilience("retries")
     profiler.record_resilience("restart_latency_ms_last", 5.0)
@@ -412,7 +415,7 @@ def test_dist_is_initialized_syncs_flag_state(monkeypatch):
     # re-connecting; the predicate reads that client state directly — NOT
     # jax.process_count(), which would initialize the XLA backend and
     # thereby forbid a first jax.distributed.initialize
-    monkeypatch.setattr(dist, "_pod_connected", lambda: True)
+    monkeypatch.setattr(dist.get_transport(), "connected", lambda: True)
     assert dist.is_initialized() is True
     assert dist._initialized is True
     called = []

@@ -1,22 +1,33 @@
 #!/usr/bin/env python
 """Multi-process launcher — parity with the reference's ``tools/launch.py``
-(dmlc-tracker local mode, launch.py:1-80).
+(dmlc-tracker local AND ssh modes, launch.py:1-80, ssh.py).
 
-Spawns ``-n`` worker processes on this host with the DMLC_* env contract that
-``mxtpu.dist.auto_initialize`` consumes (DMLC_PS_ROOT_URI/PORT, DMLC_NUM_WORKER,
-DMLC_WORKER_ID). There is no server/scheduler role: rank 0's port doubles as the
-jax.distributed coordinator, and "server-side" reduction is an XLA collective on
-every rank (see mxtpu/dist.py). ssh/mpi/yarn launchers are out of scope — multi-host
-pods should use the platform's pod launcher with the same env contract.
+Both modes spawn workers carrying the DMLC_* env contract that
+``mxtpu.dist.auto_initialize`` consumes (DMLC_PS_ROOT_URI/PORT,
+DMLC_NUM_WORKER, DMLC_WORKER_ID). There is no server/scheduler role: rank 0's
+host:port doubles as the jax.distributed coordinator, and "server-side"
+reduction is an XLA collective on every rank (see mxtpu/dist.py).
+
+* ``--launcher local`` — ``-n`` worker processes on this host (testing,
+  single-host multi-process).
+* ``--launcher ssh``   — one ssh session per remote worker. Ranks are
+  assigned in blocks: host order × ``--workers-per-host``, with
+  ``hosts[0]`` as the coordinator (its address becomes DMLC_PS_ROOT_URI for
+  every rank). The remote command is ``env K=V ... <your command>`` — no
+  remote-side wrapper script to install, matching the dmlc-tracker ssh
+  contract. ``--ssh-bin`` exists so tests substitute a local stand-in.
 
 Usage:
   python tools/launch.py -n 2 [--devices-per-worker 4] python train.py ...
+  python tools/launch.py --launcher ssh --hosts a,b --workers-per-host 2 \\
+      python train.py ...
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -27,6 +38,32 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _wait_all(procs) -> int:
+    """Poll until every worker exits; the first non-zero exit tears the job
+    down immediately — peers would otherwise block forever inside
+    jax.distributed collectives."""
+    rc = 0
+    live = list(procs)
+    while live:
+        time.sleep(0.2)
+        still = []
+        for p in live:
+            code = p.poll()
+            if code is None:
+                still.append(p)
+            elif code != 0:
+                rc = rc or code
+        live = still
+        if rc:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                p.wait()
+            return rc
+    return rc
 
 
 def launch(num_workers: int, command, devices_per_worker: int = 0,
@@ -50,41 +87,109 @@ def launch(num_workers: int, command, devices_per_worker: int = 0,
             env["JAX_PLATFORMS"] = "cpu"
         env.update(env_extra or {})
         procs.append(subprocess.Popen(list(command), env=env))
-    # Poll: the first non-zero exit tears the job down immediately — peers would
-    # otherwise block forever inside jax.distributed collectives.
-    rc = 0
-    live = list(procs)
-    while live:
-        time.sleep(0.2)
-        still = []
-        for p in live:
-            code = p.poll()
-            if code is None:
-                still.append(p)
-            elif code != 0:
-                rc = rc or code
-        live = still
-        if rc:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-            for p in procs:
-                p.wait()
-            return rc
-    return rc
+    return _wait_all(procs)
+
+
+# -- ssh mode ----------------------------------------------------------------
+
+def host_plan(hosts, workers_per_host: int = 1, port: int = 9091,
+              root_uri=None):
+    """Pure rank/env assignment for a multi-host gang: one ``(host, rank,
+    env)`` tuple per worker, ranks in host-order blocks (host 0 gets ranks
+    ``0..w-1``, host 1 gets ``w..2w-1``, ...). ``hosts[0]`` is the
+    coordinator unless ``root_uri`` overrides it (a host may be listed by a
+    name its peers can't resolve back). Separated from process spawning so
+    the rendezvous contract is unit-testable without ssh."""
+    hosts = list(hosts)
+    if not hosts:
+        raise ValueError("host_plan: no hosts given")
+    if workers_per_host < 1:
+        raise ValueError("host_plan: workers_per_host must be >= 1")
+    total = len(hosts) * workers_per_host
+    uri = root_uri if root_uri is not None else hosts[0]
+    plan = []
+    for hi, host in enumerate(hosts):
+        for wi in range(workers_per_host):
+            env = {
+                "DMLC_ROLE": "worker",
+                "DMLC_PS_ROOT_URI": uri,
+                "DMLC_PS_ROOT_PORT": str(port),
+                "DMLC_NUM_WORKER": str(total),
+                "DMLC_NUM_SERVER": "0",
+                "DMLC_WORKER_ID": str(hi * workers_per_host + wi),
+            }
+            plan.append((host, hi * workers_per_host + wi, env))
+    return plan
+
+
+def ssh_command(host: str, env: dict, command, ssh_bin: str = "ssh"):
+    """The argv for one remote worker: ``ssh <host> env K=V ... cmd...``.
+    The remote side is a single shell word-list — every env value and
+    command token is shell-quoted, so prompts/paths with spaces survive the
+    ssh → remote-shell double evaluation."""
+    remote = ["env"] + [f"{k}={v}" for k, v in sorted(env.items())] \
+        + list(command)
+    return [ssh_bin, host, " ".join(shlex.quote(tok) for tok in remote)]
+
+
+def launch_ssh(hosts, command, workers_per_host: int = 1, port: int = 9091,
+               root_uri=None, ssh_bin: str = "ssh", env_extra=None) -> int:
+    """Start one ssh session per planned worker and babysit them with the
+    same first-failure-tears-down policy as local mode."""
+    procs = []
+    for host, _rank, env in host_plan(hosts, workers_per_host, port,
+                                      root_uri):
+        env = dict(env)
+        env.update(env_extra or {})
+        procs.append(subprocess.Popen(ssh_command(host, env, command,
+                                                  ssh_bin)))
+    return _wait_all(procs)
+
+
+def _parse_hosts(args) -> list:
+    hosts = []
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [ln.strip() for ln in f if ln.strip()
+                     and not ln.lstrip().startswith("#")]
+    if args.hosts:
+        hosts += [h.strip() for h in args.hosts.split(",") if h.strip()]
+    return hosts
 
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-n", "--num-workers", type=int, default=0,
+                    help="local mode: workers on this host")
     ap.add_argument("--devices-per-worker", type=int, default=0,
                     help="force N virtual CPU devices per worker (testing)")
-    ap.add_argument("--launcher", default="local", choices=["local"],
-                    help="only local (single-host multi-process) is supported")
+    ap.add_argument("--launcher", default="local", choices=["local", "ssh"])
+    ap.add_argument("--hosts", default="",
+                    help="ssh mode: comma-separated host list")
+    ap.add_argument("--hostfile", default="",
+                    help="ssh mode: file with one host per line (# comments)")
+    ap.add_argument("--workers-per-host", type=int, default=1)
+    ap.add_argument("--port", type=int, default=9091,
+                    help="ssh mode: coordinator port on hosts[0]")
+    ap.add_argument("--root-uri", default=None,
+                    help="ssh mode: coordinator address override "
+                         "(default hosts[0])")
+    ap.add_argument("--ssh-bin", default="ssh",
+                    help="ssh executable (tests substitute a local stand-in)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+    if args.launcher == "ssh":
+        hosts = _parse_hosts(args)
+        if not hosts:
+            ap.error("ssh launcher needs --hosts or --hostfile")
+        sys.exit(launch_ssh(hosts, args.command,
+                            workers_per_host=args.workers_per_host,
+                            port=args.port, root_uri=args.root_uri,
+                            ssh_bin=args.ssh_bin))
+    if args.num_workers < 1:
+        ap.error("local launcher needs -n >= 1")
     sys.exit(launch(args.num_workers, args.command, args.devices_per_worker))
 
 
