@@ -967,18 +967,30 @@ def bench_comm():
         log(f"[comm] {mb:.0f}MB: {ms:.2f} ms/iter, algbw {alg:.2f} GB/s "
             f"({out['tier']})")
     out["all_to_all_probe"] = _all_to_all_probe()
+    probe = out["all_to_all_probe"]
+    ar64 = next((ms for mb, ms, _, _ in rows if int(mb) == 64), None)
+    a2a64 = (probe.get("sizes", {}).get("64MB") or {})
+    a2a_ms = a2a64.get("shard_map_ms") \
+        if probe.get("default_impl") == "shard_map" \
+        else a2a64.get("jit_reshard_ms")
+    if ar64 and a2a_ms:
+        # ratcheted up-is-good orientation: how many a2a exchanges fit in one
+        # same-size allreduce (VERDICT measured 0.12 — the 8.6x anomaly —
+        # against the ≥1 expected from a2a moving half the bytes)
+        out["a2a_vs_allreduce_ratio"] = round(ar64 / a2a_ms, 3)
+        log(f"[comm] a2a_vs_allreduce_ratio (64MB, allreduce_ms/a2a_ms): "
+            f"{out['a2a_vs_allreduce_ratio']}")
     return out
 
 
-def _all_to_all_probe(mb: float = 4.0, iters: int = 6):
-    """Point timing for the MULTICHIP all_to_all anomaly: the SAME logical
-    shard-ownership transpose measured two ways on the same mesh — (a) the
-    ``shard_map``+``lax.all_to_all`` lowering behind
-    ``parallel.collectives.all_to_all_array`` (what Ulysses/MoE dispatch
-    use), and (b) a bare ``jax.jit`` resharding (identity with the output
-    sharding), where the partitioner itself picks the collective. A large
-    ratio between the two legs localizes the anomaly to the lowering rather
-    than the wire."""
+def _all_to_all_probe(sizes_mb=(1.0, 16.0, 64.0), iters: int = 6):
+    """Before/after sweep for the all_to_all lowering anomaly (ISSUE 12): the
+    SAME logical shard-ownership transpose timed through
+    ``collectives.all_to_all_array`` under BOTH impls — the legacy
+    ``shard_map``+``lax.all_to_all`` lowering and the ``jit_reshard`` default
+    (GSPMD-native a2a from a spec flip) — at {1, 16, 64} MB, plus a bare
+    ``jax.jit`` reshard as the floor. ``gap`` is the default path over that
+    floor: the acceptance bar is gap ≤ 1.5 (the old lowering measured ~12.6×)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -990,13 +1002,10 @@ def _all_to_all_probe(mb: float = 4.0, iters: int = 6):
     if n == 1:
         return {"skipped": "single device"}
     ax = mesh.axis_names[0]
-    rows = max(n, int(mb * 1e6 / 4 // (n * 128)) * n)
-    x = jax.device_put(
-        jnp.arange(rows * n * 128, dtype=jnp.float32).reshape(rows, n * 128),
-        NamedSharding(mesh, P(ax, None)))
-    nbytes = x.size * 4
+    resharded = NamedSharding(mesh, P(None, ax))
+    raw_reshard = jax.jit(lambda v: v, out_shardings=resharded)
 
-    def timed(fn):
+    def timed(fn, x):
         fn(x).block_until_ready()                   # compile
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -1004,19 +1013,37 @@ def _all_to_all_probe(mb: float = 4.0, iters: int = 6):
         r.block_until_ready()
         return 1e3 * (time.perf_counter() - t0) / iters
 
-    shard_map_ms = timed(lambda v: collectives.all_to_all_array(
-        v, mesh, split_axis=1, concat_axis=0))
-    resharded = NamedSharding(mesh, P(None, ax))
-    jit_reshard = jax.jit(lambda v: v, out_shardings=resharded)
-    jit_ms = timed(jit_reshard)
-    probe = {"bytes": int(nbytes),
-             "shard_map_ms": round(shard_map_ms, 3),
-             "jit_reshard_ms": round(jit_ms, 3),
-             "ratio": round(shard_map_ms / max(jit_ms, 1e-9), 2)}
-    log(f"[comm] all_to_all probe ({nbytes/1e6:.1f} MB): shard_map "
-        f"{shard_map_ms:.2f} ms vs jit-reshard {jit_ms:.2f} ms "
-        f"(ratio {probe['ratio']}x)")
-    return probe
+    default_impl = collectives.a2a_impl()
+    sizes = {}
+    for mb in sizes_mb:
+        rows = max(n, int(mb * 1e6 / 4 / (n * 128)) // n * n)
+        x = jax.device_put(
+            jnp.arange(rows * n * 128,
+                       dtype=jnp.float32).reshape(rows, n * 128),
+            NamedSharding(mesh, P(ax, None)))
+        nbytes = x.size * 4
+        shard_map_ms = timed(lambda v: collectives.all_to_all_array(
+            v, mesh, split_axis=1, concat_axis=0, impl="shard_map"), x)
+        jit_ms = timed(lambda v: collectives.all_to_all_array(
+            v, mesh, split_axis=1, concat_axis=0, impl="jit_reshard"), x)
+        floor_ms = timed(raw_reshard, x)
+        default_ms = shard_map_ms if default_impl == "shard_map" else jit_ms
+        entry = {"bytes": int(nbytes),
+                 "shard_map_ms": round(shard_map_ms, 3),
+                 "jit_reshard_ms": round(jit_ms, 3),
+                 "raw_reshard_ms": round(floor_ms, 3),
+                 "ratio": round(shard_map_ms / max(jit_ms, 1e-9), 2),
+                 "gap": round(default_ms / max(floor_ms, 1e-9), 2)}
+        sizes[f"{int(mb)}MB"] = entry
+        log(f"[comm] all_to_all {mb:.0f}MB: shard_map "
+            f"{shard_map_ms:.2f} ms vs jit-reshard {jit_ms:.2f} ms "
+            f"(before/after {entry['ratio']}x; default gap {entry['gap']}x)")
+    head = sizes[f"{int(sizes_mb[-1])}MB"]
+    return {"default_impl": default_impl, "sizes": sizes,
+            # headline keys (largest size) — bench-guard back-compat
+            "bytes": head["bytes"], "shard_map_ms": head["shard_map_ms"],
+            "jit_reshard_ms": head["jit_reshard_ms"],
+            "ratio": head["ratio"], "gap": head["gap"]}
 
 
 def _lenet_module(batch: int, setup: bool = True):
@@ -1459,11 +1486,18 @@ def apply_ratchet(doc: dict, harness: str):
         serving_block = doc.get("serving")
         serving_goodput = serving_block.get("goodput_tok_s") \
             if isinstance(serving_block, dict) else None
+        comm_block = doc.get("comm")
+        a2a_ratio = comm_block.get("a2a_vs_allreduce_ratio") \
+            if isinstance(comm_block, dict) else None
+        metric_name = doc.get("metric") or ""
+        img_val = doc.get("value") if metric_name.endswith("imgs_per_sec") \
+            else None
         metrics = {}
-        for key, val in (("img_s", doc.get("value")), ("mfu", mfu_val),
+        for key, val in (("img_s", img_val), ("mfu", mfu_val),
                          ("steps_per_sec", block.get("steps_per_sec")),
                          ("fsdp_param_slot_shrink", fsdp_shrink),
-                         ("serving_goodput", serving_goodput)):
+                         ("serving_goodput", serving_goodput),
+                         ("a2a_vs_allreduce_ratio", a2a_ratio)):
             if isinstance(val, (int, float)) and val > 0:
                 metrics[key] = val
         path = _ratchet_path()
@@ -1661,6 +1695,46 @@ def _emit_resilience_only(smoke: bool) -> None:
            "unit": "params_match",
            "platform": jax.default_backend(),
            "resilience": resil}
+    print(json.dumps(doc))
+
+
+def _comm_only() -> bool:
+    """``bench.py comm`` — run just the comm leg (allreduce bandwidth tiers +
+    the a2a before/after sweep) and emit a comm-only JSON line. On a
+    single-device host the sweep runs on an 8-way virtual CPU mesh
+    (``force_virtual_cpu_devices``) and ratchets under ``comm-virtual8`` so
+    virtual-wire numbers never mix with real-pod baselines."""
+    return "comm" in sys.argv[1:]
+
+
+def _emit_comm_only() -> None:
+    import jax
+    harness = "comm"
+    if len(jax.devices()) == 1 \
+            and os.environ.get("MXTPU_BENCH_COMM_VIRTUAL") != "1":
+        # the device-count flag only lands at backend init — re-exec with the
+        # 8-way virtual pod (same trick as the cpu-fallback re-exec)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MXTPU_BENCH_COMM_VIRTUAL="1",
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                              + " --xla_force_host_platform_device_count=8"
+                              ).strip())
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
+    if os.environ.get("MXTPU_BENCH_COMM_VIRTUAL") == "1":
+        harness = "comm-virtual8"
+    comm = run_leg("comm", bench_comm)
+    probe = comm.get("all_to_all_probe", {}) if isinstance(comm, dict) else {}
+    doc = {"metric": "a2a_vs_allreduce_ratio",
+           "value": (comm.get("a2a_vs_allreduce_ratio", 0.0)
+                     if isinstance(comm, dict) else 0.0),
+           "unit": "allreduce_ms/a2a_ms (64MB)",
+           "platform": jax.default_backend(),
+           "a2a_gap": probe.get("gap"),
+           "comm": comm}
+    apply_ratchet(doc, harness)
     print(json.dumps(doc))
 
 
@@ -2242,6 +2316,11 @@ def main():
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)]
                   + sys.argv[1:], env)
+    if _comm_only():
+        # comm-only runs on ANY backend: single-device/cpu hosts get the
+        # 8-way virtual mesh inside _emit_comm_only
+        _emit_comm_only()
+        return
     if os.environ.get("MXTPU_BENCH_FALLBACK") == "1" \
             or jax.default_backend() == "cpu":
         bench_cpu_fallback()

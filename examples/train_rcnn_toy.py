@@ -178,18 +178,20 @@ def build_symbol(batch, num_classes=3):
     roi_gt = sym.take(gt_cls, roi_img)                     # (R,)
     roi_label = sym.where(own_iou > 0.5, roi_gt + 1.0, sym.zeros_like(roi_gt))
 
-    # stage-2 head on pooled features — joint training, with the ROI loss
-    # batch-normalized and down-scaled: unscaled, its background-dominated
-    # gradient swamps the shared convs and collapses the RPN score map to the
-    # positive base rate (the failure the reference avoids by subsampling
-    # rois in proposal_target and by its alternating-training schedule).
-    pooled = sym.ROIPooling(feat, rois, pooled_size=(4, 4),
+    # stage-2 head on pooled features — trained on FROZEN shared features
+    # (BlockGrad on feat): the in-graph rendering of the reference's
+    # alternating-training schedule. Joint training at any useful ROI loss
+    # scale lets the background-dominated stage-2 gradient swamp the shared
+    # convs and collapse the RPN score map to the positive base rate; with
+    # the feature path blocked, the head trains at full scale while the
+    # RPN alone owns the backbone.
+    pooled = sym.ROIPooling(sym.BlockGrad(feat), rois, pooled_size=(4, 4),
                             spatial_scale=1.0 / STRIDE)    # (R, 64, 4, 4)
     h1 = sym.Activation(sym.FullyConnected(sym.Flatten(pooled), num_hidden=64,
                                            name="fc6"), act_type="relu")
     cls_score = sym.FullyConnected(h1, num_hidden=num_classes + 1, name="cls")
     roi_cls_loss = sym.SoftmaxOutput(cls_score, sym.BlockGrad(roi_label),
-                                     grad_scale=0.3, normalization="batch",
+                                     grad_scale=1.0, normalization="batch",
                                      name="roi_cls_loss")
 
     from mxtpu.symbol import Group

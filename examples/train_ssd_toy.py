@@ -63,7 +63,9 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=150)
     p.add_argument("--batch-size", type=int, default=16)
-    p.add_argument("--lr", type=float, default=0.04)
+    # note the step is doubly normalized: the loss divides by num_pos*B and
+    # trainer.step(batch_size) divides by B again — lr is calibrated for that
+    p.add_argument("--lr", type=float, default=0.4)
     p.add_argument("--eval-iou", type=float, default=0.4)
     args = p.parse_args()
 

@@ -75,6 +75,16 @@ class JaxTransport(Transport):
     """The real thing: ``jax.distributed`` against the pod coordinator."""
 
     def connect(self, coordinator_address, num_processes, process_id) -> None:
+        # CPU backends ship multiprocess collectives (gloo-over-TCP) but jax
+        # defaults the implementation to "none", so every process-spanning
+        # computation dies with "Multiprocess computations aren't implemented
+        # on the CPU backend" — the tier-1 test_dist failure mode. Select
+        # gloo before the backend initializes; harmless on TPU (the flag only
+        # affects CPU clients) and a no-op if the backend is already up.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

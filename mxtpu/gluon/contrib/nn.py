@@ -26,6 +26,14 @@ from ..block import HybridBlock
 from ..nn.basic_layers import BatchNorm, Dense
 
 
+def _layout_constrain(x: NDArray, entry: str) -> NDArray:
+    """SpecLayout activation constraint (identity unless a composed-mesh
+    step is tracing under ``parallel.fsdp.layout_scope``)."""
+    from ...parallel import fsdp as _fsdp   # lazy: parallel imports gluon
+    raw = _fsdp.constrain(x.data, entry)
+    return x if raw is x.data else NDArray(raw)
+
+
 class SyncBatchNorm(BatchNorm):
     """BatchNorm whose batch statistics are averaged across the ``dp`` mesh axis.
 
@@ -115,8 +123,15 @@ class MultiHeadAttention(HybridBlock):
         q = self.q_proj(x).reshape((B, T, H, D)).transpose((0, 2, 1, 3))
         k = self.k_proj(mem).reshape((B, mem.shape[1], H, D)).transpose((0, 2, 1, 3))
         v = self.v_proj(mem).reshape((B, mem.shape[1], H, D)).transpose((0, 2, 1, 3))
+        # Ulysses spec flip (active only under parallel.fsdp.layout_scope):
+        # incoming activations are sequence-sharded; constraining q/k/v to the
+        # head-sharded layout makes GSPMD emit the seq->head all-to-all, the
+        # kernel sees the FULL sequence for its head group, and the output
+        # constraint flips back (DeepSpeed-Ulysses as two reshards).
+        q, k, v = (_layout_constrain(t, "head_activations") for t in (q, k, v))
         out = nd.contrib.flash_attention(q, k, v, causal=self._causal)
         out = out.transpose((0, 2, 1, 3)).reshape((B, T, self._units))
+        out = _layout_constrain(out, "seq_activations")
         if self._dropout:
             out = nd.Dropout(out, p=self._dropout)
         return self.out_proj(out)

@@ -27,7 +27,7 @@ import math
 
 from ... import ndarray as nd
 from ..block import HybridBlock
-from ..contrib.nn import MultiHeadAttention
+from ..contrib.nn import MultiHeadAttention, _layout_constrain
 from ..nn.basic_layers import Dense, Embedding, LayerNorm
 
 __all__ = ["TransformerBlock", "TransformerLM", "transformer_lm"]
@@ -94,8 +94,12 @@ class TransformerLM(HybridBlock):
         h = self.embedding(tokens)
         pos = nd.slice_axis(self.pos_embed.data(), axis=0, begin=0, end=T)
         h = h + nd.reshape(pos, (1, T, self._units))
+        # composed-flagship layout: activations ride the SpecLayout table
+        # (sequence-sharded through the block stack under a layout_scope,
+        # identity otherwise)
+        h = _layout_constrain(h, "seq_activations")
         for blk in self.blocks:
-            h = blk(h)
+            h = _layout_constrain(blk(h), "seq_activations")
         h = self.ln_f(h)
         if not self._tie:
             return self.head(h)

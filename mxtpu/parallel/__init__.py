@@ -23,7 +23,8 @@ from .mesh import (Mesh, NamedSharding, P, data_axis_names,
 from . import zero
 from .zero import ZeroLayout, zero_bucket_bytes, zero_enabled
 from . import fsdp
-from .fsdp import compose_spec, fsdp_param_specs, zero_stage
+from .fsdp import (SpecLayout, compose_spec, filter_spec, fsdp_param_specs,
+                   layout_scope, parameter_spec_from_name, zero_stage)
 from . import ring_attention
 from .ring_attention import ring_attention_inner, ring_self_attention
 from . import ulysses
@@ -32,3 +33,6 @@ from . import pipeline
 from .pipeline import gpipe
 from . import moe
 from .moe import expert_parallel_ffn
+from . import flagship
+from .flagship import (flagship_mesh, flagship_param_shardings,
+                       flagship_pp_forward, train_flagship)

@@ -17,6 +17,7 @@ Two API levels:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -28,9 +29,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .mesh import Mesh, get_default_mesh
 
 __all__ = ["allreduce", "allreduce_array", "allgather_array", "broadcast_array",
-           "reduce_scatter_array", "all_to_all_array", "barrier", "psum",
-           "pmean", "all_gather", "reduce_scatter", "ppermute", "all_to_all",
-           "shard_map_compat"]
+           "reduce_scatter_array", "all_to_all_array", "a2a_impl", "barrier",
+           "psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
+           "all_to_all", "shard_map_compat"]
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = False):
@@ -130,27 +131,98 @@ def reduce_scatter_array(x, mesh: Optional[Mesh] = None, axis: int = 0):
     return fn(jnp.asarray(x))
 
 
+_A2A_IMPLS = ("jit_reshard", "shard_map")
+_a2a_programs = None
+
+
+def a2a_impl() -> str:
+    """Active array-level all_to_all lowering, selected by ``MXTPU_A2A_IMPL``.
+
+    * ``jit_reshard`` (default) — the fast path the PR 8 ``all_to_all_probe``
+      proved: express the exchange as a sharding-spec flip inside one jitted
+      identity and let GSPMD emit the native all-to-all. The explicit
+      ``shard_map``+``lax.all_to_all`` lowering was ~12.6× slower for the same
+      logical op (VERDICT: 64 MB a2a at 9,582 ms vs 1,117 ms allreduce).
+    * ``shard_map`` — the legacy explicit lowering, kept for A/B comparison.
+    """
+    impl = os.environ.get("MXTPU_A2A_IMPL", "jit_reshard").strip().lower()
+    if impl not in _A2A_IMPLS:
+        raise ValueError(f"MXTPU_A2A_IMPL={impl!r}: expected one of {_A2A_IMPLS}")
+    return impl
+
+
+def _a2a_program_cache():
+    # lazy: collectives loads very early; step_cache registration can wait
+    global _a2a_programs
+    if _a2a_programs is None:
+        from ..step_cache import ProgramCache
+        _a2a_programs = ProgramCache("a2a_reshard")
+    return _a2a_programs
+
+
 def all_to_all_array(x, mesh: Optional[Mesh] = None, split_axis: int = 1,
-                     concat_axis: int = 0):
+                     concat_axis: int = 0, *, axis_name: Optional[str] = None,
+                     tiled: bool = True, impl: Optional[str] = None):
     """Transpose shard ownership: each device scatters its ``split_axis``
     slices to peers and concatenates what it receives along ``concat_axis``
-    (the Ulysses/MoE dispatch primitive at array level). ``x`` is sharded on
-    ``concat_axis`` in, sharded on ``split_axis`` out."""
+    (the Ulysses/MoE dispatch primitive). ``x`` is sharded on ``concat_axis``
+    in, sharded on ``split_axis`` out.
+
+    Two forms, so every all-to-all in the framework routes through ONE place:
+
+    * **in-program** (``axis_name`` given): call from inside a shard_map body —
+      dispatches straight to ``lax.all_to_all`` over that axis (``tiled``
+      honored). MoE dispatch and Ulysses head/sequence exchange use this.
+    * **array-level** (no ``axis_name``): operates on a global ``jax.Array``
+      over the mesh's first axis. The lowering is selected by ``impl`` /
+      ``MXTPU_A2A_IMPL`` (see :func:`a2a_impl`): the default ``jit_reshard``
+      exploits that the tiled exchange is semantically a pure reshard — the
+      global array is unchanged, only its sharding flips from
+      ``concat_axis`` to ``split_axis`` — so a jitted spec flip lets GSPMD
+      emit the native all-to-all instead of the degenerate shard_map lowering.
+      Compiled programs are cached per (mesh, shape, dtype, axes) signature.
+    """
+    if axis_name is not None:
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
+
     mesh = mesh or get_default_mesh()
     ax_name = mesh.axis_names[0]
     if mesh.devices.size == 1:
         return jnp.asarray(x)
-    in_spec = [None] * jnp.ndim(x)
+    x = jnp.asarray(x)
+    in_spec = [None] * x.ndim
     in_spec[concat_axis] = ax_name
-    out_spec = [None] * jnp.ndim(x)
+    out_spec = [None] * x.ndim
     out_spec[split_axis] = ax_name
 
-    def _a2a(v):
-        return lax.all_to_all(v, ax_name, split_axis=split_axis,
-                              concat_axis=concat_axis, tiled=True)
+    chosen = impl or a2a_impl()
+    if chosen not in _A2A_IMPLS:
+        raise ValueError(f"all_to_all_array impl={chosen!r}: expected one of "
+                         f"{_A2A_IMPLS}")
+    key = (chosen, mesh, x.shape, str(x.dtype), split_axis, concat_axis)
 
-    fn = shard_map_compat(_a2a, mesh, P(*in_spec), P(*out_spec))
-    return fn(jnp.asarray(x))
+    if chosen == "jit_reshard":
+        in_sh = NamedSharding(mesh, P(*in_spec))
+        out_sh = NamedSharding(mesh, P(*out_spec))
+
+        def _build_reshard():
+            def _flip(v):
+                v = lax.with_sharding_constraint(v, in_sh)
+                return lax.with_sharding_constraint(v, out_sh)
+            return jax.jit(_flip, out_shardings=out_sh)
+
+        fn = _a2a_program_cache().get_or_build(key, _build_reshard)
+        return fn(x)
+
+    def _build_shard_map():
+        def _a2a(v):
+            return lax.all_to_all(v, ax_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+        return shard_map_compat(_a2a, mesh, P(*in_spec), P(*out_spec))
+
+    fn = _a2a_program_cache().get_or_build(key, _build_shard_map)
+    return fn(x)
 
 
 def broadcast_array(x, mesh: Optional[Mesh] = None, root: int = 0):

@@ -1,0 +1,215 @@
+"""The composed flagship — dp×fsdp×tp(×pp) TransformerLM training on ONE mesh
+(ROADMAP item 1: every parallelism axis the framework grew separately — ZeRO-3
+residency, Megatron tp pairs, Ulysses sequence exchange, GPipe stages —
+composed into a single SPMD step).
+
+Composition recipe:
+
+* **mesh** — ``("dp", "fsdp", "tp")`` (+ ``"pp"`` for the pipelined forward):
+  batch shards over dp×fsdp (``mesh.data_axis_names``), stage-3 params are
+  resident 1/fsdp on free dim 0s, Megatron pairs shard over tp.
+* **specs** — ONE :class:`~mxtpu.parallel.fsdp.SpecLayout` table is the
+  canonical source; :func:`flagship_param_shardings` projects it onto the
+  model's parameter names/shapes, and the model-side activation constraints
+  (``layout_scope``) flip sequence↔head sharding around attention so GSPMD
+  emits the native all-to-all — the same jit-reshard fast path
+  ``collectives.all_to_all_array`` defaults to.
+* **step** — the stock :class:`~mxtpu.parallel.data_parallel.DataParallelTrainer`
+  whole-step jit; nothing flagship-specific compiles. Trace-once is asserted
+  off ``step_cache.cache_stats("data_parallel_step")``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import fsdp as fsdp_mod
+from .data_parallel import DataParallelTrainer
+from .mesh import Mesh, get_default_mesh, make_mesh
+from .pipeline import gpipe
+
+__all__ = ["flagship_mesh", "flagship_param_shardings", "train_flagship",
+           "flagship_pp_forward"]
+
+
+def flagship_mesh(dp: int = 2, fsdp: int = 2, tp: int = 2, pp: int = 1,
+                  devices=None) -> Mesh:
+    """The composed mesh, axes in ICI-locality order: tp (and pp) innermost
+    so the chattiest collectives ride neighbor links; singleton axes are kept
+    — GSPMD treats them as replicated and the SAME program text serves every
+    decomposition of the device count."""
+    shape = (dp, fsdp, tp) + ((pp,) if pp > 1 else ())
+    names = ("dp", "fsdp", "tp") + (("pp",) if pp > 1 else ())
+    return make_mesh(shape, names, devices)
+
+
+def flagship_param_shardings(block, layout: Optional[fsdp_mod.SpecLayout],
+                             mesh: Mesh) -> Callable[[str], P]:
+    """Project the SpecLayout table onto ``block``'s parameters: a
+    ``name -> PartitionSpec`` callable for ``DataParallelTrainer``, with each
+    table spec filtered by the mesh's axes and the param's divisibility
+    (so the same table drives the 8-way mesh and the 1-device reference)."""
+    layout = layout or fsdp_mod.SpecLayout()
+    shapes = {name: p.shape for name, p in block.collect_params().items()
+              if p.shape is not None}
+
+    def spec_for(name: str) -> P:
+        base = fsdp_mod.parameter_spec_from_name(name, layout)
+        shape = shapes.get(name)
+        if shape is None:
+            return P()
+        return fsdp_mod.filter_spec(base, shape, mesh)
+
+    return spec_for
+
+
+def _lm_batches(vocab: int, batch: int, seq: int, n_batches: int, seed: int):
+    """Deterministic synthetic LM stream (markov-ish so loss actually drops):
+    next token = (token * 3 + noise) mod vocab."""
+    rs = np.random.RandomState(seed)
+    xs, ys = [], []
+    for _ in range(n_batches):
+        t0 = rs.randint(0, vocab, size=(batch, 1))
+        toks = [t0]
+        for _ in range(seq):
+            nxt = (toks[-1] * 3 + (rs.rand(batch, 1) < 0.1)) % vocab
+            toks.append(nxt.astype(np.int64))
+        seqs = np.concatenate(toks, axis=1)
+        xs.append(seqs[:, :seq].astype(np.int32))
+        ys.append(seqs[:, 1:seq + 1].astype(np.int32))
+    return xs, ys
+
+
+def train_flagship(mesh: Optional[Mesh] = None, *, vocab: int = 64,
+                   units: int = 64, num_layers: int = 2, num_heads: int = 2,
+                   batch: int = 16, seq: int = 32, epochs: int = 3,
+                   batches_per_epoch: int = 4, lr: float = 0.1,
+                   seed: int = 0, layout: Optional[fsdp_mod.SpecLayout] = None,
+                   zero_stage: Optional[int] = 3) -> dict:
+    """Fit a tiny TransformerLM on the composed mesh; returns per-epoch mean
+    losses plus the compile/memory evidence the guard asserts on.
+
+    The SAME function run on a 1-device mesh is the equivalence reference:
+    identical seed → identical init and batch stream, so per-epoch losses
+    must agree to sharded-reduction tolerance.
+    """
+    import os
+    import mxtpu as mx
+    from mxtpu import gluon, optimizer as opt_mod
+    from mxtpu.gluon.model_zoo.transformer import TransformerLM
+    from ..step_cache import cache_stats
+
+    mesh = mesh or get_default_mesh()
+    layout = layout or fsdp_mod.SpecLayout()
+    saved_stage = os.environ.get("MXTPU_ZERO_STAGE")
+    if zero_stage is not None:
+        os.environ["MXTPU_ZERO_STAGE"] = str(zero_stage)
+    try:
+        mx.rng.seed(seed)
+        net = TransformerLM(vocab, units=units, num_layers=num_layers,
+                            num_heads=num_heads, max_len=seq)
+        net.initialize(init=mx.initializer.Xavier())
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = DataParallelTrainer(
+            net, loss_fn, opt_mod.SGD(learning_rate=lr), mesh,
+            param_shardings=flagship_param_shardings(net, layout, mesh))
+        stats = cache_stats("data_parallel_step")
+        traces0 = stats.traces
+        xs, ys = _lm_batches(vocab, batch, seq, batches_per_epoch, seed)
+        losses = []
+        with fsdp_mod.layout_scope(layout, mesh):
+            for _ in range(epochs):
+                ep = [float(trainer.step(mx.nd.array(x), mx.nd.array(y)))
+                      for x, y in zip(xs, ys)]
+                losses.append(float(np.mean(ep)))
+        from mxtpu import profiler
+        return {
+            "losses": losses,
+            "traces": stats.traces - traces0,
+            "mesh_axes": dict(mesh.shape),
+            "stage": trainer.stage,
+            "memory": profiler.get_memory_stats(),
+            "params": {n: tuple(getattr(p.data().data.sharding, "spec", P()))
+                       for n, p in net.collect_params().items()
+                       if p.shape is not None},
+        }
+    finally:
+        if saved_stage is None:
+            os.environ.pop("MXTPU_ZERO_STAGE", None)
+        else:
+            os.environ["MXTPU_ZERO_STAGE"] = saved_stage
+
+
+def flagship_pp_forward(mesh: Optional[Mesh] = None, *, units: int = 32,
+                        num_heads: int = 2, micro: int = 4, batch: int = 4,
+                        seq: int = 16, seed: int = 0) -> dict:
+    """The ×pp leg: one stacked TransformerBlock per pp stage run through
+    ``gpipe`` with the batch sharded over the data axes (``batch_spec``
+    composition), checked against the sequential stage-by-stage forward.
+    Returns max |Δ| so callers can assert agreement."""
+    mesh = mesh or get_default_mesh()
+    S = int(mesh.shape["pp"])
+    rs = np.random.RandomState(seed)
+    D = units // num_heads
+
+    def stage_fn(params, h):
+        # pre-LN block in raw jax (mirrors TransformerBlock.forward /
+        # serving_step layer math)
+        def ln(x, g, b):
+            mu = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+
+        x = h
+        hn = ln(x, params["ln1_g"], params["ln1_b"])
+        B, T, C = hn.shape
+        q = (hn @ params["wq"].T).reshape(B, T, num_heads, D).transpose(0, 2, 1, 3)
+        k = (hn @ params["wk"].T).reshape(B, T, num_heads, D).transpose(0, 2, 1, 3)
+        v = (hn @ params["wv"].T).reshape(B, T, num_heads, D).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhts,bhsd->bhtd", a, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, C) @ params["wo"].T
+        x = x + o
+        g = ln(x, params["ln2_g"], params["ln2_b"])
+        f = jax.nn.gelu(g @ params["w1"].T, approximate=True) @ params["w2"].T
+        return x + f
+
+    def init_stage():
+        s = 1.0 / np.sqrt(units)
+        return {
+            "ln1_g": np.ones(units, np.float32),
+            "ln1_b": np.zeros(units, np.float32),
+            "ln2_g": np.ones(units, np.float32),
+            "ln2_b": np.zeros(units, np.float32),
+            "wq": (rs.randn(units, units) * s).astype(np.float32),
+            "wk": (rs.randn(units, units) * s).astype(np.float32),
+            "wv": (rs.randn(units, units) * s).astype(np.float32),
+            "wo": (rs.randn(units, units) * s).astype(np.float32),
+            "w1": (rs.randn(4 * units, units) * s).astype(np.float32),
+            "w2": (rs.randn(units, 4 * units) * s).astype(np.float32),
+        }
+
+    stages = [init_stage() for _ in range(S)]
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *stages)
+    x = jnp.asarray(rs.randn(micro, batch, seq, units).astype(np.float32))
+
+    data_axes = tuple(a for a in mesh.axis_names if a in ("dp", "fsdp"))
+    batch_spec = P(data_axes) if data_axes else None
+    ys = gpipe(stage_fn, stacked, x, mesh, axis_name="pp",
+               batch_spec=batch_spec)
+
+    ref = x
+    for p in stages:
+        ref = jax.vmap(lambda h, p=p: stage_fn(p, h))(ref)
+    err = float(jnp.max(jnp.abs(ys - ref)))
+    return {"max_err": err, "stages": S, "micro": micro,
+            "batch_spec": tuple(batch_spec) if batch_spec else ()}

@@ -25,6 +25,9 @@ gives HSDP composed with tensor parallelism.
 from __future__ import annotations
 
 import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,7 +37,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import data_axis_names, data_size, fsdp_axis_name, fsdp_size
 
 __all__ = ["zero_stage", "compose_spec", "fsdp_param_specs",
-           "per_device_bytes", "replicated_bytes", "measure_memory"]
+           "per_device_bytes", "replicated_bytes", "measure_memory",
+           "SpecLayout", "parameter_spec_from_name", "filter_spec",
+           "layout_scope", "current_layout"]
 
 
 def zero_stage() -> int:
@@ -96,6 +101,162 @@ def fsdp_param_specs(shapes: Sequence[Sequence[int]],
     """Composed per-param specs for stage 3; None marks bucket-eligible
     (replicated-resident) params."""
     return [compose_spec(s, b, mesh) for s, b in zip(shapes, base_specs)]
+
+
+# ---------------------------------------------------------------------------
+# SpecLayout — the canonical per-parameter / per-activation layout table
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """THE per-parameter/per-activation partition-spec table for the composed
+    dp×fsdp×tp(×pp) flagship — one frozen source of truth instead of ad-hoc
+    spec dicts scattered per entry point (SNIPPETS [3] pattern: embeddings on
+    fsdp×tp, activations on data×tp).
+
+    Weight specs follow the gluon ``Dense`` convention ``(out_features,
+    in_features)`` — dim 0 is the OUTPUT dimension. Column-parallel layers
+    (qkv, ffn-up) therefore shard dim 0 on ``tp``; row-parallel layers
+    (attn-out, ffn-down) shard dim 1. The fsdp residency axis is NOT in the
+    base table: ``compose_spec`` inserts it on free, divisible dim 0s at
+    ZeRO stage 3 (dim-0-only on purpose — see its docstring on reduction
+    order), so the same table serves every stage.
+
+    ``ulysses_axis`` names the mesh axis the attention spec-flip exchanges
+    sequence for heads over (DeepSpeed-Ulysses); the flagship reuses ``tp``
+    — heads are tp-sharded anyway, so the flip is a pure GSPMD reshard that
+    lowers to the native all-to-all (the jit-reshard fast path).
+    """
+    data_axes: Tuple[str, ...] = ("dp", "fsdp")
+    fsdp_axis: str = "fsdp"
+    tp_axis: str = "tp"
+    ulysses_axis: str = "tp"
+
+    # -- parameter table (gluon (out, in) weight convention) ----------------
+    def embeddings(self) -> P:
+        # (vocab, units): vocab is both the lookup dim and the tied-head
+        # OUTPUT dim — sharding it over fsdp×tp never touches a contraction
+        return P((self.fsdp_axis, self.tp_axis))
+
+    def qkv_projection(self) -> P:
+        return P(self.tp_axis)            # head-parallel columns
+
+    def attn_out(self) -> P:
+        return P(None, self.tp_axis)      # row-parallel (Megatron pair)
+
+    def ffn_up(self) -> P:
+        return P(self.tp_axis)
+
+    def ffn_down(self) -> P:
+        return P(None, self.tp_axis)
+
+    def vector(self) -> P:
+        return P()                        # norms, biases, pos-embed
+
+    # -- activation table ---------------------------------------------------
+    def activations(self) -> P:
+        """(B, T, C) between layers: batch over every data axis."""
+        return P(self.data_axes)
+
+    def seq_activations(self) -> P:
+        """(B, T, C) in Ulysses regions: sequence additionally sharded."""
+        return P(self.data_axes, self.ulysses_axis)
+
+    def head_activations(self) -> P:
+        """(B, H, T, D) inside attention: heads sharded, FULL sequence per
+        device group — the post-all-to-all Ulysses layout."""
+        return P(self.data_axes, self.ulysses_axis)
+
+
+def parameter_spec_from_name(name: str, layout: Optional[SpecLayout] = None) -> P:
+    """Map a gluon parameter name onto the SpecLayout table (the model-zoo
+    naming heuristic: ``multiheadattention*_dense0..2`` are q/k/v, ``dense3``
+    the output projection; a block's own ``dense0/dense1`` are the FFN pair;
+    ``embedding*_weight`` is the tied table)."""
+    layout = layout or SpecLayout()
+    n = name.lower()
+    if "embedding" in n and n.endswith("weight"):
+        return layout.embeddings()
+    if "multiheadattention" in n:
+        if n.endswith("dense3_weight"):
+            return layout.attn_out()
+        if n.endswith("weight"):
+            return layout.qkv_projection()
+        return layout.vector()
+    if n.endswith("dense0_weight"):
+        return layout.ffn_up()
+    if n.endswith("dense1_weight"):
+        return layout.ffn_down()
+    return layout.vector()
+
+
+def filter_spec(spec: Optional[P], shape: Sequence[int], mesh: Mesh) -> P:
+    """Project a table spec onto what THIS mesh/shape supports: axis names
+    the mesh doesn't carry are dropped, and a dim whose sharded degree does
+    not divide it falls back to replicated — so one table serves the 8-way
+    composed mesh and a single-device smoke run alike."""
+    entries = _spec_entries(spec, len(shape))
+    out: List = []
+    for dim, e in zip(shape, entries):
+        names = list(e) if isinstance(e, (tuple, list)) else ([e] if e else [])
+        names = [a for a in names if a in mesh.axis_names]
+        degree = 1
+        for a in names:
+            degree *= int(mesh.shape[a])
+        if not names or degree <= 1 or dim % degree != 0:
+            out.append(None)
+        else:
+            out.append(tuple(names) if len(names) > 1 else names[0])
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+# -- activation layout scope -------------------------------------------------
+# Model code (MultiHeadAttention, TransformerLM) consults this scope to place
+# with_sharding_constraint spec flips while a composed-mesh step traces; no
+# scope -> zero overhead, models stay mesh-agnostic.
+
+_layout_scope = threading.local()
+
+
+def current_layout():
+    """The active ``(layout, mesh)`` pair, or None outside a scope."""
+    return getattr(_layout_scope, "value", None)
+
+
+@contextmanager
+def layout_scope(layout: SpecLayout, mesh: Mesh):
+    """Activate the SpecLayout for model-side activation constraints. Enter
+    around trainer construction + steps (the constraint only fires on
+    tracers, so eager predicts under an open scope stay untouched)."""
+    prev = getattr(_layout_scope, "value", None)
+    _layout_scope.value = (layout, mesh)
+    try:
+        yield
+    finally:
+        _layout_scope.value = prev
+
+
+def constrain(raw, entry: str):
+    """Apply the active scope's ``entry`` activation spec (a SpecLayout
+    method name, e.g. ``"seq_activations"``) to a raw jax value via
+    ``with_sharding_constraint`` — but ONLY while a composed-mesh step is
+    tracing (value is a Tracer under an open scope). Everywhere else this is
+    the identity, so model code can call it unconditionally. The spec is
+    mesh/shape-filtered, and a constraint that filters down to fully
+    replicated is skipped (GSPMD would otherwise force a gather)."""
+    scope = current_layout()
+    if scope is None:
+        return raw
+    import jax
+    if not isinstance(raw, jax.core.Tracer):
+        return raw
+    layout, mesh = scope
+    spec = filter_spec(getattr(layout, entry)(), raw.shape, mesh)
+    if spec == P():
+        return raw
+    return jax.lax.with_sharding_constraint(raw, NamedSharding(mesh, spec))
 
 
 def per_device_bytes(arr) -> int:

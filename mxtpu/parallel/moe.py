@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .collectives import all_to_all_array, shard_map_compat
 from .mesh import Mesh, get_default_mesh
 
 __all__ = ["expert_parallel_ffn"]
@@ -65,21 +66,20 @@ def expert_parallel_ffn(router_w, w1, w2, x, mesh: Optional[Mesh] = None,
             jnp.where(keep[:, None], x_loc, 0.0))
 
         # exchange: device e receives every device's buffer for expert e
-        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
-                              tiled=False)               # (E_src, capacity, d)
+        recv = all_to_all_array(send, axis_name=axis_name, split_axis=0,
+                                concat_axis=0, tiled=False)  # (E_src, capacity, d)
 
         h = recv.reshape(-1, d) @ w1_loc[0]              # my expert's FFN
         h = jax.nn.relu(h)
         out = (h @ w2_loc[0]).reshape(E, capacity, d)
 
         # return trip + gather each token's result back by its position
-        back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
-                              tiled=False)               # (E_expert, capacity, d)
+        back = all_to_all_array(out, axis_name=axis_name, split_axis=0,
+                                concat_axis=0, tiled=False)  # (E_expert, capacity, d)
         y = back[expert, jnp.where(keep, pos, 0)]
         y = jnp.where(keep[:, None], y * gate[:, None], 0.0)
         return y
 
-    from .collectives import shard_map_compat
     fn = shard_map_compat(
         spmd, mesh,
         (P(), P(axis_name), P(axis_name), P(axis_name)),

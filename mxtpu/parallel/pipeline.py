@@ -28,7 +28,7 @@ __all__ = ["gpipe"]
 
 
 def gpipe(stage_fn: Callable, stacked_params, x, mesh: Optional[Mesh] = None,
-          axis_name: str = "pp"):
+          axis_name: str = "pp", batch_spec: Optional[P] = None):
     """Run ``x`` through S pipelined stages.
 
     ``stage_fn(params_i, h) -> h`` applies one stage. ``stacked_params`` is a
@@ -36,6 +36,12 @@ def gpipe(stage_fn: Callable, stacked_params, x, mesh: Optional[Mesh] = None,
     lives on pp-rank i). ``x``: (M, B, ...) microbatches with M >= 1; the
     activation shape must be constant across stages (uniform-width pipeline —
     standard for transformer blocks). Returns (M, B, ...) outputs.
+
+    ``batch_spec`` composes pp with the mesh's OTHER axes: the spec of one
+    microbatch (B, ...) — e.g. ``P(("dp", "fsdp"))`` to shard B over the data
+    axes while the pp ring permutes over its own axis. Stream and output
+    carry the spec shifted one dim right (the leading M axis stays
+    unsharded); default keeps the old fully-replicated behavior.
     """
     mesh = mesh or get_default_mesh()
     S = mesh.shape[axis_name]
@@ -74,10 +80,12 @@ def gpipe(stage_fn: Callable, stacked_params, x, mesh: Optional[Mesh] = None,
         return ys                                        # (n_steps, B, ...)
 
     params_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    # stream/output spec: microbatch spec shifted right of the leading M axis
+    stream_spec = P(None, *batch_spec) if batch_spec is not None else P()
     from .collectives import shard_map_compat
     fn = shard_map_compat(spmd, mesh,
-                          (params_spec, P()),            # stream replicated
-                          P())
+                          (params_spec, stream_spec),
+                          stream_spec)
     ys = fn(stacked_params, stream)
     # outputs for microbatch m exit the last stage at step m + S - 1 and are
     # visible (after the rotation) on every rank at that step

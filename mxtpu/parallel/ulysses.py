@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .collectives import all_to_all_array
 from .mesh import Mesh, get_default_mesh
 
 __all__ = ["ulysses_attention_inner", "ulysses_self_attention"]
@@ -48,12 +49,12 @@ def ulysses_attention_inner(q, k, v, axis_name: str, causal: bool = False,
     def seq_to_heads(x):
         # (B, H, t, D) -> (B, H/n, n*t, D): split heads across the axis,
         # concatenate the sequence chunks
-        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                              tiled=True)
+        return all_to_all_array(x, axis_name=axis_name, split_axis=1,
+                                concat_axis=2, tiled=True)
 
     def heads_to_seq(x):
-        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                              tiled=True)
+        return all_to_all_array(x, axis_name=axis_name, split_axis=2,
+                                concat_axis=1, tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     s = scale if scale is not None else 1.0 / (D ** 0.5)
