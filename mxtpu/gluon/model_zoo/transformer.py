@@ -200,6 +200,46 @@ class TransformerLM(HybridBlock):
 
         return step
 
+    def serving_sample(self):
+        """Per-slot next-token selection shared by the serving decode and
+        chunked-prefill programs (``serving/kv.py``): returns
+        ``sample(logits (S, V), temp (S,), topk (S,), seed (S,), pos (S,))
+        -> (S,) int32``.
+
+        Every sampling parameter is a TRACED array, so a mixed batch of
+        greedy and sampled slots — or a change in the mix between
+        dispatches — reuses one compiled program. ``temp[s] == 0`` selects
+        plain argmax, bit-identical to the pre-sampling greedy path (the
+        engine's bit-exactness contract vs solo ``generate``);
+        ``temp[s] > 0`` samples from the temperature-scaled, top-k-masked
+        logits with a key derived as ``fold_in(PRNGKey(seed[s]), pos[s])``.
+        Keying on the ABSOLUTE position makes a request's stream a pure
+        function of (weights, prompt, temperature, top-k, seed): the same
+        request re-submitted under any slot assignment, chunk boundary, or
+        prefill/decode split reproduces the same tokens — the
+        seed-determinism contract. ``topk[s] <= 0`` means no top-k
+        truncation; ties at the k-th logit are all kept (deterministic)."""
+        import jax
+        import jax.numpy as jnp
+
+        V = self._vocab
+
+        def sample(logits, temp, topk, seed, pos):
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def one(lg, tm, k, sd, p):
+                kk = jnp.clip(jnp.where(k <= 0, V, k), 1, V)
+                thresh = jnp.sort(lg)[V - kk]          # k-th largest logit
+                masked = jnp.where(lg >= thresh, lg, -jnp.inf)
+                key = jax.random.fold_in(jax.random.PRNGKey(sd), p)
+                return jax.random.categorical(
+                    key, masked / jnp.maximum(tm, 1e-6)).astype(jnp.int32)
+
+            sampled = jax.vmap(one)(logits, temp, topk, seed, pos)
+            return jnp.where(temp > 0, sampled, greedy)
+
+        return sample
+
     def _build_generate(self, B: int, P: int, TOT: int, greedy: bool):
         """One compiled decode program for (batch B, prompt bucket P, scan
         bucket TOT): the TRUE prompt length arrives as a traced scalar, so

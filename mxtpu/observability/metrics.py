@@ -315,16 +315,32 @@ def reset_resilience_stats():
 
 _SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
                  "cancelled": 0, "rejected": 0, "expired": 0,
-                 "prefills": 0, "decode_steps": 0, "tokens_out": 0,
+                 "prefills": 0, "prefill_chunks": 0,
+                 "decode_steps": 0, "tokens_out": 0,
                  "kv_promotions": 0,
+                 # shared-prefix radix KV reuse (serving/kv.PrefixCache):
+                 # hits/misses count PREFILLED requests with at least one
+                 # cache-eligible block (prompt > 32 tokens); hit_tokens is
+                 # the positions whose prefill was skipped
+                 "prefix_hits": 0, "prefix_misses": 0, "prefix_hit_tokens": 0,
+                 "prefix_inserts": 0, "prefix_evictions": 0,
+                 "prefix_cache_bytes": 0,
                  # live elasticity: requests carried across an engine
                  # drain()/adopt() handoff (zero-drop contract)
                  "drained": 0, "adopted": 0,
                  "queue_depth_max": 0, "slots": 0,
                  "slot_occupancy_sum": 0.0, "occupancy_samples": 0,
                  "ttft_ms_total": 0.0, "ttft_ms_last": 0.0,
-                 "queue_wait_ms_total": 0.0, "queue_wait_ms_last": 0.0}
+                 # TTFT decomposition: queue wait (submit -> prefill start)
+                 # + prefill (prefill start -> first token); first_decode is
+                 # admission-complete -> first decode-chunk token
+                 "queue_wait_ms_total": 0.0, "queue_wait_ms_last": 0.0,
+                 "prefill_ms_total": 0.0, "prefill_ms_last": 0.0,
+                 "first_decode_ms_total": 0.0, "first_decode_ms_last": 0.0}
 _serving = dict(_SERVING_ZERO)
+
+# keys that ASSIGN the latest value instead of accumulating
+_SERVING_ASSIGN = ("slots", "prefix_cache_bytes")
 
 
 def record_serving(key: str, n=1):
@@ -342,7 +358,7 @@ def record_serving(key: str, n=1):
         elif key.endswith("_max"):
             if n > _serving[key]:
                 _serving[key] = n
-        elif key == "slots":
+        elif key in _SERVING_ASSIGN:
             _serving[key] = int(n)
         else:
             _serving[key] += n
@@ -370,6 +386,8 @@ def get_serving_stats() -> dict:
     samples = out.pop("occupancy_samples")
     occ_sum = out.pop("slot_occupancy_sum")
     out["slot_occupancy"] = (occ_sum / samples) if samples else 0.0
+    probes = out["prefix_hits"] + out["prefix_misses"]
+    out["prefix_hit_rate"] = (out["prefix_hits"] / probes) if probes else 0.0
     return out
 
 

@@ -6,10 +6,11 @@ Two serving modes, one package:
   ``mxtpu/serving.py`` surface, unchanged): one compiled scan over a stack
   of pre-collected batches, amortizing the per-call dispatch floor.
 * **Online / latency** — :class:`ServingEngine`: continuous batching over a
-  fixed slot batch with bucketed KV admission, prefill/decode split,
-  deadlines, cancellation, and explicit backpressure. ``submit()`` from any
-  thread; greedy output is bit-exact with per-request
-  ``TransformerLM.generate``.
+  fixed slot batch with bucketed KV admission, decode-overlapped chunked
+  prefill, shared-prefix radix KV reuse, per-request
+  :class:`SamplingParams`, deadlines, cancellation, and explicit
+  backpressure. ``submit()`` from any thread; greedy output is bit-exact
+  with per-request ``TransformerLM.generate``.
 
 See ``docs/serving.md`` for architecture, knobs, and the latency/goodput
 methodology behind ``bench.py serving``.
@@ -17,12 +18,12 @@ methodology behind ``bench.py serving``.
 
 from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING,
                   DeadlineExceeded, QueueFullError, RequestCancelled,
-                  ServingRequest)
+                  SamplingParams, ServingRequest)
 from .chained import ChainedPredictor
 from .engine import ServingEngine, ServingHandoff
 from . import kv
 
 __all__ = ["ChainedPredictor", "ServingEngine", "ServingHandoff",
-           "ServingRequest",
+           "ServingRequest", "SamplingParams",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
            "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "kv"]

@@ -18,11 +18,12 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+from dataclasses import dataclass
 from typing import List, Optional
 
-__all__ = ["ServingRequest", "QueueFullError", "RequestCancelled",
-           "DeadlineExceeded", "PENDING", "RUNNING", "DONE", "CANCELLED",
-           "EXPIRED"]
+__all__ = ["ServingRequest", "SamplingParams", "QueueFullError",
+           "RequestCancelled", "DeadlineExceeded", "PENDING", "RUNNING",
+           "DONE", "CANCELLED", "EXPIRED"]
 
 PENDING = "pending"        # admitted to the queue, not yet prefilled
 RUNNING = "running"        # occupying a decode slot (or mid-prefill)
@@ -48,16 +49,45 @@ class DeadlineExceeded(RuntimeError):
 _ids = itertools.count()
 
 
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode sampling knobs, riding the decode program as
+    per-slot TRACED arrays (``TransformerLM.serving_sample``) — a mix
+    change between dispatches never retraces.
+
+    ``temperature == 0`` (the default) is greedy argmax, bit-exact with
+    solo ``generate``; ``temperature > 0`` samples from the scaled,
+    top-k-masked logits. ``top_k <= 0`` disables top-k truncation. The
+    stream is deterministic per (seed, position): resubmitting the same
+    request with the same seed reproduces the same tokens no matter how
+    the scheduler slotted or chunked it."""
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0 (0 = greedy)")
+        if self.seed < 0:
+            raise ValueError("seed must be >= 0")
+
+
 class ServingRequest:
     """One in-flight generation request.
 
     ``prompt`` is the token-id list, ``max_new`` the number of tokens to
     generate, ``deadline_s`` an optional completion budget measured from
     submit time (the engine retires the request as :data:`EXPIRED` at the
-    first step boundary past it; partial tokens are kept)."""
+    first step boundary past it; partial tokens are kept), ``sampling``
+    optional :class:`SamplingParams` (default greedy), and
+    ``prefix_cache=False`` opts this request out of shared-prefix KV reuse
+    AND of inserting its own prefix (for privacy-sensitive prompts that
+    must not seed a cache other requests can hit)."""
 
     def __init__(self, prompt, max_new: int,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 sampling: Optional[SamplingParams] = None,
+                 prefix_cache: bool = True):
         self.id = next(_ids)
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
@@ -66,6 +96,10 @@ class ServingRequest:
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
         self.max_new = int(max_new)
+        if sampling is not None and not isinstance(sampling, SamplingParams):
+            sampling = SamplingParams(**dict(sampling))
+        self.sampling = sampling
+        self.use_prefix_cache = bool(prefix_cache)
         self.t_submit = time.monotonic()
         self.deadline = None if deadline_s is None \
             else self.t_submit + float(deadline_s)

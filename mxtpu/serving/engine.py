@@ -1,9 +1,11 @@
-"""Continuous-batching serving engine (Orca-style step-boundary scheduling).
+"""Continuous-batching serving engine (Orca-style step-boundary scheduling,
+Sarathi-style decode-overlapped chunked prefill, SGLang-style radix prefix
+reuse).
 
 :class:`ServingEngine` is the online front door over a decode-capable model
-(anything exposing ``serving_step`` / ``_gen_params`` — ``TransformerLM`` in
-the zoo): callers ``submit()`` token prompts from any thread; one scheduler
-thread runs the slot batch.
+(anything exposing ``serving_step`` / ``serving_sample`` / ``_gen_params`` —
+``TransformerLM`` in the zoo): callers ``submit()`` token prompts from any
+thread; one scheduler thread runs the slot batch.
 
 The data path, end to end:
 
@@ -13,39 +15,56 @@ The data path, end to end:
    to its 32-token bucket) so admission never pays a host→device transfer
    inside the decode loop; the scheduler drains it with the non-blocking
    ``poll()``.
-2. **Prefill** — the prompt runs through a separate B=1 chunked program
-   (``kv.build_prefill``, keyed per prompt bucket) producing the request's
-   KV page plus its first token(s); the page is merged into a free slot row
-   of the engine's static ``(L, 2, slots, H, TOT, D)`` cache. TTFT is
-   prefill latency — a long prompt never stalls the in-flight slot batch.
-3. **Decode** — ``kv.build_decode`` runs ``chunk`` greedy steps over ALL
-   slots per dispatch; per-slot token/position/active/limit arrays are
-   traced inputs, so requests retiring and joining between dispatches reuse
-   the same compiled program (ONE trace per (slots, TOT bucket) — the
-   compile-guard contract). Finished/cancelled/expired requests retire at
-   chunk boundaries and their slots are immediately re-admissible.
+2. **Chunked prefill** — the prompt runs through a separate B=1 program in
+   fixed-budget position chunks (``kv.build_prefill_chunk``, one program per
+   (prompt bucket, chunk size)), ONE chunk dispatched between decode chunks:
+   a partial-prefill cursor lives on the reserved slot, so a long prompt
+   never stalls the in-flight slot batch for more than one chunk's work (the
+   decode-stall guard bound). Before the first chunk the radix
+   :class:`~mxtpu.serving.kv.PrefixCache` is probed: a prompt extending a
+   cached prefix copies the cached K/V rows into its page and prefills only
+   the suffix — a shared system prompt costs one prefill, ever. The finished
+   page is merged into the slot row; forced-prompt blocks are inserted back
+   into the tree.
+3. **Decode** — ``kv.build_decode`` runs ``chunk`` steps over ALL slots per
+   dispatch; per-slot token/position/active/limit AND sampling params
+   (temperature/top-k/seed) are traced inputs, so requests retiring,
+   joining, or changing the sampling mix between dispatches reuse the same
+   compiled program (ONE trace per (slots, TOT bucket) — the compile-guard
+   contract). Greedy slots stay bit-exact with solo ``generate``; sampled
+   slots are deterministic per (seed, position). Finished/cancelled/expired
+   requests retire at chunk boundaries and their slots are immediately
+   re-admissible.
 
 Guardrails: every dispatch heartbeats the resilience watchdog on the
 ``serving`` source (arm with ``MXTPU_SERVING_STALL_S``), spans land in the
-unified trace under ``serving/*``, and counters in
+unified trace under ``serving/*`` (``prefill_chunk``, ``decode``,
+``prefix_hit``…), and counters — including the TTFT decomposition
+queue-wait / prefill / first-decode-token — in
 ``profiler.get_serving_stats()``.
 
 Live elasticity (ROADMAP item 4, ``docs/resilience.md``): ``drain()`` stops
 admission, parks the scheduler at a chunk boundary, and freezes every
-in-flight request — its KV page, next-token/position/limit slot state, and
-handle — into a :class:`ServingHandoff`; ``adopt()`` on a fresh engine (same
-model, survivor mesh) reinstalls the pages and resumes decoding the SAME
-request handles bit-exactly, with zero drops. Queued-but-unprefilled
-requests ride along and are re-staged on the adopting engine.
+in-flight request — its KV page, next-token/position/limit/sampling slot
+state, and handle, including a PARTIALLY-PREFILLED request's cursor and
+partial page — into a :class:`ServingHandoff`; ``adopt()`` on a fresh engine
+(same model, survivor mesh) reinstalls the pages and resumes decoding (or
+the suffix prefill) for the SAME request handles bit-exactly, with zero
+drops. Queued-but-unprefilled requests ride along and are re-staged on the
+adopting engine.
 
 Knobs: ``MXTPU_SERVING_SLOTS`` (slot-batch capacity, default 4),
 ``MXTPU_SERVING_QUEUE`` (admission queue depth, default 16),
 ``MXTPU_SERVING_CHUNK`` (decode steps per dispatch, default 8),
-``MXTPU_SERVING_PROGRAM_CACHE`` (LRU bound on the program caches).
+``MXTPU_SERVING_PREFILL_CHUNK`` (prefill positions per dispatch, default
+64), ``MXTPU_PREFIX_CACHE_MB`` (radix prefix-cache byte cap, default 64; 0
+disables), ``MXTPU_SERVING_LOG_S`` (per-interval engine log period, default
+off), ``MXTPU_SERVING_PROGRAM_CACHE`` (LRU bound on the program caches).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import threading
@@ -71,6 +90,8 @@ from .api import (CANCELLED, DONE, EXPIRED, RUNNING, QueueFullError,
 
 __all__ = ["ServingEngine", "ServingHandoff"]
 
+_log = logging.getLogger("mxtpu.serving")
+
 
 @dataclass
 class ServingHandoff:
@@ -80,13 +101,16 @@ class ServingHandoff:
     mesh disappearing entirely."""
     tot: int                                  # KV bucket length of each page
     entries: List[dict] = field(default_factory=list)   # per in-flight slot:
-    #   req / page (L,2,1,H,tot,D np) / tok / p / limit / left
+    #   req / page (L,2,1,H,tot,D np) / tok / p / limit / left / temp/topk/seed
+    partial: List[dict] = field(default_factory=list)   # mid-prefill request:
+    #   req / page (L,2,1,H,PB,D np) / t (cursor) / prev / t0 / PB / left —
+    #   adopt() resumes the SUFFIX prefill, never re-prefills from scratch
     pending: List[ServingRequest] = field(default_factory=list)  # admitted,
     #   never prefilled — re-staged verbatim by adopt()
 
     @property
     def in_flight(self) -> int:
-        return len(self.entries) + len(self.pending)
+        return len(self.entries) + len(self.partial) + len(self.pending)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -96,26 +120,49 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _req_sampling(req: ServingRequest):
+    sp = req.sampling
+    if sp is None:
+        return 0.0, 0, 0
+    return float(sp.temperature), int(sp.top_k), int(sp.seed)
+
+
 class ServingEngine:
     """Online continuous-batching server over one decode-capable model.
 
-    Greedy decoding only (the bit-exactness contract is argmax vs solo
-    ``generate``); sampling requests belong on a per-request ``generate``
-    path until the engine grows per-slot rng lanes."""
+    Greedy decoding is the bit-exact default (argmax vs solo ``generate``);
+    per-request :class:`~mxtpu.serving.api.SamplingParams` ride the decode
+    program as per-slot traced arrays, seed-deterministic regardless of
+    slot assignment or chunk boundaries."""
 
     def __init__(self, model, slots: Optional[int] = None,
                  queue_depth: Optional[int] = None,
                  chunk: Optional[int] = None,
-                 stall_deadline_s: Optional[float] = None):
+                 stall_deadline_s: Optional[float] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache_mb: Optional[float] = None):
         self._model = model
         self.slots = slots if slots else _env_int("MXTPU_SERVING_SLOTS", 4)
         self.queue_depth = queue_depth if queue_depth \
             else _env_int("MXTPU_SERVING_QUEUE", 16)
         self.chunk = chunk if chunk else _env_int("MXTPU_SERVING_CHUNK", 8)
+        self.prefill_chunk = prefill_chunk if prefill_chunk \
+            else _env_int("MXTPU_SERVING_PREFILL_CHUNK", 64)
+        self.prefix_cache_mb = prefix_cache_mb if prefix_cache_mb is not None \
+            else _env_float("MXTPU_PREFIX_CACHE_MB", 64.0)
         if stall_deadline_s is None:
             raw = os.environ.get("MXTPU_SERVING_STALL_S", "")
             stall_deadline_s = float(raw) if raw else None
         self._stall_deadline_s = stall_deadline_s
+        self._log_s = _env_float("MXTPU_SERVING_LOG_S", 0.0)
+        self._next_log = 0.0
         self._submit_q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         self._start_lock = threading.Lock()
         self._decode_fns = ProgramCache("serving_decode")
@@ -136,7 +183,17 @@ class ServingEngine:
         self._limit = np.zeros(self.slots, np.int32)
         self._active = np.zeros(self.slots, bool)
         self._left = np.zeros(self.slots, np.int64)
+        self._temp = np.zeros(self.slots, np.float32)
+        self._topk = np.zeros(self.slots, np.int32)
+        self._seed = np.zeros(self.slots, np.uint32)
+        self._t_admit = np.zeros(self.slots, np.float64)
+        self._dec_emitted = np.zeros(self.slots, bool)
         self._reqs: List[Optional[ServingRequest]] = [None] * self.slots
+        # partial-prefill cursor (scheduler-thread-owned; at most one
+        # request prefills at a time, one CHUNK dispatched per loop turn)
+        self._pf: Optional[dict] = None
+        self._prefix: Optional[kv.PrefixCache] = None
+        self._evict_seen = 0
 
     # -- public surface ------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -156,17 +213,22 @@ class ServingEngine:
         return self
 
     def submit(self, prompt, max_new_tokens: int,
-               deadline_s: Optional[float] = None) -> ServingRequest:
+               deadline_s: Optional[float] = None,
+               sampling=None, prefix_cache: bool = True) -> ServingRequest:
         """Enqueue one generation request; returns its handle immediately.
-        Raises :exc:`QueueFullError` when the admission queue is at
-        capacity (backpressure, not silent growth) and ``ValueError`` for
-        requests the model can't hold."""
+        ``sampling`` takes :class:`~mxtpu.serving.api.SamplingParams` (or a
+        mapping of its fields; omitted = bit-exact greedy);
+        ``prefix_cache=False`` opts the request out of shared-prefix KV
+        reuse in both directions. Raises :exc:`QueueFullError` when the
+        admission queue is at capacity (backpressure, not silent growth)
+        and ``ValueError`` for requests the model can't hold."""
         if self._draining.is_set():
             raise RuntimeError(
                 "ServingEngine is draining — submit to the adopting engine")
         if self._stop.is_set():
             raise RuntimeError("ServingEngine is stopped")
-        req = ServingRequest(prompt, max_new_tokens, deadline_s)
+        req = ServingRequest(prompt, max_new_tokens, deadline_s,
+                             sampling=sampling, prefix_cache=prefix_cache)
         if req.total > self._model._max_len:
             raise ValueError(
                 f"prompt {len(req.prompt)} + {req.max_new} new exceeds "
@@ -206,7 +268,8 @@ class ServingEngine:
     def drain(self) -> ServingHandoff:
         """Zero-drop handoff, half one: stop admission (``submit`` raises),
         park the scheduler at its chunk boundary, and freeze every live
-        request — KV page, slot cursors, handle — into a host-resident
+        request — KV page, slot cursors, sampling params, handle, and a
+        mid-prefill request's partial page + cursor — into a host-resident
         :class:`ServingHandoff` for :meth:`adopt` on a successor engine.
         No request is cancelled; callers blocked in ``result()`` simply keep
         waiting across the handoff. Runs under the ``elastic`` heartbeat
@@ -245,7 +308,30 @@ class ServingEngine:
                         "p": int(self._p[slot]),
                         "limit": int(self._limit[slot]),
                         "left": int(self._left[slot]),
+                        "temp": float(self._temp[slot]),
+                        "topk": int(self._topk[slot]),
+                        "seed": int(self._seed[slot]),
                     })
+                # a partially-prefilled admission carries its cursor +
+                # already-computed page rows — adopt() resumes the SUFFIX
+                partial: List[dict] = []
+                if self._pf is not None:
+                    pf, self._pf = self._pf, None
+                    req = pf["req"]
+                    if req._cancelled():
+                        req._finish(CANCELLED, now)
+                        profiler.record_serving("cancelled")
+                    elif req._expired(now):
+                        req._finish(EXPIRED, now)
+                        profiler.record_serving("expired")
+                    else:
+                        partial.append({
+                            "req": req,
+                            "page": np.asarray(pf["page"]),
+                            "t": pf["t"], "prev": pf["prev"],
+                            "t0": pf["t0"], "PB": pf["PB"],
+                            "left": pf["left"],
+                        })
                 heartbeat("elastic")
                 # staged by the feed but never prefilled: keep the handles,
                 # drop the staged arrays (adopt() re-stages them). The
@@ -275,31 +361,36 @@ class ServingEngine:
         if self._wd is not None:
             self._wd.stop()
         handoff = ServingHandoff(tot=self._TOT or 0, entries=entries,
-                                 pending=pending)
+                                 partial=partial, pending=pending)
         profiler.record_serving("drained", handoff.in_flight)
         tracer.instant("serving/drained", cat="serving",
                        args={"in_slots": len(entries),
+                             "partial": len(partial),
                              "pending": len(pending)})
         return handoff
 
     def adopt(self, handoff: ServingHandoff) -> "ServingEngine":
         """Zero-drop handoff, half two: on a FRESH engine (same model,
         survivor mesh), reinstall each drained slot — KV page merged into a
-        slot row, cursors restored — then start the scheduler and re-stage
-        the pending requests. The adopted :class:`ServingRequest` handles
-        are the originals, and ``_emit`` accounting is cumulative, so decode
-        resumes exactly where the source engine stopped: greedy output stays
-        bit-exact with an uninterrupted solo ``generate``."""
+        slot row, cursors and sampling params restored — resume a
+        mid-prefill request from its cursor (suffix only, never from
+        scratch), then start the scheduler and re-stage the pending
+        requests. The adopted :class:`ServingRequest` handles are the
+        originals, and ``_emit`` accounting is cumulative, so decode
+        resumes exactly where the source engine stopped: greedy output
+        stays bit-exact with an uninterrupted solo ``generate``."""
         with self._start_lock:
             if self._thread is not None:
                 raise RuntimeError(
                     "adopt() needs a fresh engine (call before start/submit)")
-            if len(handoff.entries) > self.slots:
+            if len(handoff.entries) + len(handoff.partial) > self.slots:
                 raise ValueError(
-                    f"handoff carries {len(handoff.entries)} in-flight "
-                    f"slots but this engine has {self.slots}")
-            if handoff.entries:
+                    f"handoff carries {len(handoff.entries)} in-flight + "
+                    f"{len(handoff.partial)} mid-prefill slots but this "
+                    f"engine has {self.slots}")
+            if handoff.entries or handoff.partial:
                 self._materialize_params()
+            if handoff.entries:
                 self._ensure_capacity(handoff.tot)
                 for i, e in enumerate(handoff.entries):
                     self._caches = kv.merge_page(
@@ -308,14 +399,33 @@ class ServingEngine:
                     self._p[i] = e["p"]
                     self._limit[i] = e["limit"]
                     self._left[i] = e["left"]
+                    self._temp[i] = e.get("temp", 0.0)
+                    self._topk[i] = e.get("topk", 0)
+                    self._seed[i] = e.get("seed", 0)
+                    self._t_admit[i] = time.monotonic()
+                    self._dec_emitted[i] = False
                     self._active[i] = True
                     self._reqs[i] = e["req"]
+            if handoff.partial:
+                e = handoff.partial[0]
+                req = e["req"]
+                padded = np.zeros((1, e["PB"]), np.int32)
+                padded[0, :len(req.prompt)] = req.prompt
+                temp, topk, seed = _req_sampling(req)
+                self._pf = {"req": req, "prompt": jnp.asarray(padded),
+                            "page": jnp.asarray(e["page"]),
+                            "t": e["t"], "prev": e["prev"],
+                            "t0": e["t0"], "PB": e["PB"], "left": e["left"],
+                            "slot": len(handoff.entries),
+                            "t_start": time.monotonic(),
+                            "temp": temp, "topk": topk, "seed": seed}
         self.start()
         for req in handoff.pending:
             self._submit_q.put(req)     # blocking is fine: consumer is live
         profiler.record_serving("adopted", handoff.in_flight)
         tracer.instant("serving/adopted", cat="serving",
                        args={"in_slots": len(handoff.entries),
+                             "partial": len(handoff.partial),
                              "pending": len(handoff.pending)})
         return self
 
@@ -357,15 +467,23 @@ class ServingEngine:
             with autograd.predict_mode():
                 self._model(NDArray(np.zeros((1, 1), np.int32)))
         self._params = self._model._gen_params()
+        if self._prefix is None and self.prefix_cache_mb > 0:
+            L, H, D = kv.cache_dims(self._model)
+            block_bytes = (L * 2 * H * kv.PrefixCache.BLOCK * D
+                           * self._params["embed"].dtype.itemsize)
+            self._prefix = kv.PrefixCache(block_bytes, self.prefix_cache_mb)
 
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
                 heartbeat("serving")
-                busy = bool(self._active.any())
+                busy = bool(self._active.any()) or self._pf is not None
                 self._admit(wait_s=0.0 if busy else 0.02)
-                if self._active.any():
+                if self._pf is not None:
+                    self._prefill_chunk()     # ONE chunk, then yield to
+                if self._active.any():        # decode: the stall bound
                     self._decode_chunk()
+                self._maybe_log()
         except BaseException as e:
             self._error = e
         finally:
@@ -375,11 +493,17 @@ class ServingEngine:
                 self._shutdown_sweep()
 
     def _free_slot(self) -> Optional[int]:
-        idle = np.flatnonzero(~self._active)
-        return int(idle[0]) if idle.size else None
+        reserved = self._pf["slot"] if self._pf is not None else -1
+        for i in range(self.slots):
+            if not self._active[i] and i != reserved:
+                return i
+        return None
 
     def _admit(self, wait_s: float) -> None:
-        while True:
+        """Start at most one partial prefill per loop turn: pop a staged
+        request, probe the prefix cache, reserve a slot, and leave the
+        cursor for :meth:`_prefill_chunk` to advance between decodes."""
+        while self._pf is None:
             slot = self._free_slot()
             if slot is None or self._feed is None:
                 return
@@ -400,44 +524,147 @@ class ServingEngine:
                 req._finish(EXPIRED, now)
                 profiler.record_serving("expired")
                 continue
-            self._prefill(req, staged, slot, now)
+            self._begin_prefill(req, staged, slot, now)
 
-    def _prefill(self, req: ServingRequest, staged, slot: int,
-                 now: float) -> None:
-        model = self._model
+    def _begin_prefill(self, req: ServingRequest, staged, slot: int,
+                       now: float) -> None:
+        """Admission, phase one: probe the radix prefix cache, seed the
+        page with any cached rows, and park the partial-prefill cursor at
+        the first position that still needs computing."""
         t0 = len(req.prompt)
         PB = staged.shape[1]
         req._set_state(RUNNING)
         profiler.record_serving("admitted")
         profiler.record_serving("queue_wait_ms_last",
                                 (now - req.t_submit) * 1e3)
-        self._ensure_capacity(kv.bucket32(req.total, model._max_len))
-        with tracer.span("serving/prefill", cat="serving",
-                         args={"id": req.id, "t0": t0, "bucket": PB}):
-            fn = self._prefill_fns.get_or_build(
-                (PB,), lambda: kv.build_prefill(model, PB))
-            page, outs = fn(self._params, staged.data, jnp.int32(t0))
-            outs_np = np.asarray(outs)
-        done_t = time.monotonic()
-        # prefill emits the tokens for positions t0..PB (see kv.py); a short
-        # request can therefore complete at admission without taking a slot
-        left = req._emit(outs_np[t0 - 1:].tolist(), done_t)
-        delivered = req.max_new - left
-        profiler.record_serving("prefills")
-        profiler.record_serving("tokens_out", delivered)
-        profiler.record_serving("ttft_ms_last",
-                                (done_t - req.t_submit) * 1e3)
-        if left == 0:
-            req._finish(DONE, done_t)
-            profiler.record_serving("completed")
+        L, H, D = kv.cache_dims(self._model)
+        page = jnp.zeros((L, 2, 1, H, PB, D), self._params["embed"].dtype)
+        m = 0
+        # only FORCED prompt positions are reusable (limit = t0 - 1: the
+        # last prompt position seeds the feedback chain and is recomputed)
+        if self._prefix is not None and req.use_prefix_cache \
+                and t0 - 1 >= kv.PrefixCache.BLOCK:
+            m, blocks, path = self._prefix.match(req.prompt, t0 - 1)
+            if m:
+                # COPY the cached rows into this request's page (functional
+                # .at[].set — the tree's rows are never aliased mutably)
+                page = page.at[..., :m, :].set(
+                    jnp.concatenate(blocks, axis=4))
+                self._prefix.release(path)
+                profiler.record_serving("prefix_hits")
+                profiler.record_serving("prefix_hit_tokens", m)
+                tracer.instant("serving/prefix_hit", cat="serving",
+                               args={"id": req.id, "tokens": m})
+            else:
+                profiler.record_serving("prefix_misses")
+        temp, topk, seed = _req_sampling(req)
+        self._pf = {"req": req, "prompt": staged.data, "page": page,
+                    "t": m, "prev": 0, "t0": t0, "PB": PB,
+                    "left": req.max_new, "slot": slot, "t_start": now,
+                    "temp": temp, "topk": topk, "seed": seed}
+
+    def _prefill_chunk(self) -> None:
+        """Admission, phase two (repeated): advance the partial prefill by
+        ONE fixed-budget chunk, emitting any tokens past ``t0`` as they
+        materialize; on reaching the bucket end, merge the page into the
+        reserved slot and activate it for decode."""
+        pf = self._pf
+        req = pf["req"]
+        now = time.monotonic()
+        if req._cancelled():
+            self._pf = None
+            req._finish(CANCELLED, now)
+            profiler.record_serving("cancelled")
             return
-        self._caches = kv.merge_page(self._caches, page, slot)
-        self._tok[slot] = outs_np[-1]        # the token at position PB
-        self._p[slot] = PB                   # next position to feed
+        if req._expired(now):
+            self._pf = None
+            req._finish(EXPIRED, now)
+            profiler.record_serving("expired")
+            return
+        start = pf["t"]
+        csize = min(self.prefill_chunk, pf["PB"] - start)
+        with tracer.span("serving/prefill_chunk", cat="serving",
+                         args={"id": req.id, "start": start,
+                               "chunk": csize, "bucket": pf["PB"]}):
+            fn = self._prefill_fns.get_or_build(
+                (pf["PB"], csize),
+                lambda: kv.build_prefill_chunk(self._model, pf["PB"], csize))
+            page, outs = fn(
+                self._params, pf["page"], pf["prompt"],
+                jnp.int32(pf["t0"]), jnp.int32(start),
+                jnp.full((1,), pf["prev"], jnp.int32),
+                jnp.full((1,), pf["temp"], jnp.float32),
+                jnp.full((1,), pf["topk"], jnp.int32),
+                jnp.full((1,), pf["seed"], jnp.uint32))
+            outs_np = np.asarray(outs)
+        profiler.record_serving("prefill_chunks")
+        pf["page"] = page
+        pf["t"] = start + csize
+        pf["prev"] = int(outs_np[-1])
+        # outs[j] is the token FOR position start+j+1; generated tokens are
+        # positions >= t0, i.e. indices j >= t0-1-start (see kv.py)
+        valid = outs_np[max(pf["t0"] - 1 - start, 0):]
+        if valid.size:
+            done_t = time.monotonic()
+            first = req.t_first_token is None
+            left = req._emit(valid.tolist(), done_t)
+            profiler.record_serving("tokens_out", pf["left"] - left)
+            pf["left"] = left
+            if first:
+                profiler.record_serving("ttft_ms_last",
+                                        (done_t - req.t_submit) * 1e3)
+                profiler.record_serving("prefill_ms_last",
+                                        (done_t - pf["t_start"]) * 1e3)
+            if left == 0:
+                # short request: completed at admission, never took a slot
+                self._pf = None
+                self._insert_prefix(req, page, upto=pf["t"])
+                req._finish(DONE, done_t)
+                profiler.record_serving("prefills")
+                profiler.record_serving("completed")
+                return
+        if pf["t"] >= pf["PB"]:
+            self._finish_prefill(pf)
+
+    def _finish_prefill(self, pf: dict) -> None:
+        """Admission, phase three: the whole bucket is prefilled — merge
+        the page into the reserved slot row and hand the request to the
+        decode batch."""
+        req = pf["req"]
+        slot = pf["slot"]
+        self._pf = None
+        self._insert_prefix(req, pf["page"], upto=pf["t0"] - 1)
+        self._ensure_capacity(
+            kv.bucket32(req.total, self._model._max_len))
+        self._caches = kv.merge_page(self._caches, pf["page"], slot)
+        self._tok[slot] = pf["prev"]         # the token at position PB
+        self._p[slot] = pf["PB"]             # next position to feed
         self._limit[slot] = req.total - 1
         self._active[slot] = True
-        self._left[slot] = left
+        self._left[slot] = pf["left"]
+        self._temp[slot] = pf["temp"]
+        self._topk[slot] = pf["topk"]
+        self._seed[slot] = pf["seed"]
+        self._t_admit[slot] = time.monotonic()
+        self._dec_emitted[slot] = False
         self._reqs[slot] = req
+        profiler.record_serving("prefills")
+
+    def _insert_prefix(self, req: ServingRequest, page, upto: int) -> None:
+        """Seed the radix tree with this request's forced-prompt blocks
+        (positions below ``upto``, whole 32-blocks only) so the NEXT
+        request sharing the prefix skips their prefill."""
+        if self._prefix is None or not req.use_prefix_cache:
+            return
+        created = self._prefix.insert(req.prompt, page,
+                                      min(upto, len(req.prompt) - 1))
+        if created:
+            profiler.record_serving("prefix_inserts", created)
+        if self._prefix.evictions > self._evict_seen:
+            profiler.record_serving("prefix_evictions",
+                                    self._prefix.evictions - self._evict_seen)
+            self._evict_seen = self._prefix.evictions
+        profiler.record_serving("prefix_cache_bytes", self._prefix.bytes)
 
     def _ensure_capacity(self, need: int) -> None:
         if self._TOT is None:
@@ -460,7 +687,8 @@ class ServingEngine:
             caches, tok, p, toks, lives = fn(
                 self._params, self._caches, jnp.asarray(self._tok),
                 jnp.asarray(self._p), jnp.asarray(self._active),
-                jnp.asarray(self._limit))
+                jnp.asarray(self._limit), jnp.asarray(self._temp),
+                jnp.asarray(self._topk), jnp.asarray(self._seed))
             toks_np = np.asarray(toks)
             lives_np = np.asarray(lives)
         self._caches = caches
@@ -477,6 +705,11 @@ class ServingEngine:
                 profiler.record_serving("tokens_out",
                                         int(self._left[slot] - left))
                 self._left[slot] = left
+                if not self._dec_emitted[slot]:
+                    self._dec_emitted[slot] = True
+                    profiler.record_serving(
+                        "first_decode_ms_last",
+                        (now - self._t_admit[slot]) * 1e3)
             if self._left[slot] == 0:
                 self._retire(slot, DONE, now)
             elif req._cancelled():
@@ -497,14 +730,43 @@ class ServingEngine:
         self._p[slot] = 0
         self._limit[slot] = 0
         self._left[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._seed[slot] = 0
+        self._dec_emitted[slot] = False
+
+    def _maybe_log(self) -> None:
+        """Per-interval engine log (``MXTPU_SERVING_LOG_S``): one line with
+        the TTFT decomposition and cache/occupancy health."""
+        if not self._log_s:
+            return
+        now = time.monotonic()
+        if now < self._next_log:
+            return
+        self._next_log = now + self._log_s
+        s = profiler.get_serving_stats()
+        _log.info(
+            "serving: %d in-flight / %d done; ttft last %.1f ms "
+            "(queue %.1f + prefill %.1f), first-decode %.1f ms; "
+            "occupancy %.2f; prefix hit-rate %.2f (%d hits, %.1f MB)",
+            int(self._active.sum()) + (1 if self._pf is not None else 0),
+            s["completed"], s["ttft_ms_last"], s["queue_wait_ms_last"],
+            s["prefill_ms_last"], s["first_decode_ms_last"],
+            s["slot_occupancy"], s["prefix_hit_rate"], s["prefix_hits"],
+            s["prefix_cache_bytes"] / (1 << 20))
 
     def _shutdown_sweep(self) -> None:
         """Terminal sweep: nothing submitted may block forever — in-slot,
-        staged, and still-queued requests all finish CANCELLED."""
+        mid-prefill, staged, and still-queued requests all finish
+        CANCELLED."""
         self._stop.set()     # scheduler may exit via error with stop unset
         now = time.monotonic()
         for slot in np.flatnonzero(self._active):
             self._retire(int(slot), CANCELLED, now)
+        if self._pf is not None:
+            pf, self._pf = self._pf, None
+            pf["req"]._finish(CANCELLED, now)
+            profiler.record_serving("cancelled")
         # staged by the feed but never admitted: drain until the producer's
         # end marker (it sees the stop flag within its 0.1s poll)
         deadline = time.monotonic() + 5.0

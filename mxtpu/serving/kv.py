@@ -15,12 +15,23 @@ that array's shape and contents:
 * **Promotion** — when an incoming request's total length outgrows ``TOT``,
   :func:`promote` zero-pads the cache into the next bucket; decode re-keys
   on the new ``TOT`` and compiles at most once per bucket ever seen.
-* **Prefill/decode split** — long prompts prefill through a separate B=1
-  program over their OWN prompt bucket (:func:`build_prefill`) instead of
-  stalling the slot batch; the produced page is merged into the slot row by
-  :func:`merge_page`. The prefill scan body is exactly ``_build_generate``'s
-  greedy body, which is what makes engine output bit-exact with solo
+* **Chunked prefill** — long prompts prefill through a separate B=1
+  program over their OWN prompt bucket, split into fixed-budget position
+  chunks (:func:`build_prefill_chunk`) dispatched BETWEEN decode chunks, so
+  admission never stalls the slot batch for more than one chunk's work; the
+  finished page is merged into the slot row by :func:`merge_page`. The
+  chunk scan body is exactly ``_build_generate``'s body (greedy by default,
+  per-request sampling via ``serving_sample``), and the cross-chunk carry is
+  just ``(page, prev-token)`` — splitting the scan cannot change a single
+  emitted token, which is what keeps engine output bit-exact with solo
   ``generate`` by construction rather than by test luck.
+* **Shared-prefix radix reuse** — :class:`PrefixCache` is a
+  reference-counted radix/LRU tree over 32-token token-id prefix blocks of
+  already-prefilled pages (SGLang-RadixAttention-style). A request whose
+  prompt extends a cached prefix copies the cached K/V rows into its page
+  and prefills only the suffix: a system prompt shared by N requests costs
+  ONE prefill. K/V at position ``p`` depends only on tokens ``0..p``, so an
+  exact token match at block granularity guarantees bit-identical rows.
 
 Decode-step semantics (shared with ``generate`` via ``serving_step``):
 feeding position ``p`` consumes the token AT ``p``, writes its K/V at ``p``,
@@ -33,14 +44,15 @@ and emits the token FOR ``p + 1``. A request with prompt length ``t0`` and
 
 from __future__ import annotations
 
-from typing import Tuple
+from collections import OrderedDict
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["bucket32", "cache_dims", "empty_cache", "promote", "merge_page",
-           "build_prefill", "build_decode"]
+           "build_prefill_chunk", "build_decode", "PrefixCache"]
 
 
 def bucket32(n: int, max_len: int) -> int:
@@ -80,37 +92,42 @@ def merge_page(caches, page, slot: int):
     return caches.at[:, :, slot].set(row)
 
 
-def build_prefill(model, PB: int):
-    """One compiled B=1 prefill program for prompt bucket ``PB``: scans
-    :meth:`serving_step` over positions ``0..PB-1``, forcing prompt tokens
-    while ``t < t0`` and feeding back the greedy argmax beyond — byte-for-
-    byte the greedy ``_build_generate`` body, so the page AND the emitted
-    tokens match what solo ``generate`` would have produced.
+def build_prefill_chunk(model, PB: int, csize: int):
+    """One compiled B=1 prefill CHUNK program for (prompt bucket ``PB``,
+    chunk size ``csize``): scans :meth:`serving_step` over positions
+    ``start .. start+csize-1``, forcing prompt tokens while ``t < t0`` and
+    feeding back the sampled/argmax token beyond. The cross-chunk carry is
+    exactly the in-scan carry — the partial page plus the previous token —
+    so running ``PB/csize`` chunks back to back reproduces the monolithic
+    prefill scan token for token. ``start`` rides as a TRACED scalar: every
+    chunk of a bucket reuses ONE program, and the engine interleaves these
+    dispatches with decode chunks so admission never stalls decode for more
+    than one chunk's work (the decode-stall guard contract).
 
-    Returns ``prefill(params, prompt (1, PB) int32, t0) ->
-    (page (L,2,1,H,PB,D), outs (PB,) int32)`` where ``outs[t]`` is the
-    token for position ``t + 1``; the valid generated tokens are
-    ``outs[t0-1 : PB]`` (positions ``t0..PB``), i.e. prefill always hands
-    the request its first ``PB - t0 + 1`` tokens at admission — TTFT is
-    prefill latency, and a short request may complete without ever
-    occupying a decode slot."""
-    L, H, D = cache_dims(model)
+    Returns ``prefill(params, page, prompt (1, PB) int32, t0, start,
+    prev (1,) int32, temp (1,) f32, topk (1,) int32, seed (1,) uint32) ->
+    (page (L,2,1,H,PB,D), outs (csize,) int32)`` where ``outs[j]`` is the
+    token for position ``start + j + 1``; the valid generated tokens of a
+    chunk are those with ``start + j >= t0 - 1``. With a prefix-cache hit
+    the engine seeds ``page`` with the cached rows and starts the cursor at
+    the matched length — only the suffix is ever scanned. Greedy decoding
+    is ``temp == 0`` (bit-exact argmax); sampling params are traced, so a
+    sampled and a greedy request share this one program."""
     step = model.serving_step(1, PB)
+    sample = model.serving_sample()
 
-    def run(params, prompt, t0):
-        page0 = jnp.zeros((L, 2, 1, H, PB, D), params["embed"].dtype)
-
-        def body(carry, t):
+    def run(params, page, prompt, t0, start, prev, temp, topk, seed):
+        def body(carry, j):
             page, prev = carry
+            t = start + j
             tok = jnp.where(t < t0, prompt[:, jnp.minimum(t, PB - 1)], prev)
             pos = jnp.full((1,), t, jnp.int32)
             new_page, logits = step(params, page, tok, pos)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = sample(logits, temp, topk, seed, pos)
             return (new_page, nxt), nxt
 
-        init = (page0, jnp.zeros((1,), jnp.int32))
-        (page, _), outs = lax.scan(body, init,
-                                   jnp.arange(PB, dtype=jnp.int32))
+        (page, _), outs = lax.scan(body, (page, prev),
+                                   jnp.arange(csize, dtype=jnp.int32))
         return page, outs[:, 0]
 
     return jax.jit(run)
@@ -118,25 +135,32 @@ def build_prefill(model, PB: int):
 
 def build_decode(model, S: int, TOT: int, chunk: int):
     """One compiled continuous-batching decode program for (slots ``S``,
-    KV bucket ``TOT``): ``chunk`` greedy steps over the slot batch with all
-    per-slot state — token, position, active flag, live limit — riding as
-    TRACED arrays, so requests joining/retiring between dispatches never
-    retrace (the compile-guard test pins exactly one trace per (S, TOT)).
+    KV bucket ``TOT``): ``chunk`` decode steps over the slot batch with all
+    per-slot state — token, position, active flag, live limit, and the
+    sampling params (temperature/top-k/seed) — riding as TRACED arrays, so
+    requests joining/retiring between dispatches AND sampling-mix changes
+    never retrace (the compile-guard test pins exactly one trace per
+    (S, TOT)).
 
-    Returns ``decode(params, caches, tok, p, active, limit) ->
-    (caches, tok, p, toks (chunk, S), lives (chunk, S))``. Per inner step a
-    slot is live while ``active & (p < limit)``; dead slots freeze (token
-    and position held, their rewrites land only in their own already-
-    retired row) and the host consumes ``toks[j, s]`` only where
-    ``lives[j, s]``."""
+    Returns ``decode(params, caches, tok, p, active, limit, temp, topk,
+    seed) -> (caches, tok, p, toks (chunk, S), lives (chunk, S))``. Per
+    inner step a slot is live while ``active & (p < limit)``; dead slots
+    freeze (token and position held, their rewrites land only in their own
+    already-retired row) and the host consumes ``toks[j, s]`` only where
+    ``lives[j, s]``. A slot with ``temp == 0`` decodes greedy argmax —
+    bit-exact with solo ``generate`` regardless of what its neighbors
+    sample; ``temp > 0`` samples with a key derived from (seed, position),
+    so a request's stream is deterministic per seed no matter how it was
+    scheduled."""
     step = model.serving_step(S, TOT)
+    sample = model.serving_sample()
 
-    def run(params, caches, tok, p, active, limit):
+    def run(params, caches, tok, p, active, limit, temp, topk, seed):
         def body(carry, _):
             caches, tok, p = carry
             live = active & (p < limit)
             new_caches, logits = step(params, caches, tok, p)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = sample(logits, temp, topk, seed, p)
             tok2 = jnp.where(live, nxt, tok)
             p2 = jnp.where(live, p + 1, p)
             return (new_caches, tok2, p2), (nxt, live)
@@ -146,3 +170,116 @@ def build_decode(model, S: int, TOT: int, chunk: int):
         return caches, tok, p, toks, lives
 
     return jax.jit(run)
+
+
+# ---------------------------------------------------------------------------
+# shared-prefix radix KV reuse (SGLang RadixAttention over bucketed pages)
+# ---------------------------------------------------------------------------
+
+
+class PrefixCache:
+    """Reference-counted radix/LRU tree over 32-token prompt-prefix blocks.
+
+    Node identity is the FULL token-id path from the root (a tuple whose
+    length is a multiple of :data:`BLOCK`), so a node at depth ``d`` holds
+    the K/V rows for absolute positions ``[32(d-1), 32d)`` computed under
+    exactly those first ``32d`` prompt tokens — the radix keying makes
+    position alignment and content identity one and the same check, and a
+    hit is therefore bit-exact by construction. Only FORCED prompt
+    positions are ever cached (block end ``<= t0 - 1``): a generated or
+    final-prompt position's token feeds the next step, which the suffix
+    prefill must compute itself.
+
+    Concurrency/ownership: the tree is engine-owned and scheduler-thread-
+    only. :meth:`match` pins every matched node (refcount) so eviction
+    can't race the page install; the engine releases the pins once the rows
+    are copied into its page (pages are jnp arrays — installs copy, never
+    alias, so cached rows are immutable by construction and eviction after
+    release is always safe). Capacity is a byte cap (``MXTPU_PREFIX_CACHE_MB``);
+    eviction walks LRU order and removes unpinned LEAF nodes only, keeping
+    every cached path prefix-closed."""
+
+    BLOCK = 32
+
+    def __init__(self, block_bytes: int, capacity_mb: float):
+        self.block_bytes = int(block_bytes)
+        self.capacity_bytes = int(float(capacity_mb) * (1 << 20))
+        self.evictions = 0
+        self._nodes: "OrderedDict[tuple, dict]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def bytes(self) -> int:
+        return len(self._nodes) * self.block_bytes
+
+    def match(self, tokens, limit: int) -> Tuple[int, List, tuple]:
+        """Longest cached prefix of ``tokens`` in whole blocks, capped at
+        position ``limit`` (exclusive; the engine passes ``t0 - 1`` so the
+        final prompt position is always recomputed — its output token seeds
+        the feedback chain). Returns ``(matched_len, kv_blocks, path)``
+        with every matched node PINNED; call :meth:`release(path)` once the
+        rows are installed."""
+        blocks: List = []
+        path: tuple = ()
+        m = 0
+        while m + self.BLOCK <= limit:
+            nxt = path + tuple(tokens[m:m + self.BLOCK])
+            node = self._nodes.get(nxt)
+            if node is None:
+                break
+            node["refs"] += 1
+            self._nodes.move_to_end(nxt)
+            blocks.append(node["kv"])
+            path = nxt
+            m += self.BLOCK
+        return m, blocks, path
+
+    def release(self, path: tuple) -> None:
+        """Unpin every node along ``path`` (inverse of :meth:`match`)."""
+        for i in range(self.BLOCK, len(path) + 1, self.BLOCK):
+            node = self._nodes.get(path[:i])
+            if node is not None:
+                node["refs"] -= 1
+
+    def insert(self, tokens, page, limit: int) -> int:
+        """Cache the prefix blocks of a finished (or partial) prefill:
+        block ``b`` slices rows ``[32b, 32b+32)`` off ``page`` for every
+        whole block below ``limit``. Existing nodes are kept (identical by
+        the radix invariant), so N requests sharing a prefix insert it
+        once. Returns the number of freshly created nodes; may evict."""
+        created = 0
+        path: tuple = ()
+        m = 0
+        while m + self.BLOCK <= limit:
+            nxt = path + tuple(tokens[m:m + self.BLOCK])
+            node = self._nodes.get(nxt)
+            if node is None:
+                node = {"kv": page[..., m:m + self.BLOCK, :],
+                        "refs": 0, "children": 0}
+                self._nodes[nxt] = node
+                if path:
+                    self._nodes[path]["children"] += 1
+                created += 1
+            self._nodes.move_to_end(nxt)
+            path = nxt
+            m += self.BLOCK
+        if created:
+            self._evict()
+        return created
+
+    def _evict(self) -> None:
+        while self.bytes > self.capacity_bytes:
+            victim: Optional[tuple] = None
+            for key, node in self._nodes.items():     # LRU order
+                if node["children"] == 0 and node["refs"] == 0:
+                    victim = key
+                    break
+            if victim is None:
+                return            # everything pinned or interior: over-cap
+            self._nodes.pop(victim)
+            parent = victim[:-self.BLOCK]
+            if parent in self._nodes:
+                self._nodes[parent]["children"] -= 1
+            self.evictions += 1

@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import conftest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -99,6 +101,23 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert 0 < serving["slot_occupancy"] <= 1
     assert doc["ratchet"]["current"]["serving_goodput"] \
         == serving["goodput_tok_s"]
+    # shared-prefix leg (ISSUE 13): the system prompt prefilled ONCE
+    # (hit rate (N-1)/N), p99 TTFT beat the serialized-prefill baseline,
+    # decode stayed bit-exact, and both ride the ratchet
+    prefix = serving["prefix"]
+    assert prefix["decode_match"] is True
+    n = prefix["requests"]
+    assert prefix["hit_rate"] >= (n - 1) / n
+    assert prefix["hit_tokens"] == (n - 1) * prefix["shared_prefix_tokens"]
+    assert prefix["ttft_p99_improvement"] > 1.0, prefix
+    assert prefix["baseline"]["hit_rate"] == 0          # reuse was OFF
+    assert doc["ratchet"]["current"]["prefix_hit_rate"] \
+        == prefix["hit_rate"]
+    assert doc["ratchet"]["current"]["serving_ttft_p99_inv"] \
+        == pytest.approx(1e3 / prefix["ttft_p99_ms"])
+    # TTFT decomposition keys shipped by the engine stats
+    assert serving["ttft_queue_wait_ms_mean"] >= 0
+    assert serving["ttft_prefill_ms_mean"] > 0
     # elastic leg (ISSUE 11): one live in-place dp shrink mid-fit — no
     # restart, no steps lost, bit-exact with a cold resume — and a serving
     # drain/adopt handoff that dropped nothing
@@ -190,6 +209,16 @@ def test_bench_serving_scenario_cli(tmp_path):
     assert serving["goodput_vs_serial"] >= 1.5, serving
     assert serving["deadline_ms"] > 0
     assert serving["per_token_p99_ms"] >= serving["per_token_p50_ms"] > 0
+    # serving-only runs ratchet too: TTFT (inverse) + prefix hit rate land
+    # under the serving-smoke harness key alongside goodput
+    prefix = serving["prefix"]
+    assert prefix["hit_rate"] >= (prefix["requests"] - 1) / prefix["requests"]
+    assert prefix["decode_match"] is True
+    cur = doc["ratchet"]["current"]
+    assert cur["serving_goodput"] == serving["goodput_tok_s"]
+    assert cur["prefix_hit_rate"] == prefix["hit_rate"]
+    assert cur["serving_ttft_p99_inv"] > 0
+    assert doc["ratchet"]["harness"] == "serving-smoke"
 
 
 def test_bench_elastic_scenario_cli(tmp_path):

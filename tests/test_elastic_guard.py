@@ -377,6 +377,45 @@ def test_serving_drain_adopt_zero_drops_bit_exact(net):
     assert stats["completed"] == len(trace)
 
 
+def test_serving_drain_mid_admission_resumes_suffix_prefill(net):
+    """ISSUE 13 satellite: a drain landing while a request is MID-prefill
+    must freeze the partial page + cursor into the handoff, and adopt()
+    must resume the SUFFIX — never re-prefill from scratch. The chunk
+    counter proves it: across both engines the request's bucket is scanned
+    exactly once."""
+    from mxtpu.serving import ServingEngine
+    profiler.reset_serving_stats()
+    rs = np.random.RandomState(29)
+    prompt = rs.randint(1, VOCAB, size=248).tolist()   # PB = 256, 64 chunks
+    ref = _solo(net, prompt, 8)
+
+    eng = ServingEngine(net, slots=2, queue_depth=8, chunk=4,
+                        prefill_chunk=4).start()
+    req = eng.submit(prompt, 8)
+    t0 = time.monotonic()
+    while profiler.get_serving_stats()["prefill_chunks"] < 1:
+        assert time.monotonic() - t0 < 300, "prefill never started"
+        time.sleep(0.001)
+    handoff = eng.drain()                 # lands inside the 64-chunk scan
+    assert len(handoff.partial) == 1
+    assert handoff.partial[0]["t"] < 256  # genuinely mid-prefill
+    assert handoff.in_flight == 1
+    stats = profiler.get_serving_stats()
+    assert stats["drained"] == 1
+    assert stats["cancelled"] == 0 and stats["expired"] == 0
+
+    eng2 = ServingEngine(net, slots=2, queue_depth=8, chunk=4,
+                         prefill_chunk=4)
+    eng2.adopt(handoff)
+    assert req.result(timeout=300) == ref  # resumed, bit-exact
+    eng2.stop()
+    stats = profiler.get_serving_stats()
+    # engine1's chunks + engine2's chunks tile the bucket exactly once:
+    # the suffix resumed from the drained cursor, nothing was re-scanned
+    assert stats["prefill_chunks"] == 256 // 4
+    assert stats["completed"] == 1 and stats["adopted"] == 1
+
+
 def test_serving_drain_fault_sweeps_instead_of_blocking(net, monkeypatch):
     """A fault at the ``serving.drain`` seam aborts the handoff — the
     cancel-everything sweep must still run so no caller blocks forever."""

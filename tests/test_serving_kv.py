@@ -1,0 +1,165 @@
+"""KV-page admission edges + shared-prefix radix cache unit guard (ISSUE 13).
+
+The serving engine's paged-KV moves — :func:`kv.promote` (bucket growth),
+:func:`kv.merge_page` (page install), and the :class:`kv.PrefixCache` radix
+tree — are exercised here directly, without an engine or a model, on small
+arrays whose every element is checkable: promote at the max_len cap, merge
+into a just-promoted bucket, copy-on-write of aliased prefix pages, and the
+pin/LRU/leaf-only eviction discipline of the radix tree.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from mxtpu.serving import kv  # noqa: E402
+
+# tiny-but-nontrivial cache geometry: 2 layers, 2 heads, head dim 3
+L, H, D, S = 2, 2, 3, 2
+
+
+def _full_cache(TOT, fill=0.0):
+    c = jnp.full((L, 2, S, H, TOT, D), fill, jnp.float32)
+    return c
+
+
+def _page(PB, seed):
+    rs = np.random.RandomState(seed)
+    return jnp.asarray(rs.rand(L, 2, 1, H, PB, D).astype(np.float32))
+
+
+def test_promote_at_max_len_cap_is_identity():
+    # bucket32 caps at max_len: a request outgrowing the table asks for
+    # TOT_new == TOT_old, and promote must hand back the SAME array —
+    # no copy, no recompile-triggering shape change
+    assert kv.bucket32(1000, 64) == 64
+    caches = _full_cache(64, fill=3.0)
+    assert kv.promote(caches, 64) is caches
+    assert kv.promote(caches, 32) is caches      # shrink requests are no-ops
+
+
+def test_promote_zero_pads_and_preserves():
+    caches = _full_cache(32, fill=2.5)
+    grown = kv.promote(caches, 96)
+    assert grown.shape == (L, 2, S, H, 96, D)
+    np.testing.assert_array_equal(np.asarray(grown[..., :32, :]),
+                                  np.asarray(caches))
+    assert not np.any(np.asarray(grown[..., 32:, :]))
+
+
+def test_merge_page_into_just_promoted_bucket():
+    # the engine's admission order under growth: promote first, then merge
+    # the (smaller-bucket) page — the row must carry the page's rows, a
+    # ZERO tail (no stale K/V from the slot's previous tenant), and leave
+    # the neighbor slot untouched
+    caches = _full_cache(32, fill=7.0)           # slot 1's previous tenant
+    caches = kv.promote(caches, 96)
+    page = _page(64, seed=1)
+    merged = kv.merge_page(caches, page, 1)
+    np.testing.assert_array_equal(np.asarray(merged[:, :, 1, :, :64]),
+                                  np.asarray(page[:, :, 0]))
+    assert not np.any(np.asarray(merged[:, :, 1, :, 64:]))   # tail zeroed
+    np.testing.assert_array_equal(np.asarray(merged[:, :, 0]),
+                                  np.asarray(caches[:, :, 0]))
+
+
+def test_merge_of_aliased_prefix_page_copies():
+    # two requests build their pages from the SAME cached prefix rows; each
+    # then writes its own suffix. Functional updates must copy-on-write:
+    # neither the sibling page nor the cached block may see the writes
+    cache = kv.PrefixCache(block_bytes=1, capacity_mb=1)
+    tokens = list(range(1, 33))
+    donor = _page(32, seed=2)
+    cache.insert(tokens, donor, limit=32)
+    m, blocks, path = cache.match(tokens, limit=32)
+    assert m == 32
+    base = jnp.zeros((L, 2, 1, H, 64, D), jnp.float32)
+    page_a = base.at[..., :32, :].set(jnp.concatenate(blocks, axis=4))
+    page_b = base.at[..., :32, :].set(jnp.concatenate(blocks, axis=4))
+    cache.release(path)
+    page_a = page_a.at[..., 5, :].set(99.0)      # request A's suffix write
+    np.testing.assert_array_equal(np.asarray(page_b[..., :32, :]),
+                                  np.asarray(donor))
+    m2, blocks2, path2 = cache.match(tokens, limit=32)
+    np.testing.assert_array_equal(np.asarray(blocks2[0]),
+                                  np.asarray(donor))   # tree rows untouched
+    cache.release(path2)
+
+
+def test_prefix_cache_match_limit_and_block_granularity():
+    cache = kv.PrefixCache(block_bytes=1, capacity_mb=1)
+    tokens = list(range(100))
+    cache.insert(tokens, _page(96, seed=3), limit=96)
+    assert len(cache) == 3                       # whole blocks only
+    # a limit mid-block (the engine's t0 - 1) truncates to block boundary
+    m, blocks, path = cache.match(tokens, limit=70)
+    assert m == 64 and len(blocks) == 2
+    cache.release(path)
+    # a diverging token ends the walk at the shared prefix
+    fork = tokens[:40] + [7777] + tokens[41:]
+    m, blocks, path = cache.match(fork, limit=96)
+    assert m == 32 and len(blocks) == 1
+    cache.release(path)
+    # under one block: nothing to match, nothing pinned
+    m, blocks, path = cache.match(tokens, limit=31)
+    assert m == 0 and blocks == [] and path == ()
+
+
+def test_prefix_cache_insert_dedupes_shared_prefix():
+    cache = kv.PrefixCache(block_bytes=1, capacity_mb=1)
+    shared = list(range(64))
+    a = shared + [1, 2, 3] + list(range(200, 229))
+    b = shared + [4, 5, 6] + list(range(300, 329))
+    assert cache.insert(a, _page(96, seed=4), limit=96) == 3
+    # b re-walks the shared two blocks (kept, not re-created) and adds one
+    assert cache.insert(b, _page(96, seed=5), limit=96) == 1
+    assert len(cache) == 4
+
+
+def test_prefix_cache_evicts_lru_leaves_only_and_respects_pins():
+    # capacity of exactly 4 blocks; each path below is 2 blocks long
+    cache = kv.PrefixCache(block_bytes=1 << 19, capacity_mb=2)
+    paths = [[i] * 64 for i in (1, 2, 3)]
+    cache.insert(paths[0], _page(64, seed=6), limit=64)
+    cache.insert(paths[1], _page(64, seed=7), limit=64)
+    assert cache.bytes == 4 << 19
+    # pin path[0]; inserting path[2] must evict from path[1] (LRU), and
+    # only its LEAF first — the tree stays prefix-closed
+    m, _, pin = cache.match(paths[0], limit=64)
+    assert m == 64
+    cache.insert(paths[2], _page(64, seed=8), limit=64)
+    assert cache.bytes <= 4 << 19
+    assert cache.evictions >= 2                  # path[1] gone leaf-first
+    assert cache.match(paths[1], limit=64)[0] == 0
+    cache.release(pin)
+    m, _, p = cache.match(paths[0], limit=64)    # pinned path survived
+    assert m == 64
+    cache.release(p)
+    m, _, p = cache.match(paths[2], limit=64)    # newcomer resident
+    assert m == 64
+    cache.release(p)
+
+
+def test_prefix_cache_pins_block_eviction_newcomer_self_evicts():
+    # at capacity with every resident node PINNED, an insert may not rip
+    # rows out from under the in-flight install — the unpinned NEWCOMER is
+    # the only legal victim and evicts itself; pinned rows never move
+    cache = kv.PrefixCache(block_bytes=1 << 20, capacity_mb=1)
+    t1, t2, t3 = [1] * 32, [2] * 32, [3] * 32
+    cache.insert(t1, _page(32, seed=9), limit=32)
+    m, _, pin1 = cache.match(t1, limit=32)
+    assert m == 32
+    cache.insert(t2, _page(32, seed=10), limit=32)
+    assert cache.evictions == 1                  # t2 self-evicted
+    assert cache.match(t2, limit=32)[0] == 0
+    m, _, p = cache.match(t1, limit=32)          # pinned row untouched
+    assert m == 32
+    cache.release(p)
+    cache.release(pin1)                          # t1 now unpinned
+    cache.insert(t3, _page(32, seed=11), limit=32)   # evicts LRU t1
+    assert cache.evictions == 2
+    assert cache.match(t1, limit=32)[0] == 0
+    m, _, p = cache.match(t3, limit=32)
+    assert m == 32
+    cache.release(p)
