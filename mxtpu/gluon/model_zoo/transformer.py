@@ -178,8 +178,11 @@ class TransformerLM(HybridBlock):
                 v = (h @ lp["vw"].T + lp["vb"]).reshape(S, H, D)
                 # per-slot scatter: slot s writes only its own cache row at
                 # its own position — dead/retired slots can't corrupt peers
-                new_caches = new_caches.at[i, 0, rows, :, pc].set(k)
-                new_caches = new_caches.at[i, 1, rows, :, pc].set(v)
+                kv_dt = new_caches.dtype     # bf16 caches: cast, then store
+                new_caches = new_caches.at[i, 0, rows, :, pc].set(
+                    k.astype(kv_dt))
+                new_caches = new_caches.at[i, 1, rows, :, pc].set(
+                    v.astype(kv_dt))
                 K = new_caches[i, 0]        # (S, H, TOT, D)
                 V = new_caches[i, 1]
                 s = jnp.einsum("bhd,bhtd->bht", q, K) * scale

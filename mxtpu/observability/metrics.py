@@ -16,7 +16,7 @@ guard for this contract).
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 _stats_lock = threading.Lock()
 
@@ -336,11 +336,17 @@ _SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
                  # admission-complete -> first decode-chunk token
                  "queue_wait_ms_total": 0.0, "queue_wait_ms_last": 0.0,
                  "prefill_ms_total": 0.0, "prefill_ms_last": 0.0,
-                 "first_decode_ms_total": 0.0, "first_decode_ms_last": 0.0}
+                 "first_decode_ms_total": 0.0, "first_decode_ms_last": 0.0,
+                 # KV-cache residency (mxtpu.quant): bytes of the resident
+                 # paged cache (data + scales when quantized) and its
+                 # storage dtype ('float32' | 'bfloat16' | 'int8' | 'fp8')
+                 "kv_bytes_resident": 0, "kv_dtype": "float32"}
 _serving = dict(_SERVING_ZERO)
 
 # keys that ASSIGN the latest value instead of accumulating
-_SERVING_ASSIGN = ("slots", "prefix_cache_bytes")
+_SERVING_ASSIGN = ("slots", "prefix_cache_bytes", "kv_bytes_resident")
+# string-valued keys (assign verbatim)
+_SERVING_STR = ("kv_dtype",)
 
 
 def record_serving(key: str, n=1):
@@ -358,6 +364,8 @@ def record_serving(key: str, n=1):
         elif key.endswith("_max"):
             if n > _serving[key]:
                 _serving[key] = n
+        elif key in _SERVING_STR:
+            _serving[key] = str(n)
         elif key in _SERVING_ASSIGN:
             _serving[key] = int(n)
         else:
@@ -394,6 +402,65 @@ def get_serving_stats() -> dict:
 def reset_serving_stats():
     with _stats_lock:
         _serving.update(_SERVING_ZERO)
+
+
+# ---------------------------------------------------------------------------
+# quantization observability (mxtpu.quant counters)
+# ---------------------------------------------------------------------------
+
+_QUANT_ZERO = {"matmuls": 0}
+_quant = dict(_QUANT_ZERO)
+_quant_err: Dict[str, float] = {}
+_quant_ranges: Dict[str, tuple] = {}
+
+
+def record_quant_matmuls(n: int = 1):
+    """``n`` quantized matmul sites staged. Serving records the per-program
+    site count at build time; the QAT step hooks record one per Dense/Conv
+    site at TRACE time — so the counter reads 'quantized matmuls compiled',
+    which is the retrace-stable quantity (per-dispatch counts would need a
+    host sync inside jit)."""
+    with _stats_lock:
+        _quant["matmuls"] += int(n)
+
+
+def record_quant_error(tensor: str, err: float):
+    """Per-tensor max-abs round-trip quantization error, high-water over the
+    process (``quantize_lm`` records each weight once; re-quantizing after a
+    weight update only raises the mark if the error grew)."""
+    with _stats_lock:
+        if err > _quant_err.get(tensor, float("-inf")):
+            _quant_err[tensor] = float(err)
+
+
+def record_quant_range(tensor: str, lo: float, hi: float):
+    """Calibrated activation range for one site (``quant.calibrate``) —
+    widens monotonically so repeated calibration passes compose."""
+    with _stats_lock:
+        old = _quant_ranges.get(tensor)
+        if old is not None:
+            lo, hi = min(lo, old[0]), max(hi, old[1])
+        _quant_ranges[tensor] = (float(lo), float(hi))
+
+
+def get_quant_stats() -> dict:
+    """Quantization counters: ``matmuls`` (quantized matmul sites staged),
+    ``max_abs_error`` (per-tensor weight round-trip error high-water),
+    ``ranges`` (per-site calibrated activation (min, max)) — the
+    observability contract of ``mxtpu.quant``: a quant regression shows up
+    here before it shows up in accuracy."""
+    with _stats_lock:
+        out = dict(_quant)
+        out["max_abs_error"] = dict(_quant_err)
+        out["ranges"] = dict(_quant_ranges)
+    return out
+
+
+def reset_quant_stats():
+    with _stats_lock:
+        _quant.update(_QUANT_ZERO)
+        _quant_err.clear()
+        _quant_ranges.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -31,13 +31,20 @@ from .registry import register, alias
 # dense / conv / pooling
 # ---------------------------------------------------------------------------
 
+# Low-precision execution hooks (mxtpu.quant.train.quant_scope): when set,
+# these replace the fp32 matmul/conv contraction — bias add, flattening and
+# layout handling stay here so the quant layer only sees the contraction.
+_QUANT_DENSE = None   # (x, weight) -> x @ weight.T in the active quant mode
+_QUANT_CONV = None    # (data, weight, **conv_kw) -> conv in the active mode
+
 
 @register("FullyConnected", aliases=("fully_connected",))
 def _fully_connected(data, weight, bias=None, num_hidden: int = 0,
                      no_bias: bool = False, flatten: bool = True):
     """src/operator/nn/fully_connected.cc:231: y = x·Wᵀ + b (weight stored [out,in])."""
     x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
-    y = jnp.matmul(x, weight.T)
+    y = _QUANT_DENSE(x, weight) if _QUANT_DENSE is not None \
+        else jnp.matmul(x, weight.T)
     if bias is not None and not no_bias:
         y = y + bias
     return y
@@ -73,9 +80,11 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(
     stride, dilate = _tup(stride, n), _tup(dilate, n)
     pad = _tup(pad, n) if pad else (0,) * n
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_LAYOUTS[n])
-    out = lax.conv_general_dilated(
-        data, weight, window_strides=stride, padding=[(p, p) for p in pad],
-        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group)
+    conv_kw = dict(window_strides=stride, padding=[(p, p) for p in pad],
+                   rhs_dilation=dilate, dimension_numbers=dn,
+                   feature_group_count=num_group)
+    out = _QUANT_CONV(data, weight, **conv_kw) if _QUANT_CONV is not None \
+        else lax.conv_general_dilated(data, weight, **conv_kw)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out

@@ -37,6 +37,10 @@ def _scale_of(min_range, max_range, out_type="int8"):
         # unsigned range [0, max] -> [0, 255] (quantization_utils.h
         # FloatToQuantized<uint8_t>: post-ReLU activations are non-negative)
         return 255.0 / jnp.maximum(max_range, 1e-30)
+    if out_type not in _QMAX:
+        raise ValueError(
+            f"unknown quantized out_type {out_type!r}: expected one of "
+            f"{sorted(_QMAX)} or 'uint8'")
     absmax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
     return _QMAX[out_type] / jnp.maximum(absmax, 1e-30)
 

@@ -38,8 +38,8 @@ from .mesh import data_axis_names, data_size, fsdp_axis_name, fsdp_size
 
 __all__ = ["zero_stage", "compose_spec", "fsdp_param_specs",
            "per_device_bytes", "replicated_bytes", "measure_memory",
-           "SpecLayout", "parameter_spec_from_name", "filter_spec",
-           "layout_scope", "current_layout"]
+           "SpecLayout", "parameter_spec_from_name", "scale_spec",
+           "filter_spec", "layout_scope", "current_layout"]
 
 
 def zero_stage() -> int:
@@ -166,6 +166,18 @@ class SpecLayout:
         """(B, H, T, D) inside attention: heads sharded, FULL sequence per
         device group — the post-all-to-all Ulysses layout."""
         return P(self.data_axes, self.ulysses_axis)
+
+
+def scale_spec(weight_spec: Optional[P]) -> P:
+    """Partition spec for a per-row quantization scale vector riding a 2-D
+    ``(out, in)`` weight (``mxtpu.quant``): the scale has one entry per OUTPUT
+    row, so it shards exactly like the weight's dim 0 and nothing else —
+    column-parallel weights get tp-sharded scales, row-parallel weights get
+    replicated scales (their dim-0 is unsharded)."""
+    if weight_spec is None:
+        return P()
+    entries = tuple(weight_spec)
+    return P(entries[0]) if entries and entries[0] is not None else P()
 
 
 def parameter_spec_from_name(name: str, layout: Optional[SpecLayout] = None) -> P:

@@ -1,0 +1,48 @@
+"""mxtpu.quant — end-to-end low-precision execution (ROADMAP item 2).
+
+Four surfaces, one subsystem:
+
+* :mod:`~mxtpu.quant.kv_quant` — int8/fp8 paged KV cache (QuantKV pytree,
+  per-token-per-head scales, quantize-on-append).
+* :mod:`~mxtpu.quant.serve` — quantized serving decode: ``QuantSpec`` /
+  ``parse_quant`` (``MXTPU_SERVING_QUANT``), ``quantize_lm`` weight-only
+  int8, and the quantized twin of ``TransformerLM.serving_step``.
+* :mod:`~mxtpu.quant.train` — QAT fused step (``MXTPU_QUANT_STEP``):
+  fake-quant/int8 forward matmuls with straight-through grads under fp32
+  master weights, installed into the StepExecutor trace scope.
+* :mod:`~mxtpu.quant.calibrate` — streaming entropy/min-max calibration
+  over a ``DeviceFeed`` (lifted out of ``contrib/quantization.py``).
+
+Submodules import lazily so ``import mxtpu.quant`` costs nothing until a
+surface is touched (the step cache probes ``quant.train`` per step).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_SUBMODULES = ("kv_quant", "serve", "train", "calibrate")
+
+# re-exported names -> owning submodule
+_LAZY = {
+    "QuantKV": "kv_quant", "KV_MODES": "kv_quant",
+    "quantize_rows": "kv_quant", "dequantize_rows": "kv_quant",
+    "QuantSpec": "serve", "parse_quant": "serve", "quantize_lm": "serve",
+    "quant_step_mode": "train", "quant_scope": "train",
+    "StreamingCalibrator": "calibrate", "calibrate_feed": "calibrate",
+}
+
+__all__ = list(_SUBMODULES) + sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    mod = _LAZY.get(name)
+    if mod is not None:
+        return getattr(importlib.import_module(f".{mod}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,263 @@
+"""Streaming activation calibration — entropy/min-max over a ``DeviceFeed``.
+
+The calibration math (naive min/max and the TensorRT-style KL-optimal
+threshold sweep) lived inside ``contrib/quantization.py`` and worked by
+CONCATENATING every observed activation on the host — O(samples) memory,
+unusable against a production feed. This module lifts it into a streaming
+API: :class:`StreamingCalibrator` folds each observed chunk into per-tensor
+min/max/absmax plus a fixed-width histogram (range expands by power-of-two
+rebinning when a later chunk overflows it), so memory is O(bins) per tensor
+regardless of how many batches stream through. ``contrib.quantize_net``'s
+collection pass now runs on this calibrator; :func:`calibrate_feed` drives
+it over any batch source — including an async :class:`~mxtpu.device_feed.
+DeviceFeed` — and records the calibrated ranges into
+``profiler.get_quant_stats()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["StreamingCalibrator", "calibrate_feed", "collect_stats",
+           "optimal_threshold_from_hist", "_get_optimal_threshold",
+           "_smooth_distribution"]
+
+
+def _smooth_distribution(p: np.ndarray, eps: float = 1e-4) -> np.ndarray:
+    """Replace zeros with eps, taking the mass off nonzero entries
+    (reference quantization.py:234 _smooth_distribution behavior)."""
+    is_zero = p == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = p.size - n_zero
+    if n_zero == 0 or n_nonzero == 0:
+        return p.astype(np.float64)
+    out = p.astype(np.float64).copy()
+    out[is_zero] = eps
+    out[~is_zero] -= eps * n_zero / n_nonzero
+    return out
+
+
+def optimal_threshold_from_hist(hist: np.ndarray, edges: np.ndarray,
+                                num_quantized_bins: int = 255,
+                                sweep_stride: Optional[int] = None) -> float:
+    """KL-optimal clipping threshold from a symmetric histogram (the
+    TensorRT algorithm; reference quantization.py:253).
+
+    The clipped reference distribution P absorbs the outlier mass into its
+    edge bins while the int8-quantized candidate Q is built from the
+    *sliced* histogram only — that asymmetry is what makes aggressive
+    clipping of real mass expensive in KL(P||Q). ``sweep_stride`` subsamples
+    the threshold sweep (default covers ~256 candidates, bounding the KL gap
+    to adjacent-bin resolution)."""
+    num_bins = int(hist.size)
+    zero = num_bins // 2
+    half_q = num_quantized_bins // 2
+    stride = sweep_stride or max(1, (zero + 1 - half_q) // 256)
+    best_kl, best_t = np.inf, float(edges[-1])
+    for i in range(half_q, zero + 1, stride):
+        start, stop = zero - i, zero + i + 1
+        sliced = hist[start:stop].astype(np.float64)
+        p = sliced.copy()
+        p[0] += hist[:start].sum()
+        p[-1] += hist[stop:].sum()
+        if p.sum() == 0:
+            continue
+        nonzero = sliced != 0
+        m = p.size // num_quantized_bins
+        q = np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            s = j * m
+            e = s + m if j != num_quantized_bins - 1 else p.size
+            cnt = int(nonzero[s:e].sum())
+            if cnt:
+                q[s:e][nonzero[s:e]] = sliced[s:e].sum() / cnt
+        ps = _smooth_distribution(p)
+        qs = _smooth_distribution(q)
+        ps /= ps.sum()
+        qs /= qs.sum()
+        kl = float(np.sum(ps * np.log(ps / qs)))
+        if kl < best_kl:
+            best_kl, best_t = kl, float(edges[stop])
+    return best_t
+
+
+def _get_optimal_threshold(arr: np.ndarray, num_bins: int = 2001,
+                           num_quantized_bins: int = 255,
+                           sweep_stride: Optional[int] = None) -> float:
+    """One-shot threshold over a materialized array (the pre-streaming
+    surface; ``contrib.quantization`` re-exports it for compatibility)."""
+    arr = np.asarray(arr, np.float64).ravel()
+    th = float(np.max(np.abs(arr))) if arr.size else 0.0
+    if th == 0.0:
+        return 1e-30
+    hist, edges = np.histogram(arr, bins=num_bins, range=(-th, th))
+    return optimal_threshold_from_hist(hist, edges, num_quantized_bins,
+                                       sweep_stride)
+
+
+class StreamingCalibrator:
+    """Constant-memory per-tensor activation statistics.
+
+    ``observe(name, chunk)`` folds a chunk into running min/max/absmax and a
+    ``num_bins``-wide symmetric histogram. The histogram's range is fixed by
+    the first chunk's absmax; when a later chunk overflows it, the range
+    doubles (power-of-two) and existing counts REBIN by bin-center — each
+    count lands within half a (new, coarser) bin of where an exact
+    re-histogram would put it, so the entropy sweep sees at most one-bin
+    drift versus the concatenate-everything baseline."""
+
+    def __init__(self, num_bins: int = 2001):
+        self.num_bins = int(num_bins)
+        self._min: Dict[str, float] = {}
+        self._max: Dict[str, float] = {}
+        self._absmax: Dict[str, float] = {}
+        self._hist: Dict[str, np.ndarray] = {}
+        self._th: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+
+    # -- accumulation ------------------------------------------------------
+    def observe(self, name: str, chunk) -> None:
+        arr = np.asarray(chunk, np.float64).ravel()
+        if arr.size == 0:
+            return
+        lo, hi = float(arr.min()), float(arr.max())
+        am = max(abs(lo), abs(hi))
+        self._min[name] = min(self._min.get(name, lo), lo)
+        self._max[name] = max(self._max.get(name, hi), hi)
+        self._absmax[name] = max(self._absmax.get(name, am), am)
+        self._count[name] = self._count.get(name, 0) + arr.size
+        th = self._th.get(name)
+        if th is None:
+            th = am if am > 0 else 1.0
+            self._th[name] = th
+            self._hist[name] = np.zeros(self.num_bins, np.int64)
+        elif am > th:
+            factor = 2 ** int(math.ceil(math.log2(am / th)))
+            self._rebin(name, th * factor)
+            th = self._th[name]
+        self._hist[name] += np.histogram(arr, bins=self.num_bins,
+                                         range=(-th, th))[0]
+
+    def _rebin(self, name: str, th_new: float) -> None:
+        th = self._th[name]
+        hist = self._hist[name]
+        centers = (np.arange(self.num_bins) + 0.5) * (2 * th / self.num_bins) - th
+        idx = np.clip(((centers + th_new) * self.num_bins
+                       / (2 * th_new)).astype(np.int64), 0, self.num_bins - 1)
+        out = np.zeros(self.num_bins, np.int64)
+        np.add.at(out, idx, hist)
+        self._hist[name] = out
+        self._th[name] = th_new
+
+    # -- readout -----------------------------------------------------------
+    def names(self):
+        return sorted(self._count)
+
+    def seen(self, name: str) -> bool:
+        return self._count.get(name, 0) > 0
+
+    def minmax(self, name: str) -> Tuple[float, float]:
+        return self._min[name], self._max[name]
+
+    def absmax(self, name: str) -> float:
+        return self._absmax[name]
+
+    def threshold(self, name: str, num_quantized_bins: int = 255) -> float:
+        """KL-optimal clipping threshold from the streamed histogram."""
+        th = self._th[name]
+        if self._absmax[name] == 0.0:
+            return 1e-30
+        edges = np.linspace(-th, th, self.num_bins + 1)
+        return optimal_threshold_from_hist(self._hist[name], edges,
+                                           num_quantized_bins)
+
+    def ranges(self) -> Dict[str, Tuple[float, float]]:
+        return {n: (self._min[n], self._max[n]) for n in self.names()}
+
+
+def _batch_input(batch):
+    """First data tensor of whatever the feed yields: DataBatch / (x, y) /
+    bare array."""
+    data = getattr(batch, "data", None)
+    if data is not None and isinstance(data, (list, tuple)):
+        return data[0]
+    if isinstance(batch, (tuple, list)):
+        return batch[0]
+    return batch
+
+
+def collect_stats(net, sites, batches, num_batches: Optional[int] = None,
+                  calib: Optional[StreamingCalibrator] = None):
+    """Stream ``batches`` through ``net`` with forward pre-hooks folding each
+    site's input into a :class:`StreamingCalibrator` — no activation is ever
+    retained. ``sites`` is the ``contrib.quantization._walk`` site list."""
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    calib = calib or StreamingCalibrator()
+    hooked = []
+    for parent, key, child, name in sites:
+        def mk(nm):
+            def hook(block, args):
+                x = args[0]
+                raw = x.data if isinstance(x, NDArray) else x
+                calib.observe(nm, raw)
+            return hook
+        child.register_forward_pre_hook(mk(name))
+        hooked.append(child)
+    try:
+        n = 0
+        for batch in batches:
+            x = _batch_input(batch)
+            with autograd.predict_mode():
+                net(x if isinstance(x, NDArray) else NDArray(jnp.asarray(x)))
+            n += 1
+            if num_batches is not None and n >= num_batches:
+                break
+    finally:
+        for child in hooked:
+            child._forward_pre_hooks.pop()
+    return calib
+
+
+def calibrate_feed(net, feed, mode: str = "entropy",
+                   num_batches: Optional[int] = None, exclude=(),
+                   logger: Optional[logging.Logger] = None
+                   ) -> StreamingCalibrator:
+    """Calibrate every eligible Dense/Conv site of ``net`` over ``feed`` —
+    any batch iterable, including an async :class:`DeviceFeed` (reset first
+    when the source is resettable, so calibration sees epoch-aligned data).
+
+    Returns the :class:`StreamingCalibrator`; per-site ranges land in
+    ``profiler.get_quant_stats()['ranges']`` so the calibration a deployment
+    shipped with stays observable. ``mode`` is 'naive' (absmax) or 'entropy'
+    (KL threshold) — it only selects what gets LOGGED/recorded here; both
+    readouts stay available on the returned calibrator."""
+    if mode not in ("naive", "entropy"):
+        raise ValueError(f"calib_mode {mode!r} (naive | entropy)")
+    from ..contrib.quantization import _walk
+    from .. import profiler
+    sites = [(p, k, c, n) for p, k, c, n in _walk(net)
+             if not any(e in n for e in exclude)]
+    if hasattr(feed, "reset"):
+        try:
+            feed.reset()
+        except Exception:
+            pass
+    calib = collect_stats(net, sites, feed, num_batches)
+    for *_, name in sites:
+        if not calib.seen(name):
+            continue
+        lo, hi = calib.minmax(name)
+        profiler.record_quant_range(name, lo, hi)
+        if logger:
+            t = (calib.absmax(name) if mode == "naive"
+                 else calib.threshold(name))
+            logger.info("calib %s: threshold=%.5g min=%.5g max=%.5g (%s)",
+                        name, t, lo, hi, mode)
+    return calib

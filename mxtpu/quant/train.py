@@ -1,0 +1,196 @@
+"""Quantized fused training step — fake-quant forward, straight-through grads.
+
+``MXTPU_QUANT_STEP=int8|fp8`` switches the :class:`StepExecutor` fused step
+into quantization-aware training: master weights, optimizer state, and every
+gradient stay float32, but each Dense/Conv forward matmul runs low-precision
+(int8 on the real MXU ``dot_general`` path with int32 accumulation; fp8 via
+fake-quantization). The backward pass is the STRAIGHT-THROUGH ESTIMATOR —
+gradients are computed as if the quantizer were the identity — which is the
+standard QAT recipe: the fp32 master weights keep integrating small updates
+the int8 grid couldn't represent, so loss stays convergent with fp32 (the
+tier-1 fits assert 3-epoch parity; rtol documented in docs/quantization.md).
+
+Plumbing: the mode is a component of the executor's trace signature (so
+flipping the env var retraces exactly once and the retrace sanitizer labels
+it "quant"), and :func:`quant_scope` installs the low-precision twins into
+``ops.nn``'s module-level hook points only around the traced call — eager
+ops, serving, and every other step cache are untouched.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import kv_quant
+
+__all__ = ["quant_step_mode", "quant_scope", "quant_dense", "quant_conv",
+           "fake_quant"]
+
+_STEP_MODES = ("int8", "fp8")
+_OFF = ("", "0", "off", "none", "fp32", "float32")
+
+
+def quant_step_mode(value=None) -> Optional[str]:
+    """Resolve the fused-step quantization mode: ``value`` if given, else
+    ``MXTPU_QUANT_STEP``. Returns None (fp32), 'int8', or 'fp8'; anything
+    else raises ``ValueError`` (never a silent fp32 fallback)."""
+    raw = os.environ.get("MXTPU_QUANT_STEP", "") if value is None else value
+    raw = str(raw).strip().lower()
+    if raw in _OFF:
+        return None
+    if raw not in _STEP_MODES:
+        raise ValueError(
+            f"MXTPU_QUANT_STEP={raw!r} (choose from {list(_STEP_MODES)}, "
+            "or unset for float32)")
+    if raw == "fp8" and "fp8" not in kv_quant.KV_MODES:
+        raise ValueError("MXTPU_QUANT_STEP=fp8 requires a jax with "
+                         "float8_e4m3fn")
+    return raw
+
+
+def fake_quant(x, mode: str, per_row: bool = False):
+    """Quantize-dequantize ``x`` through the ``mode`` grid in one shot —
+    the value actually seen by a fake-quant forward. ``per_row`` scales per
+    last-axis row (weights, per-output-channel after a (O, -1) reshape);
+    default is one per-tensor scale (activations)."""
+    if per_row:
+        q, s = kv_quant.quantize_rows(x, mode)
+        return kv_quant.dequantize_rows(q, s).astype(x.dtype)
+    dtype, qmax = kv_quant.KV_MODES[mode]
+    absmax = jnp.max(jnp.abs(x))
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0).astype(jnp.float32)
+    if mode == "int8":
+        q = jnp.clip(jnp.round(x / scale), -qmax, qmax).astype(dtype)
+    else:
+        q = (x / scale).astype(dtype)
+    return (q.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# dense: real int8 dot_general forward, straight-through backward
+# ---------------------------------------------------------------------------
+
+
+def _dense_fwd_impl(x, w, mode):
+    """``x (..., in) @ w (out, in).T`` low-precision. int8 runs the MXU
+    2x-peak path (int8 operands, int32 accumulation, per-row activation and
+    per-out-channel weight scales — same kernel shape as serve._int8_matmul);
+    fp8 fake-quantizes both operands and matmuls in fp32."""
+    if mode == "int8":
+        x2 = x.reshape(-1, x.shape[-1])
+        xq, xs = kv_quant.quantize_rows(x2, "int8")
+        wq, ws = kv_quant.quantize_rows(w, "int8")
+        acc = lax.dot_general(xq, wq, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * xs[:, None] * ws[None, :]
+        return y.reshape(x.shape[:-1] + (w.shape[0],)).astype(x.dtype)
+    return jnp.matmul(fake_quant(x, mode), fake_quant(w, mode, True).T)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ste_dense(x, w, mode):
+    return _dense_fwd_impl(x, w, mode)
+
+
+def _ste_dense_fwd(x, w, mode):
+    return _dense_fwd_impl(x, w, mode), (x, w)
+
+
+def _ste_dense_bwd(mode, res, g):
+    # straight-through: the grads of the UNQUANTIZED y = x @ w.T
+    x, w = res
+    dx = jnp.matmul(g, w)
+    lead = tuple(range(g.ndim - 1))
+    dw = jnp.tensordot(g, x, axes=(lead, lead))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_ste_dense.defvjp(_ste_dense_fwd, _ste_dense_bwd)
+
+
+def quant_dense(x, w, mode: str = "int8"):
+    """The ``ops.nn._fully_connected`` matmul twin (bias is added by the
+    caller in fp32). Records the staged site into ``get_quant_stats()`` —
+    the call fires at TRACE time, so the counter reads 'quantized matmul
+    sites compiled', not per-step dispatches."""
+    from .. import profiler
+    profiler.record_quant_matmuls(1)
+    return _ste_dense(x, w, mode)
+
+
+# ---------------------------------------------------------------------------
+# conv: fake-quant forward, fp32-vjp backward
+# ---------------------------------------------------------------------------
+
+
+def _conv_apply(x, w, cfg):
+    _, stride, padding, dilate, dn, groups = cfg
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=list(padding),
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+def _conv_fwd_impl(x, w, cfg):
+    mode = cfg[0]
+    O = w.shape[0]
+    wf = fake_quant(w.reshape(O, -1), mode, True).reshape(w.shape)
+    return _conv_apply(fake_quant(x, mode), wf, cfg)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ste_conv(x, w, cfg):
+    return _conv_fwd_impl(x, w, cfg)
+
+
+def _ste_conv_fwd(x, w, cfg):
+    return _conv_fwd_impl(x, w, cfg), (x, w)
+
+
+def _ste_conv_bwd(cfg, res, g):
+    # straight-through via the vjp of the fp32 conv at the UNQUANTIZED point
+    x, w = res
+    _, vjp = jax.vjp(lambda a, b: _conv_apply(a, b, cfg), x, w)
+    return vjp(g)
+
+
+_ste_conv.defvjp(_ste_conv_fwd, _ste_conv_bwd)
+
+
+def quant_conv(x, w, *, window_strides, padding, rhs_dilation,
+               dimension_numbers, feature_group_count, mode: str = "int8"):
+    """The ``ops.nn._convolution`` kernel twin. The conv geometry is folded
+    into one hashable nondiff cfg tuple so ``custom_vjp`` treats it as
+    static (``ConvDimensionNumbers`` is a namedtuple of tuples)."""
+    from .. import profiler
+    profiler.record_quant_matmuls(1)
+    cfg = (mode, tuple(window_strides), tuple(tuple(p) for p in padding),
+           tuple(rhs_dilation), dimension_numbers, int(feature_group_count))
+    return _ste_conv(x, w, cfg)
+
+
+@contextmanager
+def quant_scope(mode: Optional[str]):
+    """Install the low-precision Dense/Conv twins into ``ops.nn``'s hook
+    points for the duration of the block — the StepExecutor wraps exactly
+    its traced call in this, so the scope decides what gets STAGED; the
+    compiled program keeps its precision for life regardless of the hooks'
+    later state. No-op (and zero overhead) when ``mode`` is None."""
+    if not mode:
+        yield
+        return
+    from ..ops import nn as _nn
+    prev = (_nn._QUANT_DENSE, _nn._QUANT_CONV)
+    _nn._QUANT_DENSE = partial(quant_dense, mode=mode)
+    _nn._QUANT_CONV = partial(quant_conv, mode=mode)
+    try:
+        yield
+    finally:
+        _nn._QUANT_DENSE, _nn._QUANT_CONV = prev

@@ -18,12 +18,12 @@ methodology behind ``bench.py serving``.
 
 from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING,
                   DeadlineExceeded, QueueFullError, RequestCancelled,
-                  SamplingParams, ServingRequest)
+                  SamplingParams, ServingConfig, ServingRequest)
 from .chained import ChainedPredictor
 from .engine import ServingEngine, ServingHandoff
 from . import kv
 
 __all__ = ["ChainedPredictor", "ServingEngine", "ServingHandoff",
-           "ServingRequest", "SamplingParams",
+           "ServingRequest", "SamplingParams", "ServingConfig",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
            "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "kv"]

@@ -21,9 +21,9 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional
 
-__all__ = ["ServingRequest", "SamplingParams", "QueueFullError",
-           "RequestCancelled", "DeadlineExceeded", "PENDING", "RUNNING",
-           "DONE", "CANCELLED", "EXPIRED"]
+__all__ = ["ServingRequest", "SamplingParams", "ServingConfig",
+           "QueueFullError", "RequestCancelled", "DeadlineExceeded",
+           "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED"]
 
 PENDING = "pending"        # admitted to the queue, not yet prefilled
 RUNNING = "running"        # occupying a decode slot (or mid-prefill)
@@ -70,6 +70,29 @@ class SamplingParams:
             raise ValueError("temperature must be >= 0 (0 = greedy)")
         if self.seed < 0:
             raise ValueError("seed must be >= 0")
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Engine configuration as one value (``ServingEngine(config=...)``) —
+    the programmatic face of the ``MXTPU_SERVING_*`` env knobs, so a router
+    or test can declare a whole deployment without touching the process
+    environment. Resolution order per knob: explicit constructor kwarg >
+    this config > env var > default; ``None`` fields defer down the chain.
+
+    ``kv_dtype`` is the paged-KV storage dtype (e.g. ``'bfloat16'``; the
+    once-dead ``kv.empty_cache(dtype=...)`` parameter, now plumbed
+    end-to-end). ``quant`` selects low-precision execution — a
+    :class:`~mxtpu.quant.serve.QuantSpec` or a token string like
+    ``'int8_kv,int8_w'`` (see ``docs/quantization.md``)."""
+    slots: Optional[int] = None
+    queue_depth: Optional[int] = None
+    chunk: Optional[int] = None
+    prefill_chunk: Optional[int] = None
+    prefix_cache_mb: Optional[float] = None
+    stall_deadline_s: Optional[float] = None
+    kv_dtype: Optional[str] = None
+    quant: object = None
 
 
 class ServingRequest:

@@ -59,7 +59,12 @@ Knobs: ``MXTPU_SERVING_SLOTS`` (slot-batch capacity, default 4),
 ``MXTPU_SERVING_PREFILL_CHUNK`` (prefill positions per dispatch, default
 64), ``MXTPU_PREFIX_CACHE_MB`` (radix prefix-cache byte cap, default 64; 0
 disables), ``MXTPU_SERVING_LOG_S`` (per-interval engine log period, default
-off), ``MXTPU_SERVING_PROGRAM_CACHE`` (LRU bound on the program caches).
+off), ``MXTPU_SERVING_PROGRAM_CACHE`` (LRU bound on the program caches),
+``MXTPU_SERVING_KV_DTYPE`` (cache storage dtype, e.g. ``bfloat16``),
+``MXTPU_SERVING_QUANT`` (low-precision execution: ``int8_kv`` / ``fp8_kv``
+/ ``int8_w``, comma-separated — see ``docs/quantization.md``). All knobs
+are also settable programmatically via :class:`~mxtpu.serving.api
+.ServingConfig` / the constructor kwargs.
 """
 
 from __future__ import annotations
@@ -82,11 +87,12 @@ from ..ndarray.ndarray import NDArray
 from ..observability import tracer
 from ..resilience.elastic import elastic_watchdog
 from ..resilience.faults import fault_point
+from ..quant.serve import parse_quant, quantize_lm
 from ..resilience.watchdog import Watchdog, heartbeat
 from ..step_cache import ProgramCache
 from . import kv
 from .api import (CANCELLED, DONE, EXPIRED, RUNNING, QueueFullError,
-                  ServingRequest)
+                  ServingConfig, ServingRequest)
 
 __all__ = ["ServingEngine", "ServingHandoff"]
 
@@ -107,6 +113,10 @@ class ServingHandoff:
     #   adopt() resumes the SUFFIX prefill, never re-prefills from scratch
     pending: List[ServingRequest] = field(default_factory=list)  # admitted,
     #   never prefilled — re-staged verbatim by adopt()
+    kv_dtype: str = "float32"                 # page storage: 'float32' /
+    #   'bfloat16' / 'int8' / 'fp8' — adopt() refuses a mismatched engine
+    #   (quantized pages are QuantKV hosts; reinterpreting them as another
+    #   storage would corrupt every resumed request)
 
     @property
     def in_flight(self) -> int:
@@ -147,8 +157,33 @@ class ServingEngine:
                  chunk: Optional[int] = None,
                  stall_deadline_s: Optional[float] = None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_cache_mb: Optional[float] = None):
+                 prefix_cache_mb: Optional[float] = None,
+                 kv_dtype=None, quant=None,
+                 config: Optional[ServingConfig] = None):
+        if config is not None:
+            slots = slots or config.slots
+            queue_depth = queue_depth or config.queue_depth
+            chunk = chunk or config.chunk
+            prefill_chunk = prefill_chunk or config.prefill_chunk
+            if prefix_cache_mb is None:
+                prefix_cache_mb = config.prefix_cache_mb
+            if stall_deadline_s is None:
+                stall_deadline_s = config.stall_deadline_s
+            kv_dtype = kv_dtype or config.kv_dtype
+            if quant is None:
+                quant = config.quant
         self._model = model
+        # low-precision execution (mxtpu.quant): ONE spec per engine
+        # lifetime, resolved kwarg > config > env — the program caches stay
+        # keyed on (slots, bucket, chunk) because the spec never changes
+        if quant is None:
+            quant = os.environ.get("MXTPU_SERVING_QUANT") or None
+        self._quant = parse_quant(quant)
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("MXTPU_SERVING_KV_DTYPE") or None
+        self._kv_dtype = jnp.zeros((0,), kv_dtype or jnp.float32).dtype
+        # what get_serving_stats()/ServingHandoff report as the page storage
+        self._kv_dtype_str = self._quant.kv or self._kv_dtype.name
         self.slots = slots if slots else _env_int("MXTPU_SERVING_SLOTS", 4)
         self.queue_depth = queue_depth if queue_depth \
             else _env_int("MXTPU_SERVING_QUEUE", 16)
@@ -202,6 +237,7 @@ class ServingEngine:
                 return self
             self._materialize_params()
             profiler.record_serving("slots", self.slots)
+            profiler.record_serving("kv_dtype", self._kv_dtype_str)
             self._feed = DeviceFeed(self._staging_source(), depth=2)
             if self._stall_deadline_s:
                 self._wd = Watchdog(deadline_s=self._stall_deadline_s,
@@ -302,8 +338,9 @@ class ServingEngine:
                     entries.append({
                         "req": req,
                         # one slot row, host-landed: survives the old mesh
-                        "page": np.asarray(
-                            self._caches[:, :, slot:slot + 1]),
+                        # (quantized pages keep their data + scale leaves)
+                        "page": kv.host_page(
+                            kv.slot_page(self._caches, slot)),
                         "tok": int(self._tok[slot]),
                         "p": int(self._p[slot]),
                         "limit": int(self._limit[slot]),
@@ -327,7 +364,7 @@ class ServingEngine:
                     else:
                         partial.append({
                             "req": req,
-                            "page": np.asarray(pf["page"]),
+                            "page": kv.host_page(pf["page"]),
                             "t": pf["t"], "prev": pf["prev"],
                             "t0": pf["t0"], "PB": pf["PB"],
                             "left": pf["left"],
@@ -361,7 +398,8 @@ class ServingEngine:
         if self._wd is not None:
             self._wd.stop()
         handoff = ServingHandoff(tot=self._TOT or 0, entries=entries,
-                                 partial=partial, pending=pending)
+                                 partial=partial, pending=pending,
+                                 kv_dtype=self._kv_dtype_str)
         profiler.record_serving("drained", handoff.in_flight)
         tracer.instant("serving/drained", cat="serving",
                        args={"in_slots": len(entries),
@@ -388,13 +426,18 @@ class ServingEngine:
                     f"handoff carries {len(handoff.entries)} in-flight + "
                     f"{len(handoff.partial)} mid-prefill slots but this "
                     f"engine has {self.slots}")
+            if handoff.kv_dtype != self._kv_dtype_str:
+                raise ValueError(
+                    f"handoff pages are {handoff.kv_dtype} but this engine "
+                    f"stores KV as {self._kv_dtype_str} — adopt on an "
+                    "engine with the same kv_dtype/quant configuration")
             if handoff.entries or handoff.partial:
                 self._materialize_params()
             if handoff.entries:
                 self._ensure_capacity(handoff.tot)
                 for i, e in enumerate(handoff.entries):
                     self._caches = kv.merge_page(
-                        self._caches, jnp.asarray(e["page"]), i)
+                        self._caches, kv.device_page(e["page"]), i)
                     self._tok[i] = e["tok"]
                     self._p[i] = e["p"]
                     self._limit[i] = e["limit"]
@@ -413,7 +456,7 @@ class ServingEngine:
                 padded[0, :len(req.prompt)] = req.prompt
                 temp, topk, seed = _req_sampling(req)
                 self._pf = {"req": req, "prompt": jnp.asarray(padded),
-                            "page": jnp.asarray(e["page"]),
+                            "page": kv.device_page(e["page"]),
                             "t": e["t"], "prev": e["prev"],
                             "t0": e["t0"], "PB": e["PB"], "left": e["left"],
                             "slot": len(handoff.entries),
@@ -466,11 +509,12 @@ class ServingEngine:
             from .. import autograd
             with autograd.predict_mode():
                 self._model(NDArray(np.zeros((1, 1), np.int32)))
-        self._params = self._model._gen_params()
+        # identity pass-through on the fp32 path; int8 per-channel weights +
+        # scales under int8_w (one host-side pass, then everything is traced)
+        self._params = quantize_lm(self._model, self._quant)
         if self._prefix is None and self.prefix_cache_mb > 0:
-            L, H, D = kv.cache_dims(self._model)
-            block_bytes = (L * 2 * H * kv.PrefixCache.BLOCK * D
-                           * self._params["embed"].dtype.itemsize)
+            block_bytes = kv.block_nbytes(self._model, self._kv_dtype,
+                                          self._quant)
             self._prefix = kv.PrefixCache(block_bytes, self.prefix_cache_mb)
 
     def _run(self) -> None:
@@ -537,8 +581,7 @@ class ServingEngine:
         profiler.record_serving("admitted")
         profiler.record_serving("queue_wait_ms_last",
                                 (now - req.t_submit) * 1e3)
-        L, H, D = kv.cache_dims(self._model)
-        page = jnp.zeros((L, 2, 1, H, PB, D), self._params["embed"].dtype)
+        page = kv.empty_page(self._model, PB, self._kv_dtype, self._quant)
         m = 0
         # only FORCED prompt positions are reusable (limit = t0 - 1: the
         # last prompt position seeds the feedback chain and is recomputed)
@@ -547,9 +590,9 @@ class ServingEngine:
             m, blocks, path = self._prefix.match(req.prompt, t0 - 1)
             if m:
                 # COPY the cached rows into this request's page (functional
-                # .at[].set — the tree's rows are never aliased mutably)
-                page = page.at[..., :m, :].set(
-                    jnp.concatenate(blocks, axis=4))
+                # .at[].set — the tree's rows are never aliased mutably;
+                # quantized blocks install their bytes, never re-quantize)
+                page = kv.install_rows(page, blocks, m)
                 self._prefix.release(path)
                 profiler.record_serving("prefix_hits")
                 profiler.record_serving("prefix_hit_tokens", m)
@@ -588,7 +631,8 @@ class ServingEngine:
                                "chunk": csize, "bucket": pf["PB"]}):
             fn = self._prefill_fns.get_or_build(
                 (pf["PB"], csize),
-                lambda: kv.build_prefill_chunk(self._model, pf["PB"], csize))
+                lambda: kv.build_prefill_chunk(self._model, pf["PB"], csize,
+                                               quant=self._quant))
             page, outs = fn(
                 self._params, pf["page"], pf["prompt"],
                 jnp.int32(pf["t0"]), jnp.int32(start),
@@ -669,13 +713,18 @@ class ServingEngine:
     def _ensure_capacity(self, need: int) -> None:
         if self._TOT is None:
             self._TOT = need
-            self._caches = kv.empty_cache(self._model, self.slots, need)
+            self._caches = kv.empty_cache(self._model, self.slots, need,
+                                          self._kv_dtype, self._quant)
         elif need > self._TOT:
             with tracer.span("serving/kv_promote", cat="serving",
                              args={"from": self._TOT, "to": need}):
                 self._caches = kv.promote(self._caches, need)
             self._TOT = need
             profiler.record_serving("kv_promotions")
+        else:
+            return
+        profiler.record_serving("kv_bytes_resident",
+                                kv.cache_nbytes(self._caches))
 
     def _decode_chunk(self) -> None:
         n_active = int(self._active.sum())
@@ -683,7 +732,8 @@ class ServingEngine:
                          args={"active": n_active, "tot": self._TOT}):
             key = (self.slots, self._TOT, self.chunk)
             fn = self._decode_fns.get_or_build(
-                key, lambda: kv.build_decode(self._model, *key))
+                key, lambda: kv.build_decode(self._model, *key,
+                                             quant=self._quant))
             caches, tok, p, toks, lives = fn(
                 self._params, self._caches, jnp.asarray(self._tok),
                 jnp.asarray(self._p), jnp.asarray(self._active),
@@ -696,6 +746,12 @@ class ServingEngine:
         self._p = np.array(p)       # mutated at retire/admit boundaries
         now = time.monotonic()
         profiler.record_serving("decode_steps")
+        # re-assert per dispatch: these are assign-style stats, and callers
+        # commonly reset_serving_stats() after warmup (which wiped the values
+        # recorded at start()/cache creation)
+        profiler.record_serving("kv_dtype", self._kv_dtype_str)
+        profiler.record_serving("kv_bytes_resident",
+                                kv.cache_nbytes(self._caches))
         profiler.record_serving_occupancy(n_active, self.slots)
         for slot in np.flatnonzero(self._active):
             req = self._reqs[slot]

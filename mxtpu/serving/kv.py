@@ -51,8 +51,34 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["bucket32", "cache_dims", "empty_cache", "promote", "merge_page",
+from ..quant import kv_quant as qkv
+
+__all__ = ["bucket32", "cache_dims", "empty_cache", "empty_page", "promote",
+           "merge_page", "slot_page", "host_page", "device_page",
+           "install_rows", "cache_nbytes", "block_nbytes",
            "build_prefill_chunk", "build_decode", "PrefixCache"]
+
+
+def _kv_mode(quant) -> Optional[str]:
+    """KV storage mode of a quant selector: None, a bare mode string, or a
+    ``QuantSpec`` (whose ``.kv`` field may itself be None — weight-only
+    quantization keeps the cache at the working dtype)."""
+    if quant is None or isinstance(quant, str):
+        return quant
+    return getattr(quant, "kv", None)
+
+
+def _step_fn(model, S: int, TOT: int, quant):
+    """The decode-step builder both compiled programs share: the model's
+    own ``serving_step`` on the fp32 path, its quantized twin
+    (``mxtpu.quant.serve.build_step``) when a spec is active. Selected at
+    BUILD time — the engine holds one spec for life, so program-cache keys
+    stay (slots, bucket, chunk) exactly as before."""
+    if quant is not None and not isinstance(quant, str) \
+            and getattr(quant, "enabled", False):
+        from ..quant.serve import build_step
+        return build_step(model, S, TOT, quant)
+    return model.serving_step(S, TOT)
 
 
 def bucket32(n: int, max_len: int) -> int:
@@ -66,33 +92,72 @@ def cache_dims(model) -> Tuple[int, int, int]:
     return len(model.blocks), H, model._units // H
 
 
-def empty_cache(model, slots: int, TOT: int, dtype=jnp.float32):
+def empty_cache(model, slots: int, TOT: int, dtype=jnp.float32, quant=None):
+    """The engine cache: a ``dtype`` array, or a quantized
+    :class:`~mxtpu.quant.kv_quant.QuantKV` when ``quant`` selects a KV mode
+    (``dtype`` then only describes the working precision around it)."""
     L, H, D = cache_dims(model)
-    return jnp.zeros((L, 2, slots, H, TOT, D), dtype)
+    return qkv.empty((L, 2, slots, H, TOT, D), dtype, _kv_mode(quant))
+
+
+def empty_page(model, PB: int, dtype=jnp.float32, quant=None):
+    """A fresh B=1 prefill page ``(L, 2, 1, H, PB, D)`` matching the engine
+    cache's storage (same dtype/quant mode, so merge is a pure install)."""
+    L, H, D = cache_dims(model)
+    return qkv.empty_page(L, H, D, PB, dtype, _kv_mode(quant))
 
 
 def promote(caches, TOT_new: int):
     """Zero-pad the cache into a bigger TOT bucket (request outgrew its
     page). Positions past the old TOT are unwritten by definition, so the
     pad is content-preserving; per-slot state (p/limit/tok) is untouched."""
-    L, two, S, H, TOT_old, D = caches.shape
-    if TOT_new <= TOT_old:
-        return caches
-    return jnp.zeros((L, two, S, H, TOT_new, D), caches.dtype) \
-        .at[..., :TOT_old, :].set(caches)
+    return qkv.promote(caches, TOT_new)
 
 
 def merge_page(caches, page, slot: int):
     """Install a prefilled ``(L, 2, 1, H, PB, D)`` page as slot row ``slot``
     of the engine cache (zeroing the row's tail past PB — stale K/V from
     the slot's previous tenant must not survive admission)."""
-    PB = page.shape[4]
-    row = jnp.zeros(caches.shape[:2] + caches.shape[3:], caches.dtype) \
-        .at[..., :PB, :].set(page[:, :, 0])
-    return caches.at[:, :, slot].set(row)
+    return qkv.merge_page(caches, page, slot)
 
 
-def build_prefill_chunk(model, PB: int, csize: int):
+def slot_page(caches, slot: int):
+    """One slot's ``(L, 2, 1, H, TOT, D)`` page view — the drain() unit."""
+    return qkv.slot_page(caches, slot)
+
+
+def host_page(page):
+    """Host-land a page (numpy leaves; quantized pages keep data + scale)
+    for a mesh-independent ``ServingHandoff``."""
+    return qkv.to_host(page)
+
+
+def device_page(page):
+    return qkv.to_device(page)
+
+
+def install_rows(page, blocks, m: int):
+    """Seed a page's first ``m`` token rows from cached prefix blocks
+    (quantized blocks install bit-identical bytes — a shared prefix never
+    pays a second quantization)."""
+    return qkv.install_rows(page, blocks, m)
+
+
+def cache_nbytes(caches) -> int:
+    """Resident bytes of the cache (data + scales when quantized) — the
+    ``kv_bytes_resident`` serving stat."""
+    return qkv.cache_nbytes(caches)
+
+
+def block_nbytes(model, dtype=jnp.float32, quant=None) -> int:
+    """Bytes of one 32-token :class:`PrefixCache` block for this model at
+    this cache storage (the prefix-cache byte-cap accounting)."""
+    L, H, D = cache_dims(model)
+    return qkv.page_nbytes(L, H, D, PrefixCache.BLOCK, dtype,
+                           _kv_mode(quant))
+
+
+def build_prefill_chunk(model, PB: int, csize: int, quant=None):
     """One compiled B=1 prefill CHUNK program for (prompt bucket ``PB``,
     chunk size ``csize``): scans :meth:`serving_step` over positions
     ``start .. start+csize-1``, forcing prompt tokens while ``t < t0`` and
@@ -112,8 +177,11 @@ def build_prefill_chunk(model, PB: int, csize: int):
     the engine seeds ``page`` with the cached rows and starts the cursor at
     the matched length — only the suffix is ever scanned. Greedy decoding
     is ``temp == 0`` (bit-exact argmax); sampling params are traced, so a
-    sampled and a greedy request share this one program."""
-    step = model.serving_step(1, PB)
+    sampled and a greedy request share this one program. ``quant`` (a
+    :class:`~mxtpu.quant.serve.QuantSpec`) swaps in the quantized step —
+    the page is then a :class:`QuantKV` and ``params`` come from
+    ``quantize_lm``; the scan/carry structure is identical."""
+    step = _step_fn(model, 1, PB, quant)
     sample = model.serving_sample()
 
     def run(params, page, prompt, t0, start, prev, temp, topk, seed):
@@ -133,7 +201,7 @@ def build_prefill_chunk(model, PB: int, csize: int):
     return jax.jit(run)
 
 
-def build_decode(model, S: int, TOT: int, chunk: int):
+def build_decode(model, S: int, TOT: int, chunk: int, quant=None):
     """One compiled continuous-batching decode program for (slots ``S``,
     KV bucket ``TOT``): ``chunk`` decode steps over the slot batch with all
     per-slot state — token, position, active flag, live limit, and the
@@ -151,8 +219,9 @@ def build_decode(model, S: int, TOT: int, chunk: int):
     bit-exact with solo ``generate`` regardless of what its neighbors
     sample; ``temp > 0`` samples with a key derived from (seed, position),
     so a request's stream is deterministic per seed no matter how it was
-    scheduled."""
-    step = model.serving_step(S, TOT)
+    scheduled. ``quant`` swaps in the quantized step (``caches`` is then a
+    :class:`QuantKV` pytree riding the same scan carry)."""
+    step = _step_fn(model, S, TOT, quant)
     sample = model.serving_sample()
 
     def run(params, caches, tok, p, active, limit, temp, topk, seed):
@@ -256,7 +325,7 @@ class PrefixCache:
             nxt = path + tuple(tokens[m:m + self.BLOCK])
             node = self._nodes.get(nxt)
             if node is None:
-                node = {"kv": page[..., m:m + self.BLOCK, :],
+                node = {"kv": qkv.block_slice(page, m, self.BLOCK),
                         "refs": 0, "children": 0}
                 self._nodes[nxt] = node
                 if path:

@@ -238,7 +238,19 @@ def build_update_all(opt, lr_mults: Sequence[float], wd_mults: Sequence[float],
 # component names of the StepExecutor._sig tuple, in order — the retrace
 # sanitizer uses them to label its signature diff ("params[0].dtype changed")
 _SIG_LABELS = ("data", "label", "params", "aux", "opt_states", "grad_req",
-               "opt_hyperparams", "zero")
+               "opt_hyperparams", "zero", "quant")
+
+
+def quant_step_mode():
+    # lazy: mxtpu.quant.train imports ops.nn, which must finish registering
+    # before quant resolves — deferring breaks the import cycle
+    from .quant.train import quant_step_mode as _mode
+    return _mode()
+
+
+def quant_scope(mode):
+    from .quant.train import quant_scope as _scope
+    return _scope(mode)
 
 
 def _sharding_of(raw):
@@ -569,6 +581,7 @@ class StepExecutor:
             tuple(p.grad_req for p in self._param_handles),
             optimizer_fingerprint(tr._optimizer),
             zero_sig,
+            quant_step_mode(),   # MXTPU_QUANT_STEP: flipping modes retraces
         )
 
     # -- tracing -----------------------------------------------------------
@@ -800,7 +813,11 @@ class StepExecutor:
                                "signature":
                                f"{hash(sig) & 0xffffffffffffffff:016x}"}
                          if traced_now else {"cache": self._cache_name})
-        with sp, sanitize.step_guard(san, traced_now, where=self._cache_name):
+        with sp, sanitize.step_guard(san, traced_now, where=self._cache_name), \
+                quant_scope(sig[-1]):
+            # quant_scope swaps the dense/conv contraction for the fake-quant
+            # STE path while THIS signature's program traces (no-op when the
+            # mode is off or the program is already compiled)
             out = entry["jitted"](*step_args)
         (new_params, new_aux, new_states, new_zstates, new_zres, grads,
          loss_arr, raw_outs, exposed0) = out

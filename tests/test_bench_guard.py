@@ -49,7 +49,7 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     # every fallback scenario must keep emitting its keys
     assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
             "compile_caches", "mfu", "trace", "fsdp", "serving",
-            "elastic", "ratchet"} <= set(doc)
+            "elastic", "quant", "ratchet"} <= set(doc)
     # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
     # mid-epoch crash survived by a supervised restart, final params equal
     # to the fault-free baseline
@@ -118,6 +118,26 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     # TTFT decomposition keys shipped by the engine stats
     assert serving["ttft_queue_wait_ms_mean"] >= 0
     assert serving["ttft_prefill_ms_mean"] > 0
+    # quant leg (ISSUE 14): int8 paged-KV shrank resident KV >= 1.9x at the
+    # same slot count, greedy decode stayed token-exact, the quantized fused
+    # step trained, and both headline ratios ride the ratchet
+    quant = doc["quant"]
+    assert "error" not in quant, quant
+    assert quant["kv_bytes_shrink"] >= 1.9
+    assert quant["int8_kv"]["decode_match"] == quant["requests"]
+    assert quant["int8_kv"]["kv_dtype"] == "int8"
+    assert quant["fp32"]["kv_dtype"] == "float32"
+    assert quant["int8_kv"]["kv_bytes_resident"] \
+        < quant["fp32"]["kv_bytes_resident"]
+    assert quant["resident_slots_at_fp32_budget"]["int8_kv"] \
+        > quant["resident_slots_at_fp32_budget"]["fp32"]
+    assert quant["train_step_ms_int8"] > 0
+    assert quant["train_loss_end_int8"] == pytest.approx(
+        quant["train_loss_end_fp32"], rel=0.05)
+    assert doc["ratchet"]["current"]["kv_bytes_shrink"] \
+        == quant["kv_bytes_shrink"]
+    assert doc["ratchet"]["current"]["quant_decode_speedup"] \
+        == quant["quant_decode_speedup"]
     # elastic leg (ISSUE 11): one live in-place dp shrink mid-fit — no
     # restart, no steps lost, bit-exact with a cold resume — and a serving
     # drain/adopt handoff that dropped nothing
@@ -167,13 +187,17 @@ def test_bench_leg_failure_yields_partial_json(tmp_path):
     doc, p = _run_fallback_bench(tmp_path, extra_env={
         # input_pipeline: fails every attempt → retries exhaust → error leg
         # zero_dp: fails once → the transient retry policy must recover it
-        "MXTPU_BENCH_FAIL_LEG": "input_pipeline,zero_dp:1",
+        # quant: fails every attempt too — a second exhausted leg, and it
+        # keeps this scenario fast (the quant leg is benched for real by
+        # the fallback test above and the quant CLI scenario)
+        "MXTPU_BENCH_FAIL_LEG": "input_pipeline,quant,zero_dp:1",
         "MXTPU_BENCH_RETRY_BACKOFF_S": "0.01",
         "MXTPU_RETRY_BACKOFF_MAX_S": "0.05",
     })
     assert "error" in doc["input_pipeline"]
     assert "UNAVAILABLE" in doc["input_pipeline"]["error"]
     assert doc["input_pipeline"]["retried"] is True
+    assert "error" in doc["quant"]
     # the retried leg recovered — full payload, no error key
     assert "error" not in doc["zero_dp"]
     assert doc["zero_dp"]["zero1"]["step_ms"] > 0
@@ -234,6 +258,29 @@ def test_bench_elastic_scenario_cli(tmp_path):
     assert elastic["params_match_cold_resume"] is True
     assert elastic["serving"]["requests_dropped"] == 0
     assert elastic["serving"]["decode_match"] is True
+
+
+@pytest.mark.slow        # the fallback test above already runs the quant leg
+def test_bench_quant_scenario_cli(tmp_path):
+    """``bench.py quant`` (ISSUE 14): the quant-only CLI path must exit 0
+    and emit a single quant JSON doc — fp32 vs int8-KV vs int8-KV+int8-W
+    serving, the >= 1.9x KV shrink, token-exact int8-KV greedy decode, and
+    the quantized fused-step timing, with both ratios on the ratchet."""
+    doc, _ = _run_fallback_bench(tmp_path, args=("quant",))
+    assert doc["metric"] == "kv_bytes_shrink"
+    assert doc["value"] >= 1.9
+    quant = doc["quant"]
+    assert quant["int8_kv"]["decode_match"] == quant["requests"]
+    assert quant["int8_kv_int8_w"]["decode_steps"] > 0
+    assert 0 <= quant["weight_leg_token_agreement"] <= 1
+    assert quant["quant_decode_speedup"] > 0
+    assert quant["kv_block_shrink"] == pytest.approx(
+        quant["kv_bytes_shrink"], rel=0.01)
+    assert quant["quant_matmul_sites"] > 0
+    cur = doc["ratchet"]["current"]
+    assert cur["kv_bytes_shrink"] == quant["kv_bytes_shrink"]
+    assert cur["quant_decode_speedup"] == quant["quant_decode_speedup"]
+    assert doc["ratchet"]["harness"] == "quant-smoke"
 
 
 def test_bench_sanitized_leg_exits_zero_with_no_violations(tmp_path):
