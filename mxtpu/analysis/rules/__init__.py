@@ -6,8 +6,10 @@ this repo's real bug history (see ``docs/static_analysis.md`` for the
 catalog and the PR 2 / PR 4 incidents each one would have caught).
 """
 
-from . import host_sync, donation, nondeterminism, thread_shared, excepts
+from . import (host_sync, donation, nondeterminism, thread_shared, excepts,
+               span_leak)
 
-RULES = [host_sync, donation, nondeterminism, thread_shared, excepts]
+RULES = [host_sync, donation, nondeterminism, thread_shared, excepts,
+         span_leak]
 
 __all__ = ["RULES"]

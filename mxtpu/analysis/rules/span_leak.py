@@ -1,0 +1,106 @@
+"""R006 span-leak: ``tracer.span(...)`` opened and never closed.
+
+``tracer.span`` returns a context manager; the duration event is only
+recorded when the span EXITS. A bare call (``tracer.span("step/x")`` as a
+statement) silently records nothing — worse, the reader assumes the region
+is timed, so the gap in the trace gets misdiagnosed as idle time. The
+telemetry-plane work made spans the backbone of request timelines and
+flight-recorder bundles, which is exactly when a leaked span turns into a
+missing forensic record.
+
+Blessed patterns (not flagged):
+
+* ``with tracer.span(...):`` — the normal form;
+* returning/yielding the span (ownership handed to the caller);
+* passing it straight into another call (``stack.enter_context(...)``);
+* binding it to a name that the enclosing scope later uses as a context
+  manager, calls ``__enter__``/``close``/``__exit__`` on, or passes on.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, dotted_name
+
+RULE_ID = "R006"
+TITLE = "span-leak"
+
+# qualifiers that make a ``.span(...)`` call the tracer's (vs some other
+# object's unrelated ``span`` method)
+_QUALS = ("tracer", "observability", "profiler")
+
+
+def _is_span_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if parts[-1] != "span":
+        return False
+    if len(parts) == 1:
+        return True          # bare span() — from-import of tracer.span
+    return any(q in seg for seg in parts[:-1] for q in _QUALS)
+
+
+def _name_is_closed(scope: ast.AST, var: str, after_line: int) -> bool:
+    """Does ``scope`` ever treat ``var`` as a managed/closed span after the
+    binding line? (with-statement, __enter__/__exit__/close, or passing the
+    span onward — e.g. into ``ExitStack.enter_context``)."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.withitem):
+            c = n.context_expr
+            if isinstance(c, ast.Name) and c.id == var:
+                return True
+        elif isinstance(n, ast.Attribute) and n.attr in (
+                "__enter__", "__exit__", "close"):
+            v = n.value
+            if isinstance(v, ast.Name) and v.id == var:
+                return True
+        elif isinstance(n, ast.Call):
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == var \
+                        and getattr(n, "lineno", 0) >= after_line:
+                    return True
+        elif isinstance(n, (ast.Return, ast.Yield)) and n.value is not None:
+            if isinstance(n.value, ast.Name) and n.value.id == var:
+                return True
+    return False
+
+
+def _blessed(ctx, call: ast.Call) -> bool:
+    parent = ctx.parent(call)
+    if isinstance(parent, ast.withitem):
+        return True
+    if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom, ast.Await)):
+        return True
+    if isinstance(parent, ast.Call):
+        # the span value flows into another call (enter_context and kin)
+        return True
+    if isinstance(parent, ast.Attribute) and parent.attr in (
+            "__enter__", "close"):
+        return True   # tracer.span(...).__enter__() — explicit management
+    if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+        targets = parent.targets if isinstance(parent, ast.Assign) \
+            else [parent.target]
+        scope = ctx.enclosing_scope(call)
+        for t in targets:
+            if isinstance(t, ast.Name) \
+                    and _name_is_closed(scope, t.id, call.lineno):
+                return True
+        return False
+    return False
+
+
+def check(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not _is_span_call(node):
+            continue
+        if _blessed(ctx, node):
+            continue
+        yield Finding(
+            ctx.path, node.lineno, node.col_offset, RULE_ID,
+            f"{TITLE}: tracer.span(...) opened without `with` (or explicit "
+            f"close) — the duration event is recorded on exit, so this span "
+            f"never lands in the trace; use `with tracer.span(...):` or "
+            f"hand the span to an ExitStack")

@@ -458,6 +458,9 @@ class CheckpointManager:
 
         def _handler(signum, frame):
             try:
+                from ..observability import flight
+                flight.record("sigterm_drain", signum=int(signum))
+                flight.dump("sigterm_drain", extra={"signum": int(signum)})
                 try:
                     self._raise_pending_error()
                 except BaseException as e:

@@ -11,32 +11,60 @@ DeviceFeed producer, the ZeRO comm path, the async checkpoint writer — into
   near-zero cost when off; ``MXTPU_TRACE=1`` or ``profiler.set_state('run')``
   arms it; spans mirror into ``jax.profiler.TraceAnnotation``).
 * :mod:`.export` — chrome-trace JSON serialization (pid/tid rows per thread,
-  metadata names, the ``profiler.dump()``/``dumps()`` body).
+  metadata names, per-request swim-lanes, the ``profiler.dump()``/
+  ``dumps()`` body, ``request_timeline``).
 * :mod:`.flops` — MFU accounting (XLA cost-analysis FLOPs with an analytic
   conv/matmul fallback, bounded step-time ring → steps/s + p50/p99 + MFU).
 * :mod:`.metrics` — the subsystem counter stores (checkpoint / feed / comm /
   sanitizer), moved here from ``profiler.py``; the profiler re-exports them.
+* :mod:`.histogram` — bounded log-bucketed streaming histograms backing the
+  serving latency percentiles (TTFT/queue-wait/prefill/first-decode/
+  per-token) and fused-step times.
+* :mod:`.exporter` — pull-based Prometheus/JSON metrics endpoint
+  (``MXTPU_METRICS_PORT``; off by default).
+* :mod:`.flight` — always-on crash flight recorder; postmortem bundles to
+  ``MXTPU_FLIGHT_DIR`` on stalls, resize failures, scheduler-thread
+  exceptions, and SIGTERM drains.
 
 ``mxtpu.profiler`` remains the user-facing facade — importing this package
 directly is for framework internals and tests.
 
 Span catalog (see docs/observability.md):
 
-====================  =======================================================
-``step/compile``      trace+lower+compile of a fused step (args: signature)
-``step/execute``      one cache-hit fused-step dispatch
-``feed/transfer``     DeviceFeed producer staging one batch host→device
-``feed/stall``        consumer blocked waiting on the feed queue
-``comm/exchange``     cross-process collective (``_process_exchange``)
-``ckpt/snapshot``     device→host state capture (training thread)
-``ckpt/write``        serialize+fsync of one step (writer thread)
-``ckpt/commit``       atomic rename+COMMIT marker (writer thread)
-``feed/queue_depth``  counter: prefetch queue occupancy
-====================  =======================================================
+==========================  =================================================
+``step/compile``            trace+lower+compile of a fused step
+``step/execute``            one cache-hit fused-step dispatch
+``feed/transfer``           DeviceFeed producer staging one batch
+``feed/stall``              consumer blocked waiting on the feed queue
+``comm/exchange``           cross-process collective (``_process_exchange``)
+``ckpt/snapshot``           device→host state capture (training thread)
+``ckpt/write``              serialize+fsync of one step (writer thread)
+``ckpt/commit``             atomic rename+COMMIT marker (writer thread)
+``feed/queue_depth``        counter: prefetch queue occupancy
+``serving/submit``          instant: request enqueued (args: id)
+``serving/admit``           instant: request admitted to a slot (args: id)
+``serving/prefix_hit``      instant: radix prefix-cache hit (args: id)
+``serving/prefix_miss``     instant: probe found nothing (args: id)
+``serving/prefill_chunk``   one chunked-prefill dispatch (args: id)
+``serving/first_token``     instant: first generated token (args: id)
+``serving/decode``          one slot-batch decode dispatch (args: ids)
+``serving/first_decode``    instant: slot's first decode emission (args: id)
+``serving/retire``          instant: request left its slot (args: id)
+``serving/drain_freeze``    instant: request frozen into a handoff (args: id)
+``serving/adopt_resume``    instant: request resumed from a handoff (id)
+``serving/drained``         instant: handoff complete (args: ids)
+``serving/adopted``         instant: adoption complete (args: ids)
+==========================  =================================================
 """
 
-from . import export, flops, metrics, tracer
+from . import (exporter, export, flight, flops, histogram, metrics,  # noqa
+               tracer)
 from .tracer import counter, enabled, instant, span
 
-__all__ = ["tracer", "export", "flops", "metrics",
+__all__ = ["tracer", "export", "flops", "metrics", "histogram",
+           "exporter", "flight",
            "span", "instant", "counter", "enabled"]
+
+# MXTPU_METRICS_PORT arms the scrape endpoint at import, mirroring how
+# MXTPU_TRACE arms the tracer — off (no socket) when unset
+exporter._maybe_start_from_env()

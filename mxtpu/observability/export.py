@@ -22,10 +22,15 @@ from typing import List, Optional
 from . import tracer
 
 __all__ = ["collect_events", "chrome_trace", "write_chrome_trace",
-           "aggregate", "REQUIRED_SPAN_KEYS"]
+           "aggregate", "request_timeline", "request_lane_events",
+           "REQUIRED_SPAN_KEYS", "REQUEST_LANE_PID"]
 
 # the schema contract tests validate exported "X" events against
 REQUIRED_SPAN_KEYS = ("name", "ph", "ts", "dur", "pid", "tid")
+
+# synthetic pid for the per-request lane rows (one tid per request id) —
+# far above any real pid so the viewer groups them as their own process
+REQUEST_LANE_PID = 1 << 22
 
 
 def collect_events(legacy_events: Optional[List[dict]] = None) -> List[dict]:
@@ -58,13 +63,73 @@ def collect_events(legacy_events: Optional[List[dict]] = None) -> List[dict]:
     return events
 
 
+def _event_request_ids(ev: dict):
+    """Request ids an event is tagged with: the serving spans carry
+    ``args.id`` (one request) or ``args.ids`` (a decode dispatch over the
+    whole slot batch)."""
+    args = ev.get("args")
+    if not isinstance(args, dict):
+        return ()
+    rid = args.get("id")
+    ids = args.get("ids")
+    if rid is not None and not isinstance(ids, (list, tuple)):
+        return (rid,)
+    if rid is not None:
+        return (rid, *ids)
+    return tuple(ids) if isinstance(ids, (list, tuple)) else ()
+
+
+def request_timeline(rid: int,
+                     events: Optional[List[dict]] = None) -> List[dict]:
+    """Every recorded event tagged with request ``rid``, time-sorted — one
+    request's full life (submit → admission → prefill chunks → decode
+    dispatches → retire, including the drain/adopt markers when the request
+    crossed an engine handoff). ``ServingEngine.request_timeline`` is the
+    public face."""
+    if events is None:
+        events = collect_events()
+    out = [e for e in events if rid in _event_request_ids(e)]
+    out.sort(key=lambda e: e.get("ts", 0))
+    return out
+
+
+def request_lane_events(events: List[dict]) -> List[dict]:
+    """Synthetic per-request chrome-trace lanes: every request-tagged event
+    duplicated under ``pid = REQUEST_LANE_PID`` with ``tid = request id``,
+    plus naming metadata — so the viewer shows one swim-lane per request
+    alongside the real thread rows (a decode span over N active slots lands
+    in all N lanes)."""
+    lanes: List[dict] = []
+    seen: set = set()
+    for ev in events:
+        for rid in _event_request_ids(ev):
+            if rid not in seen:
+                seen.add(rid)
+                lanes.append({"ph": "M", "name": "thread_name",
+                              "pid": REQUEST_LANE_PID, "tid": rid,
+                              "args": {"name": f"request {rid}"}})
+            e = dict(ev)
+            e["pid"] = REQUEST_LANE_PID
+            e["tid"] = rid
+            lanes.append(e)
+    if seen:
+        lanes.insert(0, {"ph": "M", "name": "process_name",
+                         "pid": REQUEST_LANE_PID, "tid": 0,
+                         "args": {"name": "mxtpu-requests"}})
+    return lanes
+
+
 def chrome_trace(legacy_events: Optional[List[dict]] = None,
                  xplane_dir: Optional[str] = None,
-                 events: Optional[List[dict]] = None) -> dict:
+                 events: Optional[List[dict]] = None,
+                 request_lanes: bool = False) -> dict:
     """The full dump payload. ``events`` short-circuits collection (used by
-    the profiler's frozen final snapshot)."""
+    the profiler's frozen final snapshot); ``request_lanes=True`` appends
+    the synthetic per-request swim-lanes (flight-recorder bundles use it)."""
     if events is None:
         events = collect_events(legacy_events)
+    if request_lanes:
+        events = list(events) + request_lane_events(events)
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}
     if xplane_dir:
         # the paired XLA device trace (jax.profiler XPlane dir, open in

@@ -1,0 +1,205 @@
+"""Crash flight recorder — always-on bounded rings + a postmortem bundle.
+
+The tracer answers "what is this process doing *right now*" only while
+someone is watching. The flight recorder answers the question that actually
+gets asked in production: "it just died / stalled at 3am — what was it doing
+*right before that*?" It keeps three bounded, always-on rings (costing a few
+dict appends per event, nothing on the step hot path):
+
+* **events** — :func:`record` notes from the crash-adjacent code paths
+  (watchdog stall reports, resize failures, scheduler-thread exceptions,
+  SIGTERM drains), capped at ``MXTPU_FLIGHT_EVENTS`` (default 256);
+* **requests** — :func:`note_request` one-line summaries of the last N
+  finished serving requests (``MXTPU_FLIGHT_REQUESTS``, default 32), written
+  by ``ServingRequest._finish`` at the single terminal transition;
+* **counters** — a baseline of the cumulative stats stores taken at import
+  (and each :func:`dump`), so a bundle shows *deltas over the crash window*,
+  not lifetime totals.
+
+:func:`dump` writes a bundle directory ``flight-<reason>-<pid>-<seq>/``
+containing ``trace.json`` (the chrome trace with per-request lanes — open in
+Perfetto) and ``stats.json`` (reason, rings, counter deltas, and a full
+stats snapshot). The rings are always on; **disk writes are opt-in** via
+``MXTPU_FLIGHT_DIR`` (or an explicit ``out_dir``) — with neither set,
+``dump`` returns ``None`` and touches nothing. Every step of the dump path
+is exception-guarded: the crash handler must never crash the crash.
+
+Wired dump sites: ``Watchdog._handle_stall`` (reason ``"stall"``),
+``ElasticMesh.resize_now`` failure paths (``"resize_error"``), the serving
+scheduler thread's exception latch (``"scheduler_error"``), and the SIGTERM
+preemption handler (``"sigterm_drain"``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+__all__ = ["record", "note_request", "dump", "load", "reset",
+           "snapshot_rings", "ENV_DIR", "ENV_EVENTS", "ENV_REQUESTS"]
+
+ENV_DIR = "MXTPU_FLIGHT_DIR"
+ENV_EVENTS = "MXTPU_FLIGHT_EVENTS"
+ENV_REQUESTS = "MXTPU_FLIGHT_REQUESTS"
+
+_log = logging.getLogger("mxtpu.observability")
+
+
+def _cap(env: str, default: int) -> int:
+    try:
+        return max(8, int(os.environ.get(env, str(default))))
+    except ValueError:
+        return default
+
+
+_lock = threading.Lock()
+_events: "deque" = deque(maxlen=_cap(ENV_EVENTS, 256))
+_requests: "deque" = deque(maxlen=_cap(ENV_REQUESTS, 32))
+_baseline: dict = {}          # cumulative counters at the window start
+_seq = itertools.count()
+
+
+# counters worth delta-ing across a crash window (cumulative stores only —
+# gauges like occupancy delta to noise)
+_COUNTER_STORES = ("serving", "resilience", "comm", "feed", "checkpoint",
+                   "quant")
+
+
+def _counters() -> dict:
+    from . import metrics
+    out = {}
+    for store in _COUNTER_STORES:
+        try:
+            block = getattr(metrics, f"get_{store}_stats")()
+        except Exception:
+            continue
+        out[store] = {k: v for k, v in block.items()
+                      if isinstance(v, (int, float))
+                      and not isinstance(v, bool)}
+    return out
+
+
+def _rebaseline() -> None:
+    global _baseline
+    try:
+        _baseline = _counters()
+    except Exception:
+        _baseline = {}
+
+
+_rebaseline()
+
+
+def record(kind: str, **args) -> None:
+    """One crash-context breadcrumb into the bounded event ring (always on;
+    never raises)."""
+    try:
+        with _lock:
+            _events.append({"ts": time.time(), "kind": str(kind),
+                            "args": args})
+    except Exception:
+        pass
+
+
+def note_request(info: dict) -> None:
+    """One finished request's summary into the last-N ring (called from the
+    ``ServingRequest`` terminal transition; never raises)."""
+    try:
+        with _lock:
+            _requests.append(dict(info))
+    except Exception:
+        pass
+
+
+def snapshot_rings() -> dict:
+    with _lock:
+        return {"events": list(_events), "requests": list(_requests)}
+
+
+def _counter_deltas(now: dict) -> dict:
+    deltas: dict = {}
+    for store, block in now.items():
+        base = _baseline.get(store, {})
+        d = {}
+        for k, v in block.items():
+            dv = v - base.get(k, 0)
+            if dv:
+                d[k] = round(dv, 6) if isinstance(dv, float) else dv
+        if d:
+            deltas[store] = d
+    return deltas
+
+
+def dump(reason: str, extra: Optional[dict] = None,
+         out_dir: Optional[str] = None) -> Optional[str]:
+    """Write one postmortem bundle; returns its directory path, or ``None``
+    when disk writes are not armed (neither ``out_dir`` nor
+    ``MXTPU_FLIGHT_DIR``). Exception-guarded end to end — a failed dump logs
+    and returns ``None`` rather than propagating into the crash path that
+    triggered it."""
+    try:
+        target = out_dir or os.environ.get(ENV_DIR, "")
+        if not target:
+            return None
+        bundle = os.path.join(
+            target, f"flight-{reason}-{os.getpid()}-{next(_seq)}")
+        os.makedirs(bundle, exist_ok=True)
+
+        stats: dict = {"reason": reason, "ts": time.time(),
+                       "pid": os.getpid(), "extra": extra or {}}
+        stats.update(snapshot_rings())
+        try:
+            from . import exporter
+            now = _counters()
+            stats["counter_deltas"] = _counter_deltas(now)
+            stats["stats"] = exporter.collect_snapshot()
+        except Exception as e:
+            stats["stats_error"] = f"{type(e).__name__}: {e}"
+        try:
+            from . import export
+            export.write_chrome_trace(
+                os.path.join(bundle, "trace.json"),
+                export.chrome_trace(request_lanes=True))
+        except Exception as e:
+            stats["trace_error"] = f"{type(e).__name__}: {e}"
+
+        tmp = os.path.join(bundle, f".stats.tmp-{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(stats, f, default=str)
+        os.replace(tmp, os.path.join(bundle, "stats.json"))
+        _rebaseline()   # next bundle's deltas start from this window's end
+        _log.error("flight recorder: wrote %s bundle to %s", reason, bundle)
+        return bundle
+    except Exception as e:
+        try:
+            _log.error("flight recorder dump failed: %s", e)
+        except Exception:
+            pass
+        return None
+
+
+def load(path: str) -> dict:
+    """Load a bundle back: ``{"stats": ..., "trace": ...}`` (triage tooling
+    and the tier-1 flight test)."""
+    out: dict = {}
+    with open(os.path.join(path, "stats.json")) as f:
+        out["stats"] = json.load(f)
+    trace_path = os.path.join(path, "trace.json")
+    if os.path.exists(trace_path):
+        with open(trace_path) as f:
+            out["trace"] = json.load(f)
+    return out
+
+
+def reset() -> None:
+    """Clear the rings and re-baseline the counters (tests)."""
+    with _lock:
+        _events.clear()
+        _requests.clear()
+    _rebaseline()

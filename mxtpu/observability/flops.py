@@ -34,6 +34,8 @@ import threading
 from collections import deque
 from typing import Optional, Tuple
 
+from . import histogram
+
 __all__ = ["device_peak", "estimate_step_flops", "jaxpr_flops",
            "record_step", "set_step_flops", "get_step_flops",
            "get_mfu_stats", "reset_steps", "step_count", "PEAK_TFLOPS"]
@@ -179,10 +181,14 @@ _state = {"flops_per_step": None, "total_steps": 0}
 
 def record_step(seconds: float, flops: Optional[float] = None):
     """One training step's wall time (and, optionally, its FLOP count — when
-    omitted the last :func:`set_step_flops` value applies at read time)."""
+    omitted the last :func:`set_step_flops` value applies at read time).
+    Also lands in the bounded ``step/fused_step_ms`` log-bucket histogram
+    (``observability.histogram``) so fused-step tails survive past the
+    ring's window and export alongside the serving latency series."""
     with _ring_lock:
         _ring.append((float(seconds), flops))
         _state["total_steps"] += 1
+    histogram.record_value("step/fused_step_ms", float(seconds) * 1e3)
 
 
 def set_step_flops(flops: Optional[float]):
@@ -203,10 +209,12 @@ def step_count() -> int:
 
 
 def reset_steps():
-    """Clear the ring (epoch boundaries, bench legs, tests)."""
+    """Clear the ring + the fused-step histogram (epoch boundaries, bench
+    legs, tests)."""
     with _ring_lock:
         _ring.clear()
         _state["total_steps"] = 0
+    histogram.reset_histograms(prefix="step/")
 
 
 def _percentile(sorted_vals, q: float) -> float:

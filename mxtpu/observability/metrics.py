@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from . import histogram as _hist
+
 _stats_lock = threading.Lock()
 
 
@@ -333,10 +335,12 @@ _SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
                  "ttft_ms_total": 0.0, "ttft_ms_last": 0.0,
                  # TTFT decomposition: queue wait (submit -> prefill start)
                  # + prefill (prefill start -> first token); first_decode is
-                 # admission-complete -> first decode-chunk token
+                 # admission-complete -> first decode-chunk token; token_ms is
+                 # decode wall time per emitted token (one sample/dispatch)
                  "queue_wait_ms_total": 0.0, "queue_wait_ms_last": 0.0,
                  "prefill_ms_total": 0.0, "prefill_ms_last": 0.0,
                  "first_decode_ms_total": 0.0, "first_decode_ms_last": 0.0,
+                 "token_ms_total": 0.0, "token_ms_last": 0.0,
                  # KV-cache residency (mxtpu.quant): bytes of the resident
                  # paged cache (data + scales when quantized) and its
                  # storage dtype ('float32' | 'bfloat16' | 'int8' | 'fp8')
@@ -347,6 +351,11 @@ _serving = dict(_SERVING_ZERO)
 _SERVING_ASSIGN = ("slots", "prefix_cache_bytes", "kv_bytes_resident")
 # string-valued keys (assign verbatim)
 _SERVING_STR = ("kv_dtype",)
+# latency series backed by the histogram store (``histogram.record_value``):
+# the compat ``<base>_last``/``<base>_total`` keys AND the ``<base>_p*``
+# percentiles in ``get_serving_stats()`` all derive from "serving/<base>"
+_SERVING_LATENCY = ("ttft_ms", "queue_wait_ms", "prefill_ms",
+                    "first_decode_ms", "token_ms")
 
 
 def record_serving(key: str, n=1):
@@ -354,7 +363,14 @@ def record_serving(key: str, n=1):
     lifecycle counts (submitted/admitted/completed/cancelled/rejected/
     expired), prefill and decode-step dispatches, tokens emitted, KV-bucket
     promotions, latency accumulators. ``*_last`` keys assign, ``*_max`` keys
-    take the high-water mark, everything else accumulates."""
+    take the high-water mark, everything else accumulates. Latency
+    ``*_ms_last`` keys are routed WHOLE into the histogram store — one
+    guarded write per sample instead of the old torn last+total scalar
+    pair — and read back (last/total/percentiles) by
+    :func:`get_serving_stats`."""
+    if key.endswith("_ms_last"):
+        _hist.record_value("serving/" + key[:-5], float(n))
+        return
     with _stats_lock:
         if key.endswith("_last"):
             _serving[key] = n
@@ -388,7 +404,10 @@ def get_serving_stats() -> dict:
     observability contract of :class:`mxtpu.serving.ServingEngine`.
     ``bench.py serving`` reads these; ``docs/serving.md`` has the diagnosis
     guide (e.g. rejected≫0 → raise queue depth; occupancy≈1 with queue
-    growth → raise MXTPU_SERVING_SLOTS)."""
+    growth → raise MXTPU_SERVING_SLOTS). Latency keys are histogram-backed:
+    the legacy ``<base>_last``/``<base>_total`` scalars stay, and each base
+    gains ``_p50/_p90/_p99/_p999`` (log-bucket percentiles, ≤ ~2 % relative
+    error — see ``observability/histogram.py``)."""
     with _stats_lock:
         out = dict(_serving)
     samples = out.pop("occupancy_samples")
@@ -396,12 +415,28 @@ def get_serving_stats() -> dict:
     out["slot_occupancy"] = (occ_sum / samples) if samples else 0.0
     probes = out["prefix_hits"] + out["prefix_misses"]
     out["prefix_hit_rate"] = (out["prefix_hits"] / probes) if probes else 0.0
+    # latency series: read outside _stats_lock (histogram store has its own
+    # lock; never nest the two — R004 discipline)
+    for base in _SERVING_LATENCY:
+        h = _hist.get_histogram("serving/" + base)
+        if h is not None and h.count:
+            s = h.summary()
+            out[base + "_last"] = s["last"]
+            out[base + "_total"] = s["sum"]
+            out[base + "_count"] = s["count"]
+            for _q, name in _hist.QUANTILES:
+                out[f"{base}_{name}"] = s[name]
+        else:
+            out[base + "_count"] = 0
+            for _q, name in _hist.QUANTILES:
+                out[f"{base}_{name}"] = 0.0
     return out
 
 
 def reset_serving_stats():
     with _stats_lock:
         _serving.update(_SERVING_ZERO)
+    _hist.reset_histograms(prefix="serving/")
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,7 @@ import jax
 
 from .observability import export as _export
 from .observability import flops as _flops
+from .observability import histogram as _hist
 from .observability import tracer as _tracer
 from .observability.metrics import (  # noqa: F401  (re-exported surface)
     _stats_lock,
@@ -64,6 +65,11 @@ from .observability.metrics import (  # noqa: F401  (re-exported surface)
 get_mfu_stats = _flops.get_mfu_stats
 record_step_time = _flops.record_step
 reset_step_times = _flops.reset_steps
+
+# streaming latency histograms (observability.histogram is the store)
+get_histogram = _hist.get_histogram
+get_histogram_stats = _hist.get_histogram_stats
+reset_histograms = _hist.reset_histograms
 
 _state = {"config": {"filename": "profile.json", "profile_all": False},
           "running": False, "dir": None, "events": [], "paused": False}
@@ -220,6 +226,7 @@ def dumps(reset: bool = False) -> str:
                           "sanitizer": get_sanitizer_stats(),
                           "resilience": get_resilience_stats(),
                           "serving": get_serving_stats(),
+                          "histograms": _hist.get_histogram_stats(),
                           "mfu": get_mfu_stats()})
     if reset:
         reset_trace()

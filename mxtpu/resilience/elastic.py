@@ -221,8 +221,12 @@ class ElasticRun:
                         self._feed.set_placement(new_mesh)
                     exec_.adopt_mesh(new_mesh)
                     heartbeat("elastic")
-                except ResizeError:
+                except ResizeError as e:
                     self._restore(old_mesh)
+                    from ..observability import flight
+                    flight.record("resize_error", to_dp=dp, error=str(e))
+                    flight.dump("resize_error",
+                                extra={"to_dp": dp, "error": str(e)})
                     raise
                 except (KeyboardInterrupt, SystemExit):
                     raise
@@ -231,6 +235,10 @@ class ElasticRun:
                     # error, layout mismatch): restore the old mesh so the
                     # supervisor's fallback restart starts from sane state
                     self._restore(old_mesh)
+                    from ..observability import flight
+                    flight.record("resize_error", to_dp=dp, error=repr(e))
+                    flight.dump("resize_error",
+                                extra={"to_dp": dp, "error": repr(e)})
                     raise ResizeError(
                         f"in-place resize to dp={dp} failed: "
                         f"{type(e).__name__}: {e}") from e

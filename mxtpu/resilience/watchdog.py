@@ -366,12 +366,18 @@ class Watchdog:
     def _handle_stall(self) -> None:
         report = self._build_report()
         self.stalled = report
-        from ..observability import metrics, tracer
+        from ..observability import flight, metrics, tracer
         metrics.record_resilience("watchdog_stalls")
         tracer.instant("resilience/stall", cat="resilience",
                        args={"waited_s": round(report.waited_s, 3),
                              "deadline_s": self.deadline_s})
         _log.error("%s", report.render())
+        # postmortem bundle BEFORE any policy runs — on_stall may restart
+        # the world and the default policy os._exit()s (dump() is a no-op
+        # unless MXTPU_FLIGHT_DIR is set, and never raises)
+        flight.record("stall", source=self.source,
+                      waited_s=round(report.waited_s, 3))
+        flight.dump("stall", extra=report.to_dict())
         if self.on_stall is not None:
             self.on_stall(report)
             return

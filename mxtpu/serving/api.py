@@ -237,7 +237,20 @@ class ServingRequest:
             self.state = state
             self.error = error
             self.t_done = now
+            n_tokens = len(self._tokens)
             self._cond.notify_all()
+        # one-line summary into the flight recorder's last-N ring (outside
+        # _cond — the recorder has its own lock) so a postmortem bundle
+        # shows what the engine finished right before dying
+        from ..observability import flight
+        flight.note_request({
+            "id": self.id, "state": state,
+            "prompt": len(self.prompt), "max_new": self.max_new,
+            "tokens": n_tokens,
+            "ttft_ms": None if self.t_first_token is None
+            else round((self.t_first_token - self.t_submit) * 1e3, 3),
+            "total_ms": round((now - self.t_submit) * 1e3, 3),
+            "error": repr(error) if error is not None else None})
 
     def _set_state(self, state: str) -> None:
         with self._cond:

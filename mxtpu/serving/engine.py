@@ -282,10 +282,23 @@ class ServingEngine:
                 f"{req.id} rejected") from None
         profiler.record_serving("submitted")
         profiler.record_serving("queue_depth_max", self._submit_q.qsize())
+        tracer.instant("serving/submit", cat="serving",
+                       args={"id": req.id, "prompt": len(req.prompt),
+                             "max_new": req.max_new})
         return req
 
     def stats(self) -> dict:
         return profiler.get_serving_stats()
+
+    def request_timeline(self, rid: int) -> List[dict]:
+        """Every trace event tagged with request ``rid``, time-sorted —
+        submit → admit → prefill chunks → decode dispatches → retire,
+        including drain/adopt markers when the request crossed an engine
+        handoff. Needs tracing on (``profiler.start()`` / ``MXTPU_TRACE``);
+        ids also land in the batch ``serving/decode`` spans, so a request's
+        lane shows exactly which dispatches computed its tokens."""
+        from ..observability import export
+        return export.request_timeline(rid)
 
     def stop(self) -> None:
         """Stop the scheduler; queued and in-flight requests are finished
@@ -349,6 +362,9 @@ class ServingEngine:
                         "topk": int(self._topk[slot]),
                         "seed": int(self._seed[slot]),
                     })
+                    tracer.instant("serving/drain_freeze", cat="serving",
+                                   args={"id": req.id, "slot": slot,
+                                         "p": int(self._p[slot])})
                 # a partially-prefilled admission carries its cursor +
                 # already-computed page rows — adopt() resumes the SUFFIX
                 partial: List[dict] = []
@@ -369,6 +385,9 @@ class ServingEngine:
                             "t0": pf["t0"], "PB": pf["PB"],
                             "left": pf["left"],
                         })
+                        tracer.instant("serving/drain_freeze", cat="serving",
+                                       args={"id": req.id, "partial": True,
+                                             "t": pf["t"]})
                 heartbeat("elastic")
                 # staged by the feed but never prefilled: keep the handles,
                 # drop the staged arrays (adopt() re-stages them). The
@@ -404,7 +423,10 @@ class ServingEngine:
         tracer.instant("serving/drained", cat="serving",
                        args={"in_slots": len(entries),
                              "partial": len(partial),
-                             "pending": len(pending)})
+                             "pending": len(pending),
+                             "ids": [e["req"].id for e in entries]
+                             + [e["req"].id for e in partial]
+                             + [r.id for r in pending]})
         return handoff
 
     def adopt(self, handoff: ServingHandoff) -> "ServingEngine":
@@ -449,6 +471,9 @@ class ServingEngine:
                     self._dec_emitted[i] = False
                     self._active[i] = True
                     self._reqs[i] = e["req"]
+                    tracer.instant("serving/adopt_resume", cat="serving",
+                                   args={"id": e["req"].id, "slot": i,
+                                         "p": e["p"]})
             if handoff.partial:
                 e = handoff.partial[0]
                 req = e["req"]
@@ -462,6 +487,9 @@ class ServingEngine:
                             "slot": len(handoff.entries),
                             "t_start": time.monotonic(),
                             "temp": temp, "topk": topk, "seed": seed}
+                tracer.instant("serving/adopt_resume", cat="serving",
+                               args={"id": req.id, "partial": True,
+                                     "t": e["t"]})
         self.start()
         for req in handoff.pending:
             self._submit_q.put(req)     # blocking is fine: consumer is live
@@ -469,7 +497,10 @@ class ServingEngine:
         tracer.instant("serving/adopted", cat="serving",
                        args={"in_slots": len(handoff.entries),
                              "partial": len(handoff.partial),
-                             "pending": len(handoff.pending)})
+                             "pending": len(handoff.pending),
+                             "ids": [e["req"].id for e in handoff.entries]
+                             + [e["req"].id for e in handoff.partial]
+                             + [r.id for r in handoff.pending]})
         return self
 
     def __enter__(self) -> "ServingEngine":
@@ -530,6 +561,9 @@ class ServingEngine:
                 self._maybe_log()
         except BaseException as e:
             self._error = e
+            from ..observability import flight
+            flight.record("scheduler_error", error=repr(e))
+            flight.dump("scheduler_error", extra={"error": repr(e)})
         finally:
             # a clean drain hands its in-flight state to adopt(); anything
             # else (stop, scheduler error) must cancel so nobody blocks
@@ -581,6 +615,10 @@ class ServingEngine:
         profiler.record_serving("admitted")
         profiler.record_serving("queue_wait_ms_last",
                                 (now - req.t_submit) * 1e3)
+        tracer.instant("serving/admit", cat="serving",
+                       args={"id": req.id, "slot": slot,
+                             "queue_wait_ms": round(
+                                 (now - req.t_submit) * 1e3, 3)})
         page = kv.empty_page(self._model, PB, self._kv_dtype, self._quant)
         m = 0
         # only FORCED prompt positions are reusable (limit = t0 - 1: the
@@ -600,6 +638,8 @@ class ServingEngine:
                                args={"id": req.id, "tokens": m})
             else:
                 profiler.record_serving("prefix_misses")
+                tracer.instant("serving/prefix_miss", cat="serving",
+                               args={"id": req.id})
         temp, topk, seed = _req_sampling(req)
         self._pf = {"req": req, "prompt": staged.data, "page": page,
                     "t": m, "prev": 0, "t0": t0, "PB": PB,
@@ -659,6 +699,10 @@ class ServingEngine:
                                         (done_t - req.t_submit) * 1e3)
                 profiler.record_serving("prefill_ms_last",
                                         (done_t - pf["t_start"]) * 1e3)
+                tracer.instant("serving/first_token", cat="serving",
+                               args={"id": req.id,
+                                     "ttft_ms": round(
+                                         (done_t - req.t_submit) * 1e3, 3)})
             if left == 0:
                 # short request: completed at admission, never took a slot
                 self._pf = None
@@ -666,6 +710,11 @@ class ServingEngine:
                 req._finish(DONE, done_t)
                 profiler.record_serving("prefills")
                 profiler.record_serving("completed")
+                # terminal timeline marker: every request's timeline ends in
+                # a retire even when it never occupied a decode slot
+                tracer.instant("serving/retire", cat="serving",
+                               args={"id": req.id, "state": DONE,
+                                     "at_admission": True})
                 return
         if pf["t"] >= pf["PB"]:
             self._finish_prefill(pf)
@@ -728,8 +777,15 @@ class ServingEngine:
 
     def _decode_chunk(self) -> None:
         n_active = int(self._active.sum())
-        with tracer.span("serving/decode", cat="serving",
-                         args={"active": n_active, "tot": self._TOT}):
+        span_args = {"active": n_active, "tot": self._TOT}
+        if tracer.enabled():
+            # tag the dispatch with the whole slot batch's request ids so
+            # request_timeline()/per-request lanes can claim it (built only
+            # under tracing — the off path stays a dict literal)
+            span_args["ids"] = [self._reqs[int(s)].id
+                                for s in np.flatnonzero(self._active)]
+        t_dispatch = time.monotonic()
+        with tracer.span("serving/decode", cat="serving", args=span_args):
             key = (self.slots, self._TOT, self.chunk)
             fn = self._decode_fns.get_or_build(
                 key, lambda: kv.build_decode(self._model, *key,
@@ -753,6 +809,7 @@ class ServingEngine:
         profiler.record_serving("kv_bytes_resident",
                                 kv.cache_nbytes(self._caches))
         profiler.record_serving_occupancy(n_active, self.slots)
+        emitted_total = 0
         for slot in np.flatnonzero(self._active):
             req = self._reqs[slot]
             fresh = toks_np[lives_np[:, slot], slot]
@@ -760,18 +817,26 @@ class ServingEngine:
                 left = req._emit(fresh.tolist(), now)
                 profiler.record_serving("tokens_out",
                                         int(self._left[slot] - left))
+                emitted_total += int(self._left[slot] - left)
                 self._left[slot] = left
                 if not self._dec_emitted[slot]:
                     self._dec_emitted[slot] = True
                     profiler.record_serving(
                         "first_decode_ms_last",
                         (now - self._t_admit[slot]) * 1e3)
+                    tracer.instant("serving/first_decode", cat="serving",
+                                   args={"id": req.id})
             if self._left[slot] == 0:
                 self._retire(slot, DONE, now)
             elif req._cancelled():
                 self._retire(slot, CANCELLED, now)
             elif req._expired(now):
                 self._retire(slot, EXPIRED, now)
+        if emitted_total:
+            # dispatch wall clock amortized per emitted token — one sample
+            # per dispatch into the serving/token_ms histogram
+            profiler.record_serving(
+                "token_ms_last", (now - t_dispatch) * 1e3 / emitted_total)
 
     def _retire(self, slot: int, state: str, now: float) -> None:
         req = self._reqs[slot]

@@ -309,6 +309,64 @@ def test_r005_suppressed():
 
 
 # ---------------------------------------------------------------------------
+# R006 span-leak
+# ---------------------------------------------------------------------------
+
+def test_r006_positive_bare_statement_and_leaked_binding():
+    findings = _lint("""
+        from mxtpu.observability import tracer
+        def step():
+            tracer.span("step/execute", cat="step")   # never entered
+            run()
+        def leaky():
+            s = tracer.span("step/compile")           # bound, never closed
+            run()
+            return 0
+    """, select=["R006"])
+    assert len(findings) == 2
+    assert all(f.rule == "R006" for f in findings)
+    assert "with tracer.span" in findings[0].message
+
+
+def test_r006_negative_with_exitstack_return_and_unrelated_span():
+    assert _rules_hit("""
+        import contextlib
+        from mxtpu.observability import tracer
+        def normal():
+            with tracer.span("step/execute"):
+                run()
+        def stacked(stack):
+            s = stack.enter_context(tracer.span("feed/transfer"))
+            s.set(bytes=4)
+        def handed_off():
+            return tracer.span("ckpt/write")          # caller owns it
+        def bound_then_entered():
+            s = tracer.span("comm/exchange")
+            with s:
+                run()
+        def explicit():
+            s = tracer.span("ckpt/commit")
+            s.__enter__()
+            try:
+                run()
+            finally:
+                s.__exit__(None, None, None)
+        def not_the_tracer(row):
+            row.span("A1:B2")                         # spreadsheet API: fine
+    """, select=["R006"]) == set()
+
+
+def test_r006_suppressed():
+    findings = _lint("""
+        from mxtpu.observability import tracer
+        def step():
+            tracer.span("step/execute")  # mxtpu: ignore[R006]
+            run()
+    """, select=["R006"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # linter plumbing
 # ---------------------------------------------------------------------------
 

@@ -49,7 +49,7 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     # every fallback scenario must keep emitting its keys
     assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
             "compile_caches", "mfu", "trace", "fsdp", "serving",
-            "elastic", "quant", "ratchet"} <= set(doc)
+            "elastic", "quant", "observability", "ratchet"} <= set(doc)
     # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
     # mid-epoch crash survived by a supervised restart, final params equal
     # to the fault-free baseline
@@ -151,6 +151,16 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert elastic["serving"]["requests_dropped"] == 0
     assert elastic["serving"]["decode_match"] is True
     assert elastic["serving"]["drained"] == elastic["serving"]["adopted"]
+    # observability leg (ISSUE 15): telemetry (tracer + latency histograms)
+    # costs < 3% step time, and the in-process Prometheus/JSON scrape
+    # round-tripped for real
+    obs = doc["observability"]
+    assert "error" not in obs, obs
+    assert obs["overhead_frac"] < 0.03, obs
+    assert obs["steps_per_s_off"] > 0 and obs["steps_per_s_telemetry"] > 0
+    assert obs["prometheus_ok"] is True and obs["json_ok"] is True
+    assert obs["scrape_ms"] > 0 and obs["scrape_bytes"] > 0
+    assert obs["step_ms_p99"] >= obs["step_ms_p50"] > 0
     # the comm leg's all_to_all anomaly probe shipped its point timing
     a2a = doc.get("comm", {}).get("all_to_all_probe")
     if a2a is not None:
@@ -281,6 +291,25 @@ def test_bench_quant_scenario_cli(tmp_path):
     assert cur["kv_bytes_shrink"] == quant["kv_bytes_shrink"]
     assert cur["quant_decode_speedup"] == quant["quant_decode_speedup"]
     assert doc["ratchet"]["harness"] == "quant-smoke"
+
+
+@pytest.mark.slow   # the fallback test above already runs the telemetry leg
+def test_bench_observability_scenario_cli(tmp_path):
+    """``bench.py observability`` (ISSUE 15 satellite): the telemetry-only
+    CLI path must exit 0 and emit a single observability JSON doc — tracer+
+    histogram overhead vs the untraced loop, a real exporter scrape, and the
+    ``telemetry_overhead_inv`` ratchet under the smoke harness key."""
+    doc, _ = _run_fallback_bench(tmp_path, args=("observability",))
+    assert doc["metric"] == "telemetry_overhead_frac"
+    obs = doc["observability"]
+    assert "error" not in obs, obs
+    assert doc["value"] == obs["overhead_frac"]
+    assert obs["overhead_frac"] < 0.03, obs
+    assert obs["prometheus_ok"] is True and obs["json_ok"] is True
+    assert obs["scrape_ms"] > 0
+    cur = doc["ratchet"]["current"]
+    assert cur["telemetry_overhead_inv"] == obs["overhead_inv"] > 0
+    assert doc["ratchet"]["harness"] == "observability-smoke"
 
 
 def test_bench_sanitized_leg_exits_zero_with_no_violations(tmp_path):

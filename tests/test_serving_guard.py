@@ -286,3 +286,50 @@ def test_per_slot_sampling_no_retrace_and_seed_determinism(net):
                       "seed": 42}).result(timeout=300) == out_s
         caches = profiler.get_compile_stats()
         assert caches["serving_decode"]["traces"] == traces0
+
+
+def test_tracing_changes_no_bits(net):
+    """ISSUE 15 acceptance: the telemetry plane is observational — running
+    the SAME staggered trace with the tracer armed (request-tagged spans,
+    latency histograms recording) produces bit-identical outputs, and the
+    request-tagged events only exist while the tracer is on."""
+    from mxtpu.observability import export, tracer
+
+    rs = np.random.RandomState(31)
+    trace = [(rs.randint(1, VOCAB, size=n).tolist(), new)
+             for n, new in [(3, 40), (17, 30), (9, 45)]]
+    refs = [_solo(net, p, m) for p, m in trace]
+
+    def run_trace(eng):
+        reqs = []
+        for i, (p, m) in enumerate(trace):
+            reqs.append(eng.submit(p, m))
+            time.sleep(0.02 * (i % 3))
+        return reqs, [r.result(timeout=300) for r in reqs]
+
+    was_on = tracer.enabled()
+    try:
+        with ServingEngine(net, slots=2, queue_depth=8, chunk=4) as eng:
+            tracer.stop()
+            tracer.reset()                         # drop any prior events
+            _, outs_off = run_trace(eng)           # untraced pass
+            assert outs_off == refs
+            n_tagged_off = sum(
+                1 for e in export.collect_events()
+                if export._event_request_ids(e))
+
+            tracer.start()                         # traced pass, same engine
+            reqs, outs_on = run_trace(eng)
+            assert outs_on == refs                 # bit-exact under tracing
+        # untraced requests left no per-request events; traced ones did,
+        # and each traced request's timeline is individually recoverable
+        assert n_tagged_off == 0
+        for r in reqs:
+            names = {e["name"] for e in export.request_timeline(r.id)}
+            assert {"serving/submit", "serving/admit",
+                    "serving/retire"} <= names
+    finally:
+        tracer.stop()
+        tracer.reset()
+        if was_on:
+            tracer.start()

@@ -1,0 +1,396 @@
+"""Telemetry-plane guard (ISSUE 15): streaming latency histograms, the
+metrics exporter, per-request serving timelines, and the crash flight
+recorder.
+
+Contracts under test:
+
+* ``LogHistogram`` percentiles track ``numpy.percentile(...,
+  method="inverted_cdf")`` within the log-bucket error bound, and merging
+  is associative — two engines' histograms combined in any order equal one
+  histogram that saw every sample.
+* ``record_serving("*_ms_last", ...)`` routes through the guarded histogram
+  store, and ``get_serving_stats()`` derives the compat ``_last``/``_total``
+  scalars plus ``_p50/_p90/_p99/_p999`` from the SAME samples.
+* The Prometheus/JSON exporter round-trips over a real in-process HTTP
+  scrape (port 0 → ephemeral) — no fake handler objects.
+* ``engine.request_timeline(rid)`` reconstructs one request's full life —
+  submit → admit → first token → decode → retire — and stays complete when
+  the request crosses a ``drain()``/``adopt()`` engine handoff.
+* The flight recorder dumps a loadable postmortem bundle when the watchdog
+  aborts a hung step (``MXTPU_FAULT_PLAN`` hang seam, subprocess, exit 87),
+  and stays a strict no-op when ``MXTPU_FLIGHT_DIR`` is unset.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, profiler
+from mxtpu.gluon.model_zoo import transformer_lm
+from mxtpu.observability import exporter, flight, histogram, metrics, tracer
+from mxtpu.observability.histogram import LogHistogram
+
+from conftest import subprocess_env
+
+VOCAB = 50
+
+
+# ---------------------------------------------------------------------------
+# histogram: percentile accuracy vs numpy
+# ---------------------------------------------------------------------------
+
+
+# √growth − 1 ≈ 1.98 % is the per-bucket bound; rank/bucket alignment at the
+# extreme tail (p999 of 20k samples) can add discretization on top, so the
+# test allows 4 %.
+_REL_TOL = 0.04
+
+
+@pytest.mark.parametrize("dist", ["lognormal", "uniform", "bimodal"])
+def test_histogram_percentiles_match_numpy(dist):
+    rs = np.random.RandomState(17)
+    n = 20_000
+    if dist == "lognormal":
+        data = np.exp(rs.normal(2.0, 1.2, size=n))           # heavy tail
+    elif dist == "uniform":
+        data = rs.uniform(0.05, 500.0, size=n)
+    else:
+        data = np.concatenate([rs.uniform(0.5, 2.0, size=n // 2),
+                               rs.uniform(800.0, 1200.0, size=n - n // 2)])
+    h = LogHistogram()
+    for v in data:
+        h.record(float(v))
+    assert h.count == n
+    assert h.min == pytest.approx(float(data.min()))
+    assert h.max == pytest.approx(float(data.max()))
+    assert h.sum == pytest.approx(float(data.sum()), rel=1e-9)
+    for q, name in histogram.QUANTILES:
+        got = h.percentile(q)
+        want = float(np.percentile(data, q * 100, method="inverted_cdf"))
+        rel = abs(got - want) / want
+        assert rel <= _REL_TOL, \
+            f"{dist} {name}: histogram={got:.4f} numpy={want:.4f} rel={rel:.4f}"
+
+
+def test_histogram_empty_single_and_clamping():
+    h = LogHistogram()
+    assert h.percentile(0.5) == 0.0 and h.count == 0
+    h.record(3.7)
+    # one sample: every quantile is that sample, exactly (min/max clamp)
+    for q, _ in histogram.QUANTILES:
+        assert h.percentile(q) == 3.7
+    assert h.summary()["last"] == 3.7
+    # NaN and negative clock skew clamp to 0, never poison the buckets
+    h.record(float("nan"))
+    h.record(-5.0)
+    assert h.count == 3 and h.min == 0.0 and h.max == 3.7
+    # values beyond the top bucket land in overflow but stay clamped to max
+    big = LogHistogram(lo=1e-3, hi=10.0, growth=1.5)
+    big.record(1e9)
+    assert big.percentile(0.5) == 1e9
+
+
+def test_histogram_merge_is_associative_and_matches_one_recorder():
+    rs = np.random.RandomState(5)
+    chunks = [rs.lognormal(1.0, 1.0, size=m) for m in (700, 1300, 500)]
+    hs = []
+    for c in chunks:
+        h = LogHistogram()
+        for v in c:
+            h.record(float(v))
+        hs.append(h)
+    one = LogHistogram()
+    for v in np.concatenate(chunks):
+        one.record(float(v))
+
+    left = hs[0].copy().merge(hs[1]).merge(hs[2])          # (a+b)+c
+    right = hs[0].copy().merge(hs[1].copy().merge(hs[2]))  # a+(b+c)
+    for m in (left, right):
+        assert m.counts == one.counts                      # exact, per bucket
+        assert m.count == one.count
+        assert m.sum == pytest.approx(one.sum, rel=1e-9)
+        assert (m.min, m.max) == (one.min, one.max)
+        for q, _ in histogram.QUANTILES:
+            assert m.percentile(q) == one.percentile(q)
+
+    with pytest.raises(ValueError):
+        LogHistogram(lo=1e-3, hi=10.0, growth=1.5).merge(one)
+
+
+def test_serving_ms_last_routes_through_histogram_store():
+    """Satellite (a): the ``*_ms_last`` cross-thread overwrite race is gone —
+    the scalar is DERIVED from the locked histogram, and the same samples
+    back the ``_total``/``_count``/percentile keys."""
+    profiler.reset_serving_stats()
+    for v in (10.0, 20.0, 100.0):
+        metrics.record_serving("ttft_ms_last", v)
+    stats = profiler.get_serving_stats()
+    assert stats["ttft_ms_last"] == 100.0                  # last sample
+    assert stats["ttft_ms_total"] == pytest.approx(130.0)
+    assert stats["ttft_ms_count"] == 3
+    assert stats["ttft_ms_p50"] == pytest.approx(20.0, rel=_REL_TOL)
+    assert stats["ttft_ms_p99"] == pytest.approx(100.0, rel=_REL_TOL)
+    # never-recorded series still expose zeroed derived keys (compat)
+    assert stats["token_ms_count"] == 0
+    assert stats["token_ms_p99"] == 0.0
+    # the underlying histogram is the profiler-facade-visible store
+    h = profiler.get_histogram("serving/ttft_ms")
+    assert h is not None and h.count == 3
+    assert "serving/ttft_ms" in profiler.get_histogram_stats()
+    profiler.reset_serving_stats()
+    assert profiler.get_histogram("serving/ttft_ms") is None
+
+
+# ---------------------------------------------------------------------------
+# exporter: real in-process scrape round-trip
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_exporter_prometheus_and_json_round_trip():
+    profiler.reset_serving_stats()
+    metrics.record_serving("submitted", 4)
+    metrics.record_serving("ttft_ms_last", 12.5)
+    histogram.record_value("test/scrape_ms", 1.25)
+    try:
+        with exporter.MetricsExporter(0) as ex:          # port 0 → ephemeral
+            assert ex.port > 0
+            base = f"http://127.0.0.1:{ex.port}"
+
+            status, ctype, body = _get(base + "/metrics")
+            assert status == 200 and ctype.startswith("text/plain")
+            text = body.decode()
+            assert "mxtpu_serving_submitted 4" in text
+            assert 'mxtpu_hist_serving_ttft_ms{quantile="0.5"}' in text
+            assert "mxtpu_hist_serving_ttft_ms_count 1" in text
+            assert "mxtpu_hist_test_scrape_ms_count 1" in text
+
+            status, ctype, body = _get(base + "/json")
+            assert status == 200 and ctype.startswith("application/json")
+            snap = json.loads(body)
+            assert snap["serving"]["submitted"] == 4
+            assert snap["serving"]["ttft_ms_count"] == 1
+            assert snap["histograms"]["serving/ttft_ms"]["count"] == 1
+            assert snap["histograms"]["serving/ttft_ms"]["last"] == 12.5
+
+            # unknown paths 404 rather than crashing the server thread
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(base + "/nope")
+            assert ei.value.code == 404
+            # the scrape text parses as Prometheus 0.0.4: every sample line
+            # is "name{labels} value" with a finite float value
+            for line in text.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                name, val = line.rsplit(" ", 1)
+                assert name and np.isfinite(float(val))
+        assert not exporter.active()
+    finally:
+        histogram.reset_histograms(prefix="test/")
+        profiler.reset_serving_stats()
+
+
+def test_exporter_env_arming_is_off_by_default():
+    assert os.environ.get(exporter.ENV_PORT) is None
+    assert not exporter.active()                 # import-time arming stayed off
+    with pytest.raises(ValueError):
+        exporter.start()                         # no port anywhere → explicit
+
+
+# ---------------------------------------------------------------------------
+# per-request timelines across drain()/adopt()
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.rng.seed(0)
+    model = transformer_lm("tiny", vocab_size=VOCAB)
+    model.initialize()
+    return model
+
+
+def _solo(model, prompt, max_new):
+    out = model.generate(nd.array(np.array([prompt], np.int32)), max_new)
+    return np.asarray(out.data)[0, len(prompt):].tolist()
+
+
+def test_request_timeline_complete_across_drain_adopt(net):
+    """One request's timeline — submit → admit → first_token → decode →
+    drain_freeze → adopt_resume → retire — survives the engine handoff, is
+    time-sorted, and the decode spans carry the request id in ``args.ids``."""
+    from mxtpu.serving import ServingEngine
+    profiler.reset_serving_stats()
+    was_on = tracer.enabled()
+    tracer.start()
+    try:
+        rs = np.random.RandomState(11)
+        # 120-token prompt + prefill_chunk=4 → a 32-dispatch prefill scan:
+        # draining after the first chunk deterministically freezes the
+        # request MID-prefill (the proven test_elastic_guard pattern); and
+        # total = 120 + 40 = 160 > the 128 prefill bucket, so the request
+        # must be promoted into a decode slot (decode spans exist to assert)
+        prompt = rs.randint(1, VOCAB, size=120).tolist()
+        ref = _solo(net, prompt, 40)
+
+        eng = ServingEngine(net, slots=2, queue_depth=8, chunk=4,
+                            prefill_chunk=4).start()
+        req = eng.submit(prompt, 40)
+        t0 = time.monotonic()
+        while profiler.get_serving_stats()["prefill_chunks"] < 1:
+            assert time.monotonic() - t0 < 300, "prefill never started"
+            time.sleep(0.001)
+        handoff = eng.drain()
+        assert handoff.in_flight == 1
+        eng2 = ServingEngine(net, slots=2, queue_depth=8, chunk=4,
+                             prefill_chunk=4)
+        eng2.adopt(handoff)
+        assert req.result(timeout=300) == ref        # traced AND bit-exact
+        eng2.stop()
+
+        tl = eng2.request_timeline(req.id)
+        names = [e["name"] for e in tl]
+        for must in ("serving/submit", "serving/admit", "serving/first_token",
+                     "serving/decode", "serving/drain_freeze",
+                     "serving/adopt_resume", "serving/retire"):
+            assert must in names, f"timeline missing {must}: {names}"
+        # ordered: the life story reads forward
+        ts = [e["ts"] for e in tl]
+        assert ts == sorted(ts)
+        assert names.index("serving/submit") \
+            < names.index("serving/admit") \
+            < names.index("serving/drain_freeze") \
+            < names.index("serving/adopt_resume") \
+            < names.index("serving/retire")
+        # decode batch spans tag the whole slot batch via args.ids
+        decode = [e for e in tl if e["name"] == "serving/decode"]
+        assert decode and all(req.id in e["args"]["ids"] for e in decode)
+        # the handoff markers carry the id set too
+        from mxtpu.observability import export
+        evs = export.collect_events()
+        drained = [e for e in evs if e["name"] == "serving/drained"]
+        adopted = [e for e in evs if e["name"] == "serving/adopted"]
+        assert drained and req.id in drained[0]["args"]["ids"]
+        assert adopted and req.id in adopted[0]["args"]["ids"]
+        # chrome trace gains one per-request swim-lane when asked
+        trace = export.chrome_trace(request_lanes=True)
+        lanes = [e for e in trace["traceEvents"]
+                 if e.get("pid") == export.REQUEST_LANE_PID]
+        assert any(e.get("tid") == req.id and e.get("ph") != "M"
+                   for e in lanes)
+        assert any(e.get("name") == "process_name" for e in lanes)
+        # ...and stays OUT of the payload by default
+        plain = export.chrome_trace()
+        assert not any(e.get("pid") == export.REQUEST_LANE_PID
+                       for e in plain["traceEvents"])
+    finally:
+        tracer.stop()
+        tracer.reset()
+        if was_on:
+            tracer.start()
+        profiler.reset_serving_stats()
+
+
+def test_finished_requests_land_in_flight_ring(net):
+    from mxtpu.serving import ServingEngine
+    flight.reset()
+    with ServingEngine(net, slots=1, queue_depth=4, chunk=4) as eng:
+        req = eng.submit([1, 2, 3], 6)
+        out = req.result(timeout=300)
+    assert len(out) == 6
+    rows = [r for r in flight.snapshot_rings()["requests"]
+            if r["id"] == req.id]
+    assert rows, "finished request never reached the flight ring"
+    row = rows[-1]
+    assert row["state"] == "done" and row["tokens"] >= 6
+    assert row["ttft_ms"] is not None and row["total_ms"] > 0
+    assert row["error"] is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dump_is_noop_unless_armed(monkeypatch):
+    monkeypatch.delenv(flight.ENV_DIR, raising=False)
+    assert flight.dump("test_reason") is None
+
+
+def test_flight_dump_and_load_roundtrip(tmp_path):
+    flight.reset()
+    flight.record("test_event", detail="abc")
+    flight.note_request({"id": 999, "state": "done"})
+    metrics.record_serving("submitted", 2)
+    bundle = flight.dump("unit", extra={"k": 1}, out_dir=str(tmp_path))
+    try:
+        assert bundle is not None and os.path.isdir(bundle)
+        assert os.path.basename(bundle).startswith("flight-unit-")
+        doc = flight.load(bundle)
+        stats = doc["stats"]
+        assert stats["reason"] == "unit" and stats["extra"] == {"k": 1}
+        assert any(e["kind"] == "test_event" for e in stats["events"])
+        assert any(r.get("id") == 999 for r in stats["requests"])
+        # counter deltas cover the crash window, not lifetime totals
+        assert stats["counter_deltas"]["serving"]["submitted"] == 2
+        assert "serving" in stats["stats"]           # full snapshot embedded
+        assert "traceEvents" in doc["trace"]
+        # the dump re-baselined: an immediate second bundle shows no delta
+        bundle2 = flight.dump("unit", out_dir=str(tmp_path))
+        d2 = flight.load(bundle2)["stats"].get("counter_deltas", {})
+        assert "submitted" not in d2.get("serving", {})
+    finally:
+        metrics.record_serving("submitted", -2)      # restore the counter
+
+
+_STALL_SCRIPT = """
+import time
+from mxtpu.resilience import Watchdog, fault_point, watchdog
+
+wd = Watchdog(deadline_s=0.4, poll_s=0.05, grace_s=2.0).start()
+for _ in range(3):
+    watchdog.heartbeat("step")
+    fault_point("step")              # pass 2 hangs via MXTPU_FAULT_PLAN
+    time.sleep(0.02)
+time.sleep(60)                       # never reached; watchdog exits 87 first
+"""
+
+
+def test_flight_recorder_dumps_on_watchdog_stall(tmp_path):
+    """ISSUE 15 tentpole (4): a hung step (``MXTPU_FAULT_PLAN`` hang seam)
+    trips the watchdog, which writes a flight bundle to ``MXTPU_FLIGHT_DIR``
+    BEFORE the default policy ``os._exit(87)``s."""
+    from mxtpu.resilience.watchdog import WATCHDOG_EXIT_CODE
+    env = subprocess_env()
+    env["MXTPU_FLIGHT_DIR"] = str(tmp_path)
+    env["MXTPU_FAULT_PLAN"] = "site=step:at=2:kind=hang"
+    env["MXTPU_FAULT_HANG_S"] = "120"
+    proc = subprocess.run([sys.executable, "-c", _STALL_SCRIPT],
+                          env=env, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == WATCHDOG_EXIT_CODE, \
+        f"rc={proc.returncode}\nstdout={proc.stdout}\nstderr={proc.stderr}"
+    bundles = [d for d in os.listdir(tmp_path)
+               if d.startswith("flight-stall-")]
+    assert len(bundles) == 1, f"expected one stall bundle, got {bundles}"
+    doc = flight.load(str(tmp_path / bundles[0]))
+    stats = doc["stats"]
+    assert stats["reason"] == "stall"
+    assert stats["extra"]["deadline_s"] == 0.4
+    assert stats["extra"]["waited_s"] >= 0.4
+    assert stats["extra"]["stacks"]                  # live stacks captured
+    assert any(e["kind"] == "stall" for e in stats["events"])
+    # the stall landed in the resilience counters over the crash window
+    assert stats["counter_deltas"]["resilience"]["watchdog_stalls"] >= 1
+    assert "traceEvents" in doc["trace"]
