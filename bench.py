@@ -548,37 +548,164 @@ def bench_transformer_lm(steps: int = 24, B: int = 32, T: int = 1024,
             "loss_start": round(loss_start, 3), "loss_end": round(loss_end, 3)}
 
 
+def bench_long_context(smoke: bool = False):
+    """Long-context MFU probe: transformer-LM training steps at T=2048 and
+    T=4096 through DataParallelTrainer over the flash-attention kernel.
+
+    This is the hold-the-ceiling leg for PR16's tentpole (c): attention
+    flops grow as T² while the matmul flops grow as T, so MFU at long T is
+    where a weak flash backward shows first. No learning gate here — the
+    flagship transformer_lm leg owns correctness; this leg measures only
+    whether throughput holds as context stretches. ``mfu_t2048`` rides the
+    BENCH_BASELINE ratchet (see apply_ratchet); docs/long_context_roofline.md
+    carries the byte/flop floor analysis behind the numbers.
+
+    Smoke mode (MXTPU_BENCH_SMOKE) shrinks to the tiny preset with the same
+    T points so the geometry (max_len override, T4096 block legality) is
+    exercised on CPU in seconds."""
+    from mxtpu import nd, optimizer as opt_mod
+    from mxtpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxtpu.gluon.model_zoo import transformer_lm
+    from mxtpu.parallel import DataParallelTrainer, shard_batch
+    from mxtpu.parallel.mesh import data_parallel_mesh
+
+    import mxtpu as mx
+
+    class SeqLoss:
+        def __call__(self, logits, y):
+            b, t, v = logits.shape
+            return SoftmaxCrossEntropyLoss()(
+                logits.reshape((b * t, v)), y.reshape((b * t,)))
+
+    if smoke:
+        preset, vocab, micro = "tiny", 256, 1
+        points = ((2048, 1, 1), (4096, 1, 1))       # (T, B, steps)
+    else:
+        preset, vocab, micro = "flagship", 16384, 4
+        points = ((2048, 8, 8), (4096, 4, 6))       # halve B as T doubles
+
+    kind, peak_tf = _device_peak()
+    doc = {"preset": preset, "device": kind}
+    for T, B, steps in points:
+        mx.rng.seed(0)
+        # the flagship preset tops out at max_len=2048 — override so the
+        # learned positional table covers the probe length
+        net = transformer_lm(preset, vocab_size=vocab, max_len=T)
+        net.initialize()
+        if not smoke:
+            net.cast("bfloat16")                    # CPU smoke stays f32
+        mesh = data_parallel_mesh()
+        dpt = DataParallelTrainer(net, SeqLoss(),
+                                  opt_mod.Adam(learning_rate=3e-4), mesh,
+                                  micro_batches=micro)
+        rs = np.random.RandomState(T)
+        x = shard_batch(
+            nd.array(rs.randint(0, vocab, (B, T)).astype(np.int32)), mesh)
+        y = shard_batch(
+            nd.array(rs.randint(0, vocab, (B, T)).astype(np.float32)), mesh)
+
+        t0 = time.perf_counter()
+        float(dpt.step_async(x, y).data)            # compile + first step
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = dpt.step_async(x, y)
+        float(loss.data)                            # sync the chain
+        dt = time.perf_counter() - t0
+        step_ms = 1e3 * dt / steps
+        tok_s = steps * B * T / dt
+
+        xla_flops = float(dpt.cost_analysis().get("flops", 0.0))
+        if micro > 1:
+            xla_flops *= micro                      # scan body counted once
+        mfu = (xla_flops / (step_ms / 1e3)) / (peak_tf * 1e12) \
+            if peak_tf else None
+        doc[f"t{T}"] = {
+            "step_ms": round(step_ms, 2), "tokens_s": round(tok_s, 1),
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "xla_gflops_per_step": round(xla_flops / 1e9, 1),
+            "config": f"{preset}_b{B}_T{T}_x{micro}"}
+        doc[f"mfu_t{T}"] = doc[f"t{T}"]["mfu"]
+        log(f"[long_context] T{T}: {step_ms:.1f} ms/step -> {tok_s:.0f} tok/s"
+            + (f", MFU {100*mfu:.1f}% ({kind})" if mfu is not None else "")
+            + f" (compile {compile_s:.0f}s)")
+    return doc
+
+
 def bench_attention():
     """Flash-attention microbench: Pallas kernel vs XLA reference, fwd+bwd,
     at a production shape (B=4, H=16, T=2048, D=64 — the head dim that used to
-    fall back)."""
+    fall back), plus a T=4096 long-context point and a backward-retune sweep
+    over (block size × launch shape: split vs MXTPU_FLASH_BWD=fused) so the
+    fastest backward config at long T is measured, not assumed (PR16
+    tentpole c)."""
     import jax
     import jax.numpy as jnp
     from mxtpu.ops.attention import attention_reference, flash_attention
 
-    B, H, T, D = 4, 16, 2048, 64
+    H, D = 16, 64
     rs = np.random.RandomState(0)
-    q, k, v = [jnp.asarray(rs.randn(B, H, T, D).astype(np.float32))
-               for _ in range(3)]
-    flops = 4 * B * H * T * T * D * 3  # fwd qk+pv (2 matmuls) + bwd ~2x fwd
-
     results = {}
-    for name, fn in (("pallas", flash_attention), ("xla_ref", attention_reference)):
-        step = jax.jit(jax.value_and_grad(
-            lambda q_, k_, v_, f=fn: jnp.sum(f(q_, k_, v_, causal=True) ** 2),
-            argnums=(0, 1, 2)))      # full backward: dq AND dk/dv kernels live
-        val, _ = step(q, k, v)
-        float(val)  # sync
-        n = 20
-        t0 = time.perf_counter()
-        for _ in range(n):
+    for tag, B, T, n in (("t2048", 4, 2048, 20), ("t4096", 2, 4096, 10)):
+        q, k, v = [jnp.asarray(rs.randn(B, H, T, D).astype(np.float32))
+                   for _ in range(3)]
+        flops = 4 * B * H * T * T * D * 3  # fwd qk+pv matmuls + bwd ~2x fwd
+        point = {}
+        for name, fn in (("pallas", flash_attention),
+                         ("xla_ref", attention_reference)):
+            step = jax.jit(jax.value_and_grad(
+                lambda q_, k_, v_, f=fn: jnp.sum(f(q_, k_, v_, causal=True) ** 2),
+                argnums=(0, 1, 2)))  # full backward: dq AND dk/dv kernels live
             val, _ = step(q, k, v)
-        float(val)
-        dt = (time.perf_counter() - t0) / n
-        results[name] = round(dt * 1e3, 3)
-        log(f"[attn] {name}: {dt*1e3:.2f} ms/iter "
-            f"({flops/dt/1e12:.1f} TFLOP/s incl. causal-skipped half)")
-    results["speedup"] = round(results["xla_ref"] / results["pallas"], 3)
+            float(val)  # sync
+            t0 = time.perf_counter()
+            for _ in range(n):
+                val, _ = step(q, k, v)
+            float(val)
+            dt = (time.perf_counter() - t0) / n
+            point[name] = round(dt * 1e3, 3)
+            log(f"[attn] {tag} {name}: {dt*1e3:.2f} ms/iter "
+                f"({flops/dt/1e12:.1f} TFLOP/s incl. causal-skipped half)")
+        point["speedup"] = round(point["xla_ref"] / point["pallas"], 3)
+        results[tag] = point
+    # headline keys stay the T2048 point (ratchet/guard continuity)
+    results.update(results["t2048"])
+
+    # backward retune sweep (direct kernel launches; TPU only — the sweep
+    # times Mosaic code, and the CPU fallback would just time the reference)
+    if jax.default_backend() == "tpu":
+        from mxtpu.ops.attention import (_flash_attention_pallas,
+                                         _flash_backward_pallas)
+        B, T = 2, 4096
+        scale = 1.0 / np.sqrt(D)
+        q, k, v, g = [jnp.asarray(rs.randn(B, H, T, D).astype(np.float32))
+                      for _ in range(4)]
+        out, lse = _flash_attention_pallas(q, k, v, True, scale)
+        sweep = {}
+        for mode in ("split", "fused"):
+            for blk in (128, 256, 512):
+                os.environ["MXTPU_FLASH_BWD"] = mode
+                try:
+                    bwd = jax.jit(lambda *a, _b=blk: _flash_backward_pallas(
+                        *a, True, scale, block_q=_b, block_k=_b))
+                    jax.block_until_ready(bwd(q, k, v, out, lse, g))
+                    t0 = time.perf_counter()
+                    for _ in range(10):
+                        r = bwd(q, k, v, out, lse, g)
+                    jax.block_until_ready(r)
+                    sweep[f"{mode}_b{blk}"] = round(
+                        (time.perf_counter() - t0) / 10 * 1e3, 3)
+                except Exception as e:   # e.g. block OOMs VMEM — record, move on
+                    sweep[f"{mode}_b{blk}"] = f"error: {type(e).__name__}"
+                finally:
+                    os.environ.pop("MXTPU_FLASH_BWD", None)
+        timed = {c: ms for c, ms in sweep.items() if isinstance(ms, float)}
+        if timed:
+            best = min(timed, key=timed.get)
+            sweep["best"] = best
+            log(f"[attn] bwd sweep @T{T}: best {best} = {timed[best]} ms "
+                f"(set MXTPU_FLASH_BWD=fused to use the fused launch)")
+        results["bwd_sweep_t4096"] = sweep
     return results
 
 
@@ -1599,6 +1726,9 @@ def apply_ratchet(doc: dict, harness: str):
             quant_block = {}
         kv_shrink = quant_block.get("kv_bytes_shrink")
         quant_speedup = quant_block.get("quant_decode_speedup")
+        lctx_block = doc.get("long_context")
+        mfu_t2048 = lctx_block.get("mfu_t2048") \
+            if isinstance(lctx_block, dict) else None
         obs_block = doc.get("observability")
         telemetry_inv = obs_block.get("overhead_inv") \
             if isinstance(obs_block, dict) else None
@@ -1615,6 +1745,7 @@ def apply_ratchet(doc: dict, harness: str):
                          ("a2a_vs_allreduce_ratio", a2a_ratio),
                          ("kv_bytes_shrink", kv_shrink),
                          ("quant_decode_speedup", quant_speedup),
+                         ("mfu_t2048", mfu_t2048),
                          ("telemetry_overhead_inv", telemetry_inv)):
             if isinstance(val, (int, float)) and val > 0:
                 metrics[key] = val
@@ -1898,13 +2029,22 @@ def bench_quant(smoke: bool = False):
     at IDENTICAL slot count (measured from ``kv_bytes_resident``, not
     computed), and ``resident_slots_at_budget`` re-derives how many decode
     slots each mode fits into the fp32 leg's KV footprint. Latency rides
-    along (decode tok/s, p99 TTFT per mode; ``quant_decode_speedup`` =
-    int8-KV tok/s over fp32 — may sit near 1.0 on CPU where int8 buys no
-    MXU cycles, the ratchet guards it against regressing). int8-KV greedy
-    decode is asserted token-exact against solo ``generate``; the
-    weight-quantized leg reports its logits deviation budget instead (see
-    docs/quantization.md). One compiled program per (slots, bucket, chunk)
-    per mode — asserted via the serving compile counters."""
+    along (decode tok/s, p99 TTFT per mode). ``quant_decode_speedup`` =
+    fp32 over int8-KV decode-PROGRAM step time (min-of-N wall of the
+    compiled ``build_decode`` program at the model's full position table —
+    exactly what the fused dequant-attention read changes, with prefill,
+    queueing, and burst-shape noise excluded; ISSUE 16 ratchets this
+    > 1.0, and the per-mode ``decode_step_ms_*`` keys ride along). Each
+    engine leg also reports ``decode_only_tok_s`` (median per-token
+    decode-dispatch wall from the serving stats). The int8-KV
+    leg also A/Bs BOTH fused decode-kernel paths (``variants``: 'pallas'
+    runs the real kernel body — interpret mode on CPU — and 'xla' the
+    int8-``dot_general`` fallback; each must stay token-exact) and reports
+    the active one as ``decode_kernel``. int8-KV greedy decode is asserted
+    token-exact against solo ``generate``; the weight-quantized leg reports
+    its logits deviation budget instead (see docs/quantization.md). One
+    compiled program per (slots, bucket, chunk) per mode — asserted via the
+    serving compile counters."""
     import jax  # noqa: F401
 
     import mxtpu as mx
@@ -1929,39 +2069,110 @@ def bench_quant(smoke: bool = False):
             nd.array(np.array([p], np.int32)), max_new).data)
         refs.append(out[0, len(p):].tolist())
 
-    def serve_leg(quant):
+    def serve_leg(quant, decode_kernel=None, legs=None, new=None):
+        if legs is None:
+            reqs_in, leg_refs = prompts, refs
+        else:
+            # the LONGEST prompts, so prompt + new overflows the prefill
+            # bucket and the burst exercises actual decode dispatches
+            order = sorted(range(n_req), key=lambda i: -len(prompts[i]))
+            reqs_in = [prompts[i] for i in order[:legs]]
+            leg_refs = [refs[i] for i in order[:legs]]
+        want = max_new if new is None else new
         eng = ServingEngine(net, slots=slots, queue_depth=n_req + 2,
-                            chunk=8, quant=quant)
+                            chunk=8, quant=quant,
+                            decode_kernel=decode_kernel)
         eng.start()
-        eng.submit(max(prompts, key=len), max_new).result(timeout=300)
+        eng.submit(max(reqs_in, key=len), want).result(timeout=300)
         profiler.reset_serving_stats()                       # warm off-clock
         t0 = time.monotonic()
-        reqs = [eng.submit(p, max_new) for p in prompts]     # burst
+        reqs = [eng.submit(p, want) for p in reqs_in]        # burst
         outs = [r.result(timeout=600) for r in reqs]
         span = time.monotonic() - t0
         stats = profiler.get_serving_stats()
         eng.stop()
         ttft = np.array([r.t_first_token - r.t_submit for r in reqs])
-        match = sum(o == r for o, r in zip(outs, refs))
+        # greedy prefixes agree: a shorter run matches the ref's head
+        match = sum(o == r[:want] for o, r in zip(outs, leg_refs))
+        # decode-only throughput: median per-token decode-dispatch wall
+        # (one token_ms sample per dispatch), prefill/queueing/scheduler
+        # time excluded — what the fused kernel actually changes (the
+        # quant_decode_speedup basis); the median resists one slow dispatch
+        # on a noisy host where the mean does not
+        tok_ms = stats.get("token_ms_p50", 0.0)
         return {
-            "decode_tok_s": n_req * max_new / span if span else 0.0,
+            "decode_tok_s": len(reqs_in) * want / span if span else 0.0,
+            "decode_only_tok_s": 1e3 / tok_ms if tok_ms else 0.0,
             "ttft_p50_ms": float(np.percentile(ttft, 50) * 1e3),
             "ttft_p99_ms": float(np.percentile(ttft, 99) * 1e3),
             "kv_bytes_resident": stats.get("kv_bytes_resident", 0),
             "kv_dtype": stats.get("kv_dtype"),
+            "decode_kernel": stats.get("decode_kernel"),
             "decode_match": int(match),
             "decode_steps": stats.get("decode_steps"),
         }
 
     fp32 = serve_leg(None)
     i8kv = serve_leg("int8_kv")
+    # A/B both fused decode-kernel paths at the same quant mode: the leg
+    # that matches the backend-auto choice reruns tiny (it already ran
+    # full-size above); the other gets its own reduced burst — on CPU that
+    # exercises the REAL pallas kernel body in interpret mode
+    variants = {}
+    for kern in ("xla", "pallas"):
+        variants[kern] = serve_leg("int8_kv", decode_kernel=kern,
+                                   legs=2, new=24)
+        if variants[kern]["decode_match"] != 2:
+            raise AssertionError(
+                f"int8-KV {kern} decode-kernel variant must stay "
+                f"token-exact: {variants[kern]['decode_match']}/2")
+        if not variants[kern]["decode_steps"]:
+            raise AssertionError(
+                f"decode-kernel variant {kern!r} never dispatched decode — "
+                "the probe burst must overflow the prefill bucket")
+    i8kv["variants"] = variants
     i8w = serve_leg("int8_kv,int8_w")
     if i8kv["decode_match"] != n_req:
         raise AssertionError(
             f"int8-KV greedy decode must stay token-exact: "
             f"{i8kv['decode_match']}/{n_req}")
     shrink = fp32["kv_bytes_resident"] / max(1, i8kv["kv_bytes_resident"])
-    speedup = i8kv["decode_tok_s"] / max(1e-9, fp32["decode_tok_s"])
+
+    # -- decode-program speedup (the ratchet basis) -------------------------
+    # min-of-N wall time of the COMPILED decode program itself, fp32 vs
+    # int8-KV, at a fixed (slots, TOT, chunk): this is precisely what the
+    # fused dequant-attention read changes, measured without prefill,
+    # scheduling, or burst-shape noise (min-of-N is the standard stable
+    # microbench estimator; the engine legs above keep the end-to-end
+    # numbers). TOT is the model's full position table — the long-context
+    # end of the bucket range, where the KV read actually costs something.
+    def decode_program_ms(quant, TOT, reps):
+        import jax
+        import jax.numpy as jnp
+        from mxtpu.quant.serve import parse_quant, quantize_lm
+        spec = parse_quant(quant)
+        params = quantize_lm(net, spec)
+        caches = skv.empty_cache(net, slots, TOT, jnp.float32, spec)
+        fn = skv.build_decode(net, slots, TOT, 8, quant=spec)
+        args = (params, caches, jnp.zeros((slots,), jnp.int32),
+                jnp.full((slots,), TOT // 2, jnp.int32),
+                jnp.ones((slots,), bool), jnp.full((slots,), TOT, jnp.int32),
+                jnp.zeros((slots,), jnp.float32),
+                jnp.zeros((slots,), jnp.int32),
+                jnp.zeros((slots,), jnp.uint32))
+        jax.block_until_ready(fn(*args))                    # trace off-clock
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best / 8 * 1e3                               # ms per step
+
+    dec_TOT = net._max_len
+    dec_reps = 30 if smoke else 60
+    dec_fp32_ms = decode_program_ms(None, dec_TOT, dec_reps)
+    dec_i8kv_ms = decode_program_ms("int8_kv", dec_TOT, dec_reps)
+    speedup = dec_fp32_ms / max(1e-9, dec_i8kv_ms)
     # capacity: decode slots per mode inside the fp32 leg's KV footprint
     budget = fp32["kv_bytes_resident"]
     per_slot = {tag: leg["kv_bytes_resident"] / slots
@@ -2019,6 +2230,9 @@ def bench_quant(smoke: bool = False):
         "kv_bytes_shrink": shrink,
         "kv_block_shrink": block_shrink,
         "quant_decode_speedup": speedup,
+        "decode_program_tot": dec_TOT,
+        "decode_step_ms_fp32": dec_fp32_ms,
+        "decode_step_ms_int8_kv": dec_i8kv_ms,
         "resident_slots_at_fp32_budget": slots_at_budget,
         "weight_leg_token_agreement": i8w["decode_match"] / n_req,
         "train_step_ms_fp32": tr_fp32["step_ms"],
@@ -2029,9 +2243,10 @@ def bench_quant(smoke: bool = False):
     }
     log(f"[quant] kv shrink {shrink:.2f}x at {slots} slots "
         f"({fp32['kv_bytes_resident']} -> {i8kv['kv_bytes_resident']} B), "
-        f"decode {i8kv['decode_tok_s']:.1f} vs fp32 "
-        f"{fp32['decode_tok_s']:.1f} tok/s ({speedup:.2f}x), int8-KV "
-        f"match {i8kv['decode_match']}/{n_req}, quant step "
+        f"decode step @T{dec_TOT} {dec_i8kv_ms:.3f} vs fp32 "
+        f"{dec_fp32_ms:.3f} ms ({speedup:.2f}x, kernel "
+        f"{i8kv['decode_kernel']}), int8-KV match "
+        f"{i8kv['decode_match']}/{n_req}, quant step "
         f"{tr_int8['step_ms']:.1f} ms vs fp32 {tr_fp32['step_ms']:.1f} ms")
     return doc
 
@@ -2659,6 +2874,7 @@ def bench_cpu_fallback():
     serving = run_leg("serving", bench_serving, smoke=smoke)
     elastic = run_leg("elastic", bench_elastic, smoke=smoke)
     quant = run_leg("quant", bench_quant, smoke=smoke)
+    lctx = run_leg("long_context", bench_long_context, smoke=smoke)
     trace = run_leg("trace", bench_trace)
     obs = run_leg("observability", bench_observability, smoke=smoke)
     san = run_leg("sanitizer", bench_sanitizer, smoke=smoke) \
@@ -2685,6 +2901,7 @@ def bench_cpu_fallback():
         "serving": serving,
         "elastic": elastic,
         "quant": quant,
+        "long_context": lctx,
         "trace": trace,
         "observability": obs,
         "compile_caches": caches,
@@ -2783,6 +3000,7 @@ def main():
     serving = run_leg("serving", bench_serving)
     elastic = run_leg("elastic", bench_elastic)
     quant = run_leg("quant", bench_quant)
+    lctx = run_leg("long_context", bench_long_context)
     trace = run_leg("trace", bench_trace)
     obs = run_leg("observability", bench_observability)
     san = run_leg("sanitizer", bench_sanitizer) \
@@ -2824,6 +3042,7 @@ def main():
         "serving": serving,
         "elastic": elastic,
         "quant": quant,
+        "long_context": lctx,
         "trace": trace,
         "observability": obs,
         "compile_caches": _compile_caches(),

@@ -1,0 +1,52 @@
+"""R007 quant-cache-materialize: ``QuantKV.dequantize()`` inside a traced
+step.
+
+``QuantKV.dequantize()`` materializes the FULL-precision view of a
+quantized cache — a debugging/test convenience. Inside a jit-traced
+serving/step function it silently rebuilds the (S, H, TOT, D) f32 cache
+every decode step, which is exactly the regression ISSUE 16 removed: PR
+14's serving read dequantized the whole per-layer cache before the score
+einsum and ``quant_decode_speedup`` ratcheted at 0.78 (quantization paid
+in bytes, charged in time). The fused read
+(``mxtpu.ops.quant_attention.dequant_attention_decode``) consumes the
+quantized storage directly; per-ROW reads (``dequantize_rows`` on one
+gathered row, e.g. the embedding lookup) are fine and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, dotted_name
+
+RULE_ID = "R007"
+TITLE = "quant-cache-materialize"
+
+# receivers whose .dequantize() is (or aliases) a QuantKV cache — the rule
+# stays name-based like the rest of tpulint: any .dequantize() attribute
+# call counts, because the method only exists on QuantKV in this codebase
+_METHOD = "dequantize"
+
+
+def check(ctx):
+    seen = set()
+    for fn in ctx.step_functions:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == _METHOD):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            recv = dotted_name(node.func.value) or "<cache>"
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, RULE_ID,
+                f"{TITLE}: {recv}.dequantize() inside a function that flows "
+                f"into a jax trace materializes the full-precision KV view "
+                f"every step (the 0.78x quant_decode_speedup regression) — "
+                f"use mxtpu.ops.quant_attention.dequant_attention_decode to "
+                f"read the quantized cache fused, or dequantize_rows on the "
+                f"single gathered row")

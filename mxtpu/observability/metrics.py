@@ -341,21 +341,33 @@ _SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
                  "prefill_ms_total": 0.0, "prefill_ms_last": 0.0,
                  "first_decode_ms_total": 0.0, "first_decode_ms_last": 0.0,
                  "token_ms_total": 0.0, "token_ms_last": 0.0,
+                 # decode-only wall clock and tokens: one decode_ms sample
+                 # per decode dispatch (the dispatch's full wall time) plus
+                 # the tokens it emitted — decode_tokens / decode_ms_total
+                 # is pure decode throughput with prefill, queueing, and
+                 # scheduler sleeps excluded (the quant_decode_speedup
+                 # methodology; see docs/quantization.md)
+                 "decode_ms_total": 0.0, "decode_ms_last": 0.0,
+                 "decode_tokens": 0,
                  # KV-cache residency (mxtpu.quant): bytes of the resident
                  # paged cache (data + scales when quantized) and its
-                 # storage dtype ('float32' | 'bfloat16' | 'int8' | 'fp8')
-                 "kv_bytes_resident": 0, "kv_dtype": "float32"}
+                 # storage dtype ('float32' | 'bfloat16' | 'int8' | 'fp8');
+                 # decode_kernel is the fused dequant-attention path of a
+                 # quantized cache ('pallas' | 'xla'; 'none' when the cache
+                 # is full-precision and the fused read never engages)
+                 "kv_bytes_resident": 0, "kv_dtype": "float32",
+                 "decode_kernel": "none"}
 _serving = dict(_SERVING_ZERO)
 
 # keys that ASSIGN the latest value instead of accumulating
 _SERVING_ASSIGN = ("slots", "prefix_cache_bytes", "kv_bytes_resident")
 # string-valued keys (assign verbatim)
-_SERVING_STR = ("kv_dtype",)
+_SERVING_STR = ("kv_dtype", "decode_kernel")
 # latency series backed by the histogram store (``histogram.record_value``):
 # the compat ``<base>_last``/``<base>_total`` keys AND the ``<base>_p*``
 # percentiles in ``get_serving_stats()`` all derive from "serving/<base>"
 _SERVING_LATENCY = ("ttft_ms", "queue_wait_ms", "prefill_ms",
-                    "first_decode_ms", "token_ms")
+                    "first_decode_ms", "token_ms", "decode_ms")
 
 
 def record_serving(key: str, n=1):

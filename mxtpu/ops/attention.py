@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -69,11 +70,22 @@ def _pick_block(t: int, cap: int = 512) -> int:
     Cap 512 measured fastest on v5e at production shapes (B4 H16 T2048 D64
     fwd+bwd: 15.1 ms @128 → 6.7 ms @512, vs 20.7 ms XLA reference); 1024
     exceeds VMEM and fails to compile. Launch sites scale the cap down with
-    the padded head dim (`_block_cap`) so large-D shapes stay inside VMEM."""
+    the padded head dim (`_block_cap`) so large-D shapes stay inside VMEM.
+
+    Raises :exc:`ValueError` when no Mosaic-legal block exists — launch
+    sites gate on ``_use_pallas``/``_legal_bucket`` first, so hitting this
+    means a kernel was invoked directly at an unsupported length; the error
+    names the constraint instead of surfacing as an opaque Mosaic lowering
+    failure deep inside ``pallas_call``."""
     if cap < 128:
         # below 128 only a whole-axis block is Mosaic-legal (the lse/delta
         # row block must be 128-divisible or the full axis)
-        return t if (t <= cap and t % 8 == 0) else 0
+        if t <= cap and t % 8 == 0:
+            return t
+        raise ValueError(
+            f"no Mosaic-legal flash block for axis length {t} under cap "
+            f"{cap}: sub-128 caps admit only a whole-axis block, needing "
+            f"t <= {cap} and t % 8 == 0 (Mosaic sublane tiling)")
     if t % 128 == 0:
         b = min(cap - cap % 128, t)
         while b > 128 and t % b != 0:
@@ -81,7 +93,12 @@ def _pick_block(t: int, cap: int = 512) -> int:
         return b
     if t <= 128 and t % 8 == 0:
         return t
-    return 0
+    raise ValueError(
+        f"no Mosaic-legal flash block for axis length {t}: the lse/delta "
+        f"row block's last dim must be a multiple of 128 or the whole "
+        f"axis, so t must be a multiple of 128, or t <= 128 with "
+        f"t % 8 == 0. Pad the sequence (e.g. to {-(-t // 128) * 128}) or "
+        f"take the XLA reference path")
 
 
 def _block_cap(dp: int) -> int:
@@ -89,6 +106,28 @@ def _block_cap(dp: int) -> int:
     the padded head dim so the per-program tiles stay in the same budget
     (Dp=256 → 256, Dp≥512 → 128, the previously-validated floor)."""
     return max(128, 512 * 128 // max(dp, 128))
+
+
+def _bwd_mode() -> str:
+    """Flash-backward launch shape: ``'split'`` (default — the validated
+    two-kernel dq then dk/dv pair) or ``'fused'`` (``MXTPU_FLASH_BWD=fused``
+    — one kernel per (batch·head, tile) computing dq for its q-tile AND
+    dk/dv for its k-tile, halving launches and re-streaming each opposing
+    tile once instead of twice across kernels). Long-context retune knob
+    (PR16 tentpole c); read at trace time, so flipping it retraces."""
+    return "fused" if os.environ.get(
+        "MXTPU_FLASH_BWD", "").strip().lower() == "fused" else "split"
+
+
+def _lse_store_dtype():
+    """Storage dtype for the sublane-broadcast lse/delta rows the backward
+    kernels stream: f32 (default, exact) or bf16 (``MXTPU_FLASH_LSE=bf16``)
+    which halves that HBM traffic at long T. Kernels accumulate in f32
+    either way — only the stored rows round. Softmax weights are exp(s-lse),
+    so a bf16 lse (rel err ~2^-8) perturbs weights ~0.4% — fine for
+    training steps, not for bit-exactness guards, hence opt-in."""
+    return jnp.bfloat16 if os.environ.get(
+        "MXTPU_FLASH_LSE", "").strip().lower() == "bf16" else jnp.float32
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
@@ -147,8 +186,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
+    lse = lse_ref[0, 0].astype(jnp.float32)[:, None]
+    delta = delta_ref[0, 0].astype(jnp.float32)[:, None]
     block_q = q.shape[0]
     qi = pl.program_id(1)
     q_start = qi * block_q
@@ -197,8 +236,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         qs = qb * block_q
         q = q_ref[0, pl.dslice(qs, block_q), :].astype(jnp.float32) * scale
         do = do_ref[0, pl.dslice(qs, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(qs, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.dslice(qs, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.dslice(qs, block_q)].astype(jnp.float32)[:, None]
+        delta = delta_ref[0, 0, pl.dslice(qs, block_q)].astype(
+            jnp.float32)[:, None]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
             rows = qs + lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -215,6 +255,81 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     z = jnp.zeros((block_k, k_blk.shape[1]), jnp.float32)
     dk, dv = lax.fori_loop(start_qb, num_qb, body, (z, z))
     # dk absorbed one factor of scale through q; no extra factor needed
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dq_ref, dk_ref, dv_ref, *, block: int,
+                            causal: bool, scale: float):
+    """One (batch·head, tile i) program producing dq for q-tile i AND dk/dv
+    for k-tile i (``MXTPU_FLASH_BWD=fused``). Requires self-attention
+    tiling (T == Tk, shared block). The two inner loops walk complementary
+    causal wedges — key tiles j <= i for dq, query tiles j >= i for dk/dv —
+    so together each program touches one full stripe of the T×T square and
+    the grid covers it exactly once, in half the kernel launches of the
+    split pair."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    t = q_ref.shape[1]
+    num_b = t // block
+    i_start = i * block
+
+    q_i = q_ref[0, pl.dslice(i_start, block), :].astype(jnp.float32) * scale
+    do_i = do_ref[0, pl.dslice(i_start, block), :].astype(jnp.float32)
+    lse_i = lse_ref[0, 0, pl.dslice(i_start, block)].astype(
+        jnp.float32)[:, None]
+    delta_i = delta_ref[0, 0, pl.dslice(i_start, block)].astype(
+        jnp.float32)[:, None]
+    k_i = k_ref[0, pl.dslice(i_start, block), :].astype(jnp.float32)
+    v_i = v_ref[0, pl.dslice(i_start, block), :].astype(jnp.float32)
+
+    # -- dq for q-tile i: stream key tiles j (j <= i when causal) ----------
+    def dq_body(j, dq):
+        ks = j * block
+        k_blk = k_ref[0, pl.dslice(ks, block), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(ks, block), :].astype(jnp.float32)
+        s = jnp.dot(q_i, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = i_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ks + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_i)
+        dp = jnp.dot(do_i, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_i)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block, q_i.shape[1]), jnp.float32)
+    dq = lax.fori_loop(0, jnp.minimum(num_b, i + 1) if causal else num_b,
+                       dq_body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+    # -- dk/dv for k-tile i: stream query tiles j (j >= i when causal) -----
+    def dkv_body(j, carry):
+        dk, dv = carry
+        qs = j * block
+        q_blk = q_ref[0, pl.dslice(qs, block), :].astype(jnp.float32) * scale
+        do_blk = do_ref[0, pl.dslice(qs, block), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.dslice(qs, block)].astype(
+            jnp.float32)[:, None]
+        delta_blk = delta_ref[0, 0, pl.dslice(qs, block)].astype(
+            jnp.float32)[:, None]
+        s = jnp.dot(q_blk, k_i.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = qs + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = i_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_blk)
+        dv_new = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v_i.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        dk_new = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    z = jnp.zeros((block, k_i.shape[1]), jnp.float32)
+    dk, dv = lax.fori_loop(i if causal else 0, num_b, dkv_body, (z, z))
+    # dk absorbed one factor of scale through q_blk; no extra factor needed
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -269,7 +384,9 @@ def _flash_attention_pallas(q, k, v, causal: bool, scale: float,
 def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
                            block_q: int = 512, block_k: int = 512,
                            interpret: bool = False, lse_cot=None):
-    """Flash backward: dq via q-block grid, dk/dv via k-block grid.
+    """Flash backward: dq via q-block grid, dk/dv via k-block grid (the
+    default 'split' launch), or one fused grid doing both per tile when
+    ``MXTPU_FLASH_BWD=fused`` and the shape is self-attention tiling.
 
     ``lse_cot`` (B,H,T): optional cotangent of the log-sum-exp output (ring
     merges differentiate through lse); it folds into the delta term exactly —
@@ -281,9 +398,13 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if lse_cot is not None:
         delta = delta - lse_cot.astype(jnp.float32)
-    # lse/delta ride (BH, 8, T): sublane-broadcast to satisfy Mosaic tiling
-    delta = jnp.broadcast_to(delta.reshape(B * H, 1, T), (B * H, 8, T))
-    lse = jnp.broadcast_to(lse.reshape(B * H, 1, T), (B * H, 8, T))
+    # lse/delta ride (BH, 8, T): sublane-broadcast to satisfy Mosaic tiling;
+    # MXTPU_FLASH_LSE=bf16 halves this streamed traffic (kernels re-widen)
+    row_dt = _lse_store_dtype()
+    delta = jnp.broadcast_to(
+        delta.astype(row_dt).reshape(B * H, 1, T), (B * H, 8, T))
+    lse = jnp.broadcast_to(
+        lse.astype(row_dt).reshape(B * H, 1, T), (B * H, 8, T))
     qq = _pad_d(q.reshape(B * H, T, D))
     kk = _pad_d(k.reshape(B * H, Tk, D))
     vv = _pad_d(v.reshape(B * H, Tk, D))
@@ -292,6 +413,36 @@ def _flash_backward_pallas(q, k, v, o, lse, g, causal: bool, scale: float,
     # same padded-D cap as the forward (blocks must match its VMEM budget)
     block_q = _pick_block(T, min(block_q, _block_cap(Dp)))
     block_k = _pick_block(Tk, min(block_k, _block_cap(Dp)))
+
+    if _bwd_mode() == "fused" and T == Tk and block_q == block_k:
+        fused = functools.partial(_flash_bwd_fused_kernel, block=block_q,
+                                  causal=causal, scale=scale)
+        dq, dk, dv = pl.pallas_call(
+            fused,
+            grid=(B * H, T // block_q),
+            in_specs=[
+                pl.BlockSpec((1, T, Dp), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, T, Dp), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, 8, T), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, 8, T), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, T, Dp), q.dtype),
+                jax.ShapeDtypeStruct((B * H, Tk, Dp), k.dtype),
+                jax.ShapeDtypeStruct((B * H, Tk, Dp), v.dtype),
+            ],
+            interpret=interpret,
+        )(qq, kk, vv, gg, lse, delta)
+        return (dq[..., :D].reshape(B, H, T, D),
+                dk[..., :D].reshape(B, H, Tk, D),
+                dv[..., :D].reshape(B, H, Tk, D))
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
                                   causal=causal, scale=scale)

@@ -161,12 +161,20 @@ def _int8_matmul(h, w_q, w_s):
     return acc.astype(jnp.float32) * h_s[:, None] * w_s[None, :]
 
 
-def build_step(model, S: int, TOT: int, spec: QuantSpec):
+def build_step(model, S: int, TOT: int, spec: QuantSpec, decode_kernel=None):
     """The quantized twin of :meth:`TransformerLM.serving_step` — identical
-    decode math with (a) KV rows quantized on append and dequantized at the
-    attention read when ``spec.kv`` is set (``caches`` is then a
-    :class:`QuantKV`), and (b) weight matmuls on the int8 path when
-    ``spec.weights`` is set (``params`` from :func:`quantize_lm`).
+    decode math with (a) KV rows quantized on append and the attention read
+    running FUSED over the quantized storage when ``spec.kv`` is set
+    (``caches`` is then a :class:`QuantKV`; see
+    :mod:`mxtpu.ops.quant_attention` — the full-precision KV view is never
+    materialized on either the pallas or the xla path), and (b) weight
+    matmuls on the int8 path when ``spec.weights`` is set (``params`` from
+    :func:`quantize_lm`).
+
+    ``decode_kernel`` picks the attention-read path ('pallas'/'xla'/None =
+    ``MXTPU_DECODE_KERNEL`` + backend auto) and is resolved ONCE here at
+    build time, so the compiled program is pinned to one kernel and env
+    flips between dispatches cannot retrace.
 
     Returns ``step(params, caches, tok, p) -> (new_caches, logits)`` with
     the same row-independence property as the fp32 step: slot ``s``'s
@@ -179,6 +187,10 @@ def build_step(model, S: int, TOT: int, spec: QuantSpec):
     scale = 1.0 / math.sqrt(D)
     wq = spec.weights == "int8"
     kvq = spec.kv
+    if kvq:
+        from ..ops import quant_attention
+        dec_kernel = quant_attention.resolve_decode_kernel(
+            decode_kernel, TOT=TOT, D=D)
     if wq or kvq:
         from .. import profiler
         # matmul sites staged per step: 6 per layer + tied/untied head
@@ -225,21 +237,22 @@ def build_step(model, S: int, TOT: int, spec: QuantSpec):
                     .at[i, 0, rows, :, pc].set(k_s) \
                     .at[i, 1, rows, :, pc].set(v_s)
                 new_caches = kv_quant.QuantKV(data, scl, kvq)
-                # dequantize-in-kernel: the attention read is the ONLY
-                # consumer; XLA fuses the scale-multiply into the einsum
-                K = kv_quant.dequantize_rows(new_caches.data[i, 0],
-                                             new_caches.scale[i, 0])
-                V = kv_quant.dequantize_rows(new_caches.data[i, 1],
-                                             new_caches.scale[i, 1])
+                # fused dequant-attention: the quantized storage feeds the
+                # read directly — no dequantized (S, H, TOT, D) view exists
+                # on either path (the 0.78x-regression fix)
+                ctx = quant_attention.dequant_attention_decode(
+                    q, new_caches.data[i, 0], new_caches.scale[i, 0],
+                    new_caches.data[i, 1], new_caches.scale[i, 1],
+                    pc, scale=scale, kernel=dec_kernel).reshape(S, U)
             else:
                 new_caches = new_caches.at[i, 0, rows, :, pc].set(k)
                 new_caches = new_caches.at[i, 1, rows, :, pc].set(v)
                 K = new_caches[i, 0]        # (S, H, TOT, D)
                 V = new_caches[i, 1]
-            s = jnp.einsum("bhd,bhtd->bht", q, K) * scale
-            s = jnp.where(mask[:, None, :], s, -1e30)
-            att = jax.nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("bht,bhtd->bhd", att, V).reshape(S, U)
+                s = jnp.einsum("bhd,bhtd->bht", q, K) * scale
+                s = jnp.where(mask[:, None, :], s, -1e30)
+                att = jax.nn.softmax(s, axis=-1)
+                ctx = jnp.einsum("bht,bhtd->bhd", att, V).reshape(S, U)
             x = x + mm(ctx, lp, "ow", "ob")
             g = ln(x, lp["ln2_g"], lp["ln2_b"])
             g = jax.nn.gelu(mm(g, lp, "f1w", "f1b"), approximate=False)

@@ -84,7 +84,10 @@ class ServingConfig:
     once-dead ``kv.empty_cache(dtype=...)`` parameter, now plumbed
     end-to-end). ``quant`` selects low-precision execution — a
     :class:`~mxtpu.quant.serve.QuantSpec` or a token string like
-    ``'int8_kv,int8_w'`` (see ``docs/quantization.md``)."""
+    ``'int8_kv,int8_w'`` (see ``docs/quantization.md``). ``decode_kernel``
+    pins the fused dequant-attention read of a quantized KV cache
+    (``'pallas'``/``'xla'``; the ``MXTPU_DECODE_KERNEL`` knob — None defers
+    down the chain to backend auto)."""
     slots: Optional[int] = None
     queue_depth: Optional[int] = None
     chunk: Optional[int] = None
@@ -93,6 +96,7 @@ class ServingConfig:
     stall_deadline_s: Optional[float] = None
     kv_dtype: Optional[str] = None
     quant: object = None
+    decode_kernel: Optional[str] = None
 
 
 class ServingRequest:

@@ -87,6 +87,7 @@ from ..ndarray.ndarray import NDArray
 from ..observability import tracer
 from ..resilience.elastic import elastic_watchdog
 from ..resilience.faults import fault_point
+from ..ops import quant_attention
 from ..quant.serve import parse_quant, quantize_lm
 from ..resilience.watchdog import Watchdog, heartbeat
 from ..step_cache import ProgramCache
@@ -158,7 +159,7 @@ class ServingEngine:
                  stall_deadline_s: Optional[float] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache_mb: Optional[float] = None,
-                 kv_dtype=None, quant=None,
+                 kv_dtype=None, quant=None, decode_kernel=None,
                  config: Optional[ServingConfig] = None):
         if config is not None:
             slots = slots or config.slots
@@ -172,6 +173,8 @@ class ServingEngine:
             kv_dtype = kv_dtype or config.kv_dtype
             if quant is None:
                 quant = config.quant
+            if decode_kernel is None:
+                decode_kernel = config.decode_kernel
         self._model = model
         # low-precision execution (mxtpu.quant): ONE spec per engine
         # lifetime, resolved kwarg > config > env — the program caches stay
@@ -179,6 +182,14 @@ class ServingEngine:
         if quant is None:
             quant = os.environ.get("MXTPU_SERVING_QUANT") or None
         self._quant = parse_quant(quant)
+        # fused dequant-attention path of the quantized KV read: like the
+        # spec, resolved ONCE per engine lifetime (kwarg > config >
+        # MXTPU_DECODE_KERNEL env) — an env flip while serving can never
+        # reach a live program, let alone retrace it
+        self._decode_kernel = quant_attention.decode_kernel_mode(decode_kernel)
+        self._decode_kernel_str = (
+            quant_attention.resolve_decode_kernel(self._decode_kernel)
+            if self._quant.kv else None)
         if kv_dtype is None:
             kv_dtype = os.environ.get("MXTPU_SERVING_KV_DTYPE") or None
         self._kv_dtype = jnp.zeros((0,), kv_dtype or jnp.float32).dtype
@@ -238,6 +249,9 @@ class ServingEngine:
             self._materialize_params()
             profiler.record_serving("slots", self.slots)
             profiler.record_serving("kv_dtype", self._kv_dtype_str)
+            if self._decode_kernel_str is not None:
+                profiler.record_serving("decode_kernel",
+                                        self._decode_kernel_str)
             self._feed = DeviceFeed(self._staging_source(), depth=2)
             if self._stall_deadline_s:
                 self._wd = Watchdog(deadline_s=self._stall_deadline_s,
@@ -671,8 +685,9 @@ class ServingEngine:
                                "chunk": csize, "bucket": pf["PB"]}):
             fn = self._prefill_fns.get_or_build(
                 (pf["PB"], csize),
-                lambda: kv.build_prefill_chunk(self._model, pf["PB"], csize,
-                                               quant=self._quant))
+                lambda: kv.build_prefill_chunk(
+                    self._model, pf["PB"], csize, quant=self._quant,
+                    decode_kernel=self._decode_kernel))
             page, outs = fn(
                 self._params, pf["page"], pf["prompt"],
                 jnp.int32(pf["t0"]), jnp.int32(start),
@@ -788,8 +803,9 @@ class ServingEngine:
         with tracer.span("serving/decode", cat="serving", args=span_args):
             key = (self.slots, self._TOT, self.chunk)
             fn = self._decode_fns.get_or_build(
-                key, lambda: kv.build_decode(self._model, *key,
-                                             quant=self._quant))
+                key, lambda: kv.build_decode(
+                    self._model, *key, quant=self._quant,
+                    decode_kernel=self._decode_kernel))
             caches, tok, p, toks, lives = fn(
                 self._params, self._caches, jnp.asarray(self._tok),
                 jnp.asarray(self._p), jnp.asarray(self._active),
@@ -806,6 +822,8 @@ class ServingEngine:
         # commonly reset_serving_stats() after warmup (which wiped the values
         # recorded at start()/cache creation)
         profiler.record_serving("kv_dtype", self._kv_dtype_str)
+        if self._decode_kernel_str is not None:
+            profiler.record_serving("decode_kernel", self._decode_kernel_str)
         profiler.record_serving("kv_bytes_resident",
                                 kv.cache_nbytes(self._caches))
         profiler.record_serving_occupancy(n_active, self.slots)
@@ -837,6 +855,12 @@ class ServingEngine:
             # per dispatch into the serving/token_ms histogram
             profiler.record_serving(
                 "token_ms_last", (now - t_dispatch) * 1e3 / emitted_total)
+            # decode-only throughput series: full dispatch wall + its token
+            # yield, so decode_tokens / decode_ms_total excludes prefill and
+            # scheduler time (the quant_decode_speedup denominator)
+            profiler.record_serving("decode_ms_last",
+                                    (now - t_dispatch) * 1e3)
+            profiler.record_serving("decode_tokens", emitted_total)
 
     def _retire(self, slot: int, state: str, now: float) -> None:
         req = self._reqs[slot]

@@ -68,16 +68,18 @@ def _kv_mode(quant) -> Optional[str]:
     return getattr(quant, "kv", None)
 
 
-def _step_fn(model, S: int, TOT: int, quant):
+def _step_fn(model, S: int, TOT: int, quant, decode_kernel=None):
     """The decode-step builder both compiled programs share: the model's
     own ``serving_step`` on the fp32 path, its quantized twin
     (``mxtpu.quant.serve.build_step``) when a spec is active. Selected at
-    BUILD time — the engine holds one spec for life, so program-cache keys
-    stay (slots, bucket, chunk) exactly as before."""
+    BUILD time — the engine holds one spec (and one resolved
+    ``decode_kernel``) for life, so program-cache keys stay (slots, bucket,
+    chunk) exactly as before and ``MXTPU_DECODE_KERNEL`` flips between
+    dispatches can never retrace a live program."""
     if quant is not None and not isinstance(quant, str) \
             and getattr(quant, "enabled", False):
         from ..quant.serve import build_step
-        return build_step(model, S, TOT, quant)
+        return build_step(model, S, TOT, quant, decode_kernel=decode_kernel)
     return model.serving_step(S, TOT)
 
 
@@ -157,7 +159,8 @@ def block_nbytes(model, dtype=jnp.float32, quant=None) -> int:
                            _kv_mode(quant))
 
 
-def build_prefill_chunk(model, PB: int, csize: int, quant=None):
+def build_prefill_chunk(model, PB: int, csize: int, quant=None,
+                        decode_kernel=None):
     """One compiled B=1 prefill CHUNK program for (prompt bucket ``PB``,
     chunk size ``csize``): scans :meth:`serving_step` over positions
     ``start .. start+csize-1``, forcing prompt tokens while ``t < t0`` and
@@ -180,8 +183,10 @@ def build_prefill_chunk(model, PB: int, csize: int, quant=None):
     sampled and a greedy request share this one program. ``quant`` (a
     :class:`~mxtpu.quant.serve.QuantSpec`) swaps in the quantized step —
     the page is then a :class:`QuantKV` and ``params`` come from
-    ``quantize_lm``; the scan/carry structure is identical."""
-    step = _step_fn(model, 1, PB, quant)
+    ``quantize_lm``; the scan/carry structure is identical.
+    ``decode_kernel`` pins the fused attention-read path (see
+    :func:`_step_fn`)."""
+    step = _step_fn(model, 1, PB, quant, decode_kernel)
     sample = model.serving_sample()
 
     def run(params, page, prompt, t0, start, prev, temp, topk, seed):
@@ -201,7 +206,8 @@ def build_prefill_chunk(model, PB: int, csize: int, quant=None):
     return jax.jit(run)
 
 
-def build_decode(model, S: int, TOT: int, chunk: int, quant=None):
+def build_decode(model, S: int, TOT: int, chunk: int, quant=None,
+                 decode_kernel=None):
     """One compiled continuous-batching decode program for (slots ``S``,
     KV bucket ``TOT``): ``chunk`` decode steps over the slot batch with all
     per-slot state — token, position, active flag, live limit, and the
@@ -220,8 +226,9 @@ def build_decode(model, S: int, TOT: int, chunk: int, quant=None):
     sample; ``temp > 0`` samples with a key derived from (seed, position),
     so a request's stream is deterministic per seed no matter how it was
     scheduled. ``quant`` swaps in the quantized step (``caches`` is then a
-    :class:`QuantKV` pytree riding the same scan carry)."""
-    step = _step_fn(model, S, TOT, quant)
+    :class:`QuantKV` pytree riding the same scan carry); ``decode_kernel``
+    pins its fused attention-read path (see :func:`_step_fn`)."""
+    step = _step_fn(model, S, TOT, quant, decode_kernel)
     sample = model.serving_sample()
 
     def run(params, caches, tok, p, active, limit, temp, topk, seed):

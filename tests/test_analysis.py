@@ -367,6 +367,50 @@ def test_r006_suppressed():
 
 
 # ---------------------------------------------------------------------------
+# R007 quant-cache-materialize
+# ---------------------------------------------------------------------------
+
+def test_r007_positive_flags_cache_dequantize_in_step():
+    findings = _lint("""
+        import jax
+        def step(params, caches, tok):
+            kv = caches.dequantize()            # full-precision view per step
+            return attend(params, kv, tok)
+        f = jax.jit(step, donate_argnums=(1,))
+    """, select=["R007"])
+    assert len(findings) == 1
+    assert findings[0].rule == "R007"
+    assert "dequant_attention_decode" in findings[0].message
+
+
+def test_r007_negative_outside_step_and_fused_read():
+    assert _rules_hit("""
+        import jax
+        from mxtpu.ops import quant_attention
+        from mxtpu.quant import kv_quant
+        def debug_dump(caches):
+            return caches.dequantize()          # host-side debugging: fine
+        def step(params, caches, tok):
+            x = kv_quant.dequantize_rows(params["embed_q"][tok],
+                                         params["embed_s"][tok])  # one row
+            return quant_attention.dequant_attention_decode(
+                x, caches.data, caches.scale, caches.data, caches.scale,
+                tok, scale=1.0)
+        f = jax.jit(step)
+    """, select=["R007"]) == set()
+
+
+def test_r007_suppressed():
+    findings = _lint("""
+        import jax
+        def step(caches):
+            return caches.dequantize()  # mxtpu: ignore[R007]
+        f = jax.jit(step)
+    """, select=["R007"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # linter plumbing
 # ---------------------------------------------------------------------------
 

@@ -99,6 +99,54 @@ def test_flash_pallas_backward_matches_reference(D, causal):
     np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fused_backward_matches_split(monkeypatch, causal):
+    """MXTPU_FLASH_BWD=fused (ISSUE 16 retune): the one-pass fused backward
+    (dq + dk/dv per tile in a single grid) is bit-identical to the split
+    pair — same f32 tile math, same accumulation order."""
+    from mxtpu.ops.attention import _flash_backward_pallas
+    B, H, T, D = 1, 2, 256, 64
+    q, k, v = _qkv(B=B, H=H, T=T, D=D, seed=6)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    scale = 1.0 / np.sqrt(D)
+    g = jnp.asarray(
+        np.random.RandomState(7).randn(B, H, T, D).astype(np.float32))
+    out, lse = _flash_attention_pallas(qa, ka, va, causal=causal, scale=scale,
+                                       interpret=True)
+    monkeypatch.delenv("MXTPU_FLASH_BWD", raising=False)
+    split = _flash_backward_pallas(qa, ka, va, out, lse, g, causal, scale,
+                                   interpret=True)
+    monkeypatch.setenv("MXTPU_FLASH_BWD", "fused")
+    fused = _flash_backward_pallas(qa, ka, va, out, lse, g, causal, scale,
+                                   interpret=True)
+    for s, f in zip(split, fused):
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(f))
+
+
+def test_flash_backward_bf16_lse_stays_close(monkeypatch):
+    """MXTPU_FLASH_LSE=bf16 (ISSUE 16 retune): rounding the streamed
+    lse/delta rows to bf16 perturbs grads by O(2^-8) relative — close, but
+    deliberately NOT exact, which is why it is opt-in."""
+    from mxtpu.ops.attention import _flash_backward_pallas
+    B, H, T, D = 1, 2, 256, 64
+    q, k, v = _qkv(B=B, H=H, T=T, D=D, seed=8)
+    qa, ka, va = map(jnp.asarray, (q, k, v))
+    scale = 1.0 / np.sqrt(D)
+    g = jnp.asarray(
+        np.random.RandomState(9).randn(B, H, T, D).astype(np.float32))
+    out, lse = _flash_attention_pallas(qa, ka, va, causal=True, scale=scale,
+                                       interpret=True)
+    monkeypatch.delenv("MXTPU_FLASH_LSE", raising=False)
+    exact = _flash_backward_pallas(qa, ka, va, out, lse, g, True, scale,
+                                   interpret=True)
+    monkeypatch.setenv("MXTPU_FLASH_LSE", "bf16")
+    low = _flash_backward_pallas(qa, ka, va, out, lse, g, True, scale,
+                                 interpret=True)
+    for e, l in zip(exact, low):
+        mag = float(jnp.max(jnp.abs(e))) + 1e-9
+        assert float(jnp.max(jnp.abs(e - l))) / mag < 0.05
+
+
 def test_nd_attention_op_and_grad():
     q, k, v = _qkv(T=8)
     qn, kn, vn = nd.array(q), nd.array(k), nd.array(v)

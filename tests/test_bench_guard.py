@@ -49,7 +49,8 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     # every fallback scenario must keep emitting its keys
     assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
             "compile_caches", "mfu", "trace", "fsdp", "serving",
-            "elastic", "quant", "observability", "ratchet"} <= set(doc)
+            "elastic", "quant", "long_context", "observability",
+            "ratchet"} <= set(doc)
     # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
     # mid-epoch crash survived by a supervised restart, final params equal
     # to the fault-free baseline
@@ -138,6 +139,26 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
         == quant["kv_bytes_shrink"]
     assert doc["ratchet"]["current"]["quant_decode_speedup"] \
         == quant["quant_decode_speedup"]
+    # fused dequant-attention decode (ISSUE 16): the quant leg A/Bs BOTH
+    # decode-kernel variants token-exactly, each probe reporting which
+    # kernel actually served its decode steps
+    variants = quant["int8_kv"]["variants"]
+    assert set(variants) == {"pallas", "xla"}
+    for kern, leg in variants.items():
+        assert leg["decode_kernel"] == kern, variants
+        assert leg["decode_steps"] > 0
+    assert quant["quant_decode_speedup"] > 0
+    assert quant["decode_step_ms_fp32"] > 0
+    assert quant["decode_step_ms_int8_kv"] > 0
+    # long-context leg (ISSUE 16): T2048 + T4096 MFU points emitted and
+    # mfu_t2048 rides the ratchet next to quant_decode_speedup
+    lctx = doc["long_context"]
+    assert "error" not in lctx, lctx
+    for key in ("t2048", "t4096"):
+        assert lctx[key]["step_ms"] > 0
+        assert lctx[key]["tokens_s"] > 0
+    assert lctx["mfu_t2048"] is not None and lctx["mfu_t2048"] > 0
+    assert doc["ratchet"]["current"]["mfu_t2048"] == lctx["mfu_t2048"]
     # elastic leg (ISSUE 11): one live in-place dp shrink mid-fit — no
     # restart, no steps lost, bit-exact with a cold resume — and a serving
     # drain/adopt handoff that dropped nothing
@@ -197,10 +218,10 @@ def test_bench_leg_failure_yields_partial_json(tmp_path):
     doc, p = _run_fallback_bench(tmp_path, extra_env={
         # input_pipeline: fails every attempt → retries exhaust → error leg
         # zero_dp: fails once → the transient retry policy must recover it
-        # quant: fails every attempt too — a second exhausted leg, and it
-        # keeps this scenario fast (the quant leg is benched for real by
-        # the fallback test above and the quant CLI scenario)
-        "MXTPU_BENCH_FAIL_LEG": "input_pipeline,quant,zero_dp:1",
+        # quant + long_context: fail every attempt too — more exhausted
+        # legs, and they keep this scenario fast (both are benched for real
+        # by the fallback test above / their CLI scenarios)
+        "MXTPU_BENCH_FAIL_LEG": "input_pipeline,quant,long_context,zero_dp:1",
         "MXTPU_BENCH_RETRY_BACKOFF_S": "0.01",
         "MXTPU_RETRY_BACKOFF_MAX_S": "0.05",
     })
@@ -208,6 +229,7 @@ def test_bench_leg_failure_yields_partial_json(tmp_path):
     assert "UNAVAILABLE" in doc["input_pipeline"]["error"]
     assert doc["input_pipeline"]["retried"] is True
     assert "error" in doc["quant"]
+    assert "error" in doc["long_context"]
     # the retried leg recovered — full payload, no error key
     assert "error" not in doc["zero_dp"]
     assert doc["zero_dp"]["zero1"]["step_ms"] > 0
@@ -287,6 +309,12 @@ def test_bench_quant_scenario_cli(tmp_path):
     assert quant["kv_block_shrink"] == pytest.approx(
         quant["kv_bytes_shrink"], rel=0.01)
     assert quant["quant_matmul_sites"] > 0
+    # both fused decode-kernel variants served token-exactly (ISSUE 16)
+    variants = quant["int8_kv"]["variants"]
+    assert set(variants) == {"pallas", "xla"}
+    for kern, leg in variants.items():
+        assert leg["decode_kernel"] == kern
+        assert leg["decode_match"] == 2
     cur = doc["ratchet"]["current"]
     assert cur["kv_bytes_shrink"] == quant["kv_bytes_shrink"]
     assert cur["quant_decode_speedup"] == quant["quant_decode_speedup"]
@@ -318,8 +346,17 @@ def test_bench_sanitized_leg_exits_zero_with_no_violations(tmp_path):
     JSON block, and report ZERO violations — the committed training/
     checkpoint/input-pipeline paths are sanitizer-clean by contract. The
     scope now also runs one TRACED leg (ISSUE 6 satellite): sanitizers +
-    tracing compose, still with zero violations."""
-    doc, _ = _run_fallback_bench(tmp_path, args=("--sanitize",))
+    tracing compose, still with zero violations.
+
+    The long_context leg is failed out via the injection seam: the
+    sanitize contract lives entirely in ``bench_sanitizer``'s own leg (the
+    other fallback legs run unsanitized), and the long-context points pay
+    two long-T compiles that the fallback test above already covers."""
+    doc, _ = _run_fallback_bench(tmp_path, args=("--sanitize",), extra_env={
+        "MXTPU_BENCH_FAIL_LEG": "long_context",
+        "MXTPU_BENCH_RETRY_BACKOFF_S": "0.01",
+        "MXTPU_RETRY_BACKOFF_MAX_S": "0.05",
+    })
     san = doc["sanitizer"]
     assert san["violations"] == 0, san
     assert set(san["modes"]) == {"transfers", "donation", "retrace",
