@@ -1732,6 +1732,9 @@ def apply_ratchet(doc: dict, harness: str):
         obs_block = doc.get("observability")
         telemetry_inv = obs_block.get("overhead_inv") \
             if isinstance(obs_block, dict) else None
+        traffic_block = doc.get("traffic")
+        goodput_slo = traffic_block.get("goodput_under_slo") \
+            if isinstance(traffic_block, dict) else None
         metric_name = doc.get("metric") or ""
         img_val = doc.get("value") if metric_name.endswith("imgs_per_sec") \
             else None
@@ -1746,7 +1749,8 @@ def apply_ratchet(doc: dict, harness: str):
                          ("kv_bytes_shrink", kv_shrink),
                          ("quant_decode_speedup", quant_speedup),
                          ("mfu_t2048", mfu_t2048),
-                         ("telemetry_overhead_inv", telemetry_inv)):
+                         ("telemetry_overhead_inv", telemetry_inv),
+                         ("goodput_under_slo", goodput_slo)):
             if isinstance(val, (int, float)) and val > 0:
                 metrics[key] = val
         path = _ratchet_path()
@@ -2017,6 +2021,200 @@ def _bench_serving_prefix(net, vocab: int, smoke: bool):
         f"{base['ttft_p99_ms']:.1f} ms "
         f"({doc['ttft_p99_improvement']:.2f}x), hit rate "
         f"{chunked['hit_rate']:.2f}, match={doc['decode_match']}")
+    return doc
+
+
+def bench_traffic(smoke: bool = False):
+    """Multi-tenant traffic-replay scenario (ISSUE 17): the SAME seeded
+    bursty arrival trace (``mxtpu.sched.replay``) — three tenants with
+    shared per-tenant prefixes, a batch-tier bulk tenant flooding the burst
+    windows while interactive chat requests arrive inside them — replayed
+    against two engines:
+
+    * **fifo** — the plain engine (``sched=None``): arrival order is
+      admission order, so interactive requests queue behind the bulk flood;
+    * **sched** — the SLO control plane on (``sched=True``, batched
+      prefill): strict tier priority + weighted fair share admits the
+      interactive arrivals first, preempting bulk decode slots when
+      saturated (parked KV, bit-exact on resume).
+
+    Headline is the sched leg's ``goodput_under_slo`` — tokens of requests
+    that completed inside their tenant's latency budget, per second of
+    replay span (the metric the BENCH_BASELINE ratchet tracks). Greedy
+    decode is asserted bit-exact against solo ``generate`` in BOTH legs
+    (preemption/batching must never buy latency with drift). A dry-run
+    :class:`~mxtpu.sched.autoscale.Autoscaler` consumes the sched leg's
+    stats snapshots on a fake clock, so the telemetry->decision loop runs
+    end to end every bench run. All compiles happen in warmup with a
+    non-shared prompt, off the clock."""
+    import jax  # noqa: F401
+
+    import mxtpu as mx
+    from mxtpu import nd, profiler
+    from mxtpu.gluon.model_zoo import transformer_lm
+    from mxtpu.sched import (Autoscaler, AutoscalePolicy, TenantProfile,
+                             make_trace)
+    from mxtpu.serving import ServingEngine
+
+    mx.rng.seed(0)
+    vocab = 50
+    net = transformer_lm("tiny", vocab_size=vocab)
+    net.initialize()
+
+    # latency budgets (measure-only: goodput accounting, not engine
+    # deadlines — expiry would truncate decodes and void decode_match)
+    budgets = {"chat": 2.0, "app": 4.0, "bulk": 10.0}
+    tenants = (
+        TenantProfile("chat", priority="interactive", share=1.0,
+                      prefix_len=32, suffix_len=5, max_new=10),
+        TenantProfile("app", priority="standard", share=1.0,
+                      prefix_len=32, suffix_len=7, max_new=16),
+        TenantProfile("bulk", priority="batch", share=2.0,
+                      prefix_len=32, suffix_len=9, max_new=64),
+    )
+    # bulk totals (41 + 64 = 105) overflow the 64-token admission bucket, so
+    # a burst of bulk requests holds BOTH decode slots for many chunks —
+    # chat/app complete at admission, and an interactive arrival inside a
+    # burst exercises the preempt/park/resume path instead of a no-op
+    trace = make_trace("bursty", seed=5, rate=40.0 if smoke else 60.0,
+                       duration_s=0.6 if smoke else 1.2, vocab=vocab,
+                       tenants=tenants)
+    slots, chunk = 2, 8
+
+    # solo reference pass: bit-exact continuations + the compile warmup for
+    # the generate program (off every leg's clock)
+    refs = []
+    for tr in trace.requests:
+        out = np.asarray(net.generate(
+            nd.array(np.array([list(tr.prompt)], np.int32)),
+            tr.max_new).data)
+        refs.append(out[0, len(tr.prompt):].tolist())
+
+    max_total = max(len(t.prompt) + t.max_new for t in trace.requests)
+    rs = np.random.RandomState(23)
+    warm_prompt = rs.randint(1, vocab, size=37).tolist()  # same PB bucket,
+    warm_new = max_total - len(warm_prompt)               # non-shared prefix
+    warm_solo = rs.randint(1, vocab, size=37).tolist()
+    warm_hit = [warm_prompt[:32] + rs.randint(1, vocab, size=5).tolist()
+                for _ in range(3)]
+
+    def leg(sched):
+        eng = ServingEngine(net, slots=slots, chunk=chunk,
+                            queue_depth=len(trace) + 4,
+                            sched=True if sched else None,
+                            prefill_batch=2 if sched else None)
+        eng.start()
+
+        def warm(lead, pair=None):
+            base = profiler.get_serving_stats()["admitted"]
+            ws = [eng.submit(*lead, tenant="warm", priority="standard")]
+            if pair:
+                # let the lead be admitted SOLO (scalar path); its prefill
+                # program compiles on this first dispatch, and the pair
+                # queues up behind it, so both land in ONE batched group
+                while profiler.get_serving_stats()["admitted"] == base:
+                    time.sleep(0.001)
+                ws += [eng.submit(p, n, tenant="warm", priority="standard")
+                       for p, n in pair]
+            for w in ws:
+                w.result(timeout=300)
+
+        # warm every program variant the replay will hit, off the clock:
+        #   wave 1: scalar miss (PB,PB); batched miss (N,PB,PB); decode at
+        #           the max TOT bucket (the pair's totals overflow PB)
+        #   wave 2: scalar prefix-hit (PB,PB-32) — the wave-1 pair seeded
+        #           the warm prefix block — then the batched-hit twin
+        if sched:
+            warm((warm_solo, 8),
+                 [(warm_prompt, warm_new), (warm_prompt, warm_new)])
+            warm((warm_hit[2], 8), [(warm_hit[0], 8), (warm_hit[1], 8)])
+        else:
+            warm((warm_prompt, warm_new))
+            warm((warm_hit[2], 8))
+        profiler.reset_serving_stats()
+        scaler = Autoscaler(AutoscalePolicy(breach_ticks=2, cooldown_s=5.0),
+                            dry_run=True) if sched else None
+        t_base = time.monotonic()
+        reqs = []
+        for tr in trace.requests:
+            wait = tr.t - (time.monotonic() - t_base)
+            if wait > 0:
+                time.sleep(wait)
+            reqs.append(eng.submit(list(tr.prompt), tr.max_new,
+                                   tenant=tr.tenant, priority=tr.priority))
+            if scaler is not None:
+                scaler.step(profiler.get_serving_stats(), now=tr.t)
+        outs = [r.result(timeout=600) for r in reqs]
+        span = time.monotonic() - t_base
+        stats = profiler.get_serving_stats()
+        eng.stop()
+
+        match = all(o == r for o, r in zip(outs, refs))
+        by_tier = {}
+        ok_tokens = 0
+        for tr, r in zip(trace.requests, reqs):
+            lat = r.t_done - r.t_submit
+            if lat <= budgets[tr.tenant]:
+                ok_tokens += tr.max_new
+            by_tier.setdefault(tr.priority, []).append(
+                (r.t_first_token - r.t_submit) * 1e3)
+        tiers = {tier: {"n": len(v),
+                        "ttft_p50_ms": float(np.percentile(v, 50)),
+                        "ttft_p99_ms": float(np.percentile(v, 99))}
+                 for tier, v in by_tier.items()}
+        out = {
+            "goodput_under_slo": ok_tokens / span if span else 0.0,
+            "span_s": round(span, 3),
+            "decode_match": bool(match),
+            "ttft_by_tier": tiers,
+            "slot_occupancy": stats.get("slot_occupancy"),
+            "preempted": stats.get("preempted"),
+            "resumed": stats.get("resumed"),
+            "shed": stats.get("shed"),
+            "prefill_groups": stats.get("prefill_groups"),
+            "prefix_hits": stats.get("prefix_hits"),
+            "prefix_partial_hits": stats.get("prefix_partial_hits"),
+        }
+        if scaler is not None:
+            table = scaler.decision_table()
+            out["autoscale_dry_run"] = {
+                "ticks": len(table),
+                "actions": {a: sum(1 for d in table if d["action"] == a)
+                            for a in ("scale_up", "scale_down", "hold")},
+                "actuated": any(d["actuated"] for d in table),  # must stay
+            }                                                   # False: dry
+        return out
+
+    fifo = leg(sched=False)
+    sched = leg(sched=True)
+    inter_fifo = fifo["ttft_by_tier"].get("interactive", {})
+    inter_sched = sched["ttft_by_tier"].get("interactive", {})
+    doc = {
+        "kind": trace.kind,
+        "requests": len(trace),
+        "tenants": {p.name: {"priority": p.priority, "share": p.share,
+                             "budget_s": budgets[p.name]}
+                    for p in tenants},
+        "slots": slots,
+        "chunk": chunk,
+        "fifo": fifo,
+        "sched": sched,
+        "goodput_under_slo": sched["goodput_under_slo"],
+        "goodput_vs_fifo": sched["goodput_under_slo"]
+        / max(fifo["goodput_under_slo"], 1e-9),
+        "interactive_ttft_p99_ms": inter_sched.get("ttft_p99_ms"),
+        "interactive_ttft_p99_vs_fifo": (
+            inter_fifo.get("ttft_p99_ms", 0.0)
+            / max(inter_sched.get("ttft_p99_ms", 0.0), 1e-9)),
+        "decode_match": fifo["decode_match"] and sched["decode_match"],
+    }
+    log(f"[traffic] {len(trace)} reqs ({trace.kind}): goodput under SLO "
+        f"{sched['goodput_under_slo']:.1f} tok/s (fifo "
+        f"{fifo['goodput_under_slo']:.1f}, "
+        f"{doc['goodput_vs_fifo']:.2f}x), interactive ttft p99 "
+        f"{inter_sched.get('ttft_p99_ms', 0):.1f} ms vs fifo "
+        f"{inter_fifo.get('ttft_p99_ms', 0):.1f} ms, preempted "
+        f"{sched['preempted']}, match={doc['decode_match']}")
     return doc
 
 
@@ -2353,6 +2551,27 @@ def _emit_serving_only(smoke: bool) -> None:
            "platform": jax.default_backend(),
            "serving": serving}
     apply_ratchet(doc, harness="serving")
+    print(json.dumps(doc))
+
+
+def _traffic_only() -> bool:
+    """``bench.py traffic`` — run just the multi-tenant SLO traffic-replay
+    scenario (fifo vs sched on one seeded bursty trace) and emit a
+    traffic-only JSON line (rides the same cpu-fallback re-exec as every
+    other flag)."""
+    return "traffic" in sys.argv[1:]
+
+
+def _emit_traffic_only(smoke: bool) -> None:
+    import jax
+    traffic = run_leg("traffic", bench_traffic, smoke=smoke)
+    doc = {"metric": "traffic_goodput_under_slo",
+           "value": (traffic.get("goodput_under_slo", 0.0)
+                     if isinstance(traffic, dict) else 0.0),
+           "unit": "SLO-met tokens/sec (sched leg)",
+           "platform": jax.default_backend(),
+           "traffic": traffic}
+    apply_ratchet(doc, harness="traffic")
     print(json.dumps(doc))
 
 
@@ -2848,6 +3067,9 @@ def bench_cpu_fallback():
     if _serving_only():
         _emit_serving_only(smoke)
         return
+    if _traffic_only():
+        _emit_traffic_only(smoke)
+        return
     if _elastic_only():
         _emit_elastic_only(smoke)
         return
@@ -2872,6 +3094,7 @@ def bench_cpu_fallback():
                    hidden=128 if smoke else 512)
     resil = run_leg("resilience", bench_resilience, smoke=smoke)
     serving = run_leg("serving", bench_serving, smoke=smoke)
+    traffic = run_leg("traffic", bench_traffic, smoke=smoke)
     elastic = run_leg("elastic", bench_elastic, smoke=smoke)
     quant = run_leg("quant", bench_quant, smoke=smoke)
     lctx = run_leg("long_context", bench_long_context, smoke=smoke)
@@ -2899,6 +3122,7 @@ def bench_cpu_fallback():
         "fsdp": fsdp,
         "resilience": resil,
         "serving": serving,
+        "traffic": traffic,
         "elastic": elastic,
         "quant": quant,
         "long_context": lctx,
@@ -2961,6 +3185,9 @@ def main():
     if _serving_only():
         _emit_serving_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
         return
+    if _traffic_only():
+        _emit_traffic_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
+        return
     if _elastic_only():
         _emit_elastic_only(os.environ.get("MXTPU_BENCH_SMOKE") == "1")
         return
@@ -2998,6 +3225,7 @@ def main():
     fsdp = run_leg("fsdp", bench_fsdp)
     resil = run_leg("resilience", bench_resilience)
     serving = run_leg("serving", bench_serving)
+    traffic = run_leg("traffic", bench_traffic)
     elastic = run_leg("elastic", bench_elastic)
     quant = run_leg("quant", bench_quant)
     lctx = run_leg("long_context", bench_long_context)
@@ -3040,6 +3268,7 @@ def main():
         "fsdp": fsdp,
         "resilience": resil,
         "serving": serving,
+        "traffic": traffic,
         "elastic": elastic,
         "quant": quant,
         "long_context": lctx,

@@ -7,9 +7,9 @@ catalog and the PR 2 / PR 4 incidents each one would have caught).
 """
 
 from . import (host_sync, donation, nondeterminism, thread_shared, excepts,
-               span_leak, quant_dequant)
+               span_leak, quant_dequant, unbounded_map)
 
 RULES = [host_sync, donation, nondeterminism, thread_shared, excepts,
-         span_leak, quant_dequant]
+         span_leak, quant_dequant, unbounded_map]
 
 __all__ = ["RULES"]

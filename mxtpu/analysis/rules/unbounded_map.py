@@ -1,0 +1,127 @@
+"""R008 unbounded-map: per-request dict growth with no eviction site.
+
+A serving-plane object that writes ``self.x[req.id] = ...`` on every
+request grows without bound unless *something* in the same class pops the
+entry when the request retires — the classic slow leak that only shows up
+as OOM after days of traffic. The SLO scheduler's ``_inflight`` map
+(``mxtpu.sched.policy``) is exactly this shape done right: ``register``
+grows it, ``forget`` pops it; delete the pop and the scheduler leaks one
+entry per request forever while every test still passes.
+
+Flagged: inside a class, a subscript store onto a ``self`` attribute
+(outside ``__init__``) whose key smells like a request identity
+(``something.id`` / ``something.rid`` / ``request_id``-style names) or
+whose attribute name itself hints at per-request/per-tenant tracking
+(``inflight`` / ``request`` / ``per_req``), when the class body contains
+NO shrink site for that attribute.
+
+Blessed (any one of these in the same class clears the attribute):
+
+* ``self.x.pop(...)`` / ``self.x.popitem()`` / ``self.x.clear()``;
+* ``del self.x[...]``;
+* rebinding ``self.x = ...`` outside ``__init__`` (periodic reset);
+* bounded-by-construction stores — key the dict by tenant/config and cap
+  it (as ``metrics.record_tenant`` does), then suppress with
+  ``# mxtpu: ignore[R008]`` and say why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding
+
+RULE_ID = "R008"
+TITLE = "unbounded-map"
+
+# key expressions that smell like a per-request identity
+_KEY_ATTRS = {"id", "rid", "request_id", "req_id"}
+_KEY_NAMES = {"rid", "request_id", "req_id"}
+# attribute names that declare per-request/per-tenant intent outright
+_NAME_HINTS = ("inflight", "in_flight", "request", "per_req", "per_tenant")
+
+_SHRINK_METHODS = {"pop", "popitem", "clear"}
+
+
+def _self_attr(node) -> str:
+    """``self.x`` -> ``'x'``, else ''."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _key_is_request_like(key) -> bool:
+    if isinstance(key, ast.Attribute) and key.attr in _KEY_ATTRS:
+        return True
+    return isinstance(key, ast.Name) and key.id in _KEY_NAMES
+
+
+def _method_of(cls: ast.ClassDef, node, ctx):
+    """Nearest enclosing function of ``node`` that is a direct method of
+    ``cls`` (None for class-level / nested-beyond-method code)."""
+    fn = None
+    for a in ctx.ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = a
+        if a is cls:
+            return fn
+    return None
+
+
+def _shrunk_attrs(cls: ast.ClassDef, ctx) -> set:
+    """Self attributes the class body ever shrinks (pop/clear/del/rebind
+    outside __init__)."""
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SHRINK_METHODS:
+            attr = _self_attr(node.func.value)
+            if attr:
+                out.add(attr)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr:
+                        out.add(attr)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr:
+                    fn = _method_of(cls, node, ctx)
+                    if fn is not None and fn.name != "__init__":
+                        out.add(attr)     # periodic reset counts as a bound
+    return out
+
+
+def check(ctx):
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        shrunk = _shrunk_attrs(cls, ctx)
+        seen = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Subscript):
+                    continue
+                attr = _self_attr(t.value)
+                if not attr or attr in shrunk or attr in seen:
+                    continue
+                named = any(h in attr.lower() for h in _NAME_HINTS)
+                if not (named or _key_is_request_like(t.slice)):
+                    continue
+                fn = _method_of(cls, node, ctx)
+                if fn is None:
+                    continue
+                seen.add(attr)
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, RULE_ID,
+                    f"{TITLE}: `self.{attr}[...]` grows per request but "
+                    f"class `{cls.name}` never pops/clears/rebinds it — "
+                    f"one leaked entry per request until OOM; evict on "
+                    f"retire (pop in the forget/retire path) or cap and "
+                    f"suppress with a reason")

@@ -50,6 +50,7 @@ def collect_snapshot() -> dict:
     from . import metrics
     snap = {
         "serving": metrics.get_serving_stats(),
+        "sched": metrics.get_sched_stats(),
         "quant": metrics.get_quant_stats(),
         "comm": metrics.get_comm_stats(),
         "feed": metrics.get_feed_stats(),

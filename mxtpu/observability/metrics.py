@@ -325,8 +325,20 @@ _SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
                  # cache-eligible block (prompt > 32 tokens); hit_tokens is
                  # the positions whose prefill was skipped
                  "prefix_hits": 0, "prefix_misses": 0, "prefix_hit_tokens": 0,
+                 # partial-block reuse: hits whose matched length ends inside
+                 # a 32-token block (token-granular tail rows copied from a
+                 # cached child block); partial_tokens is the sub-block
+                 # positions saved, already included in prefix_hit_tokens
+                 "prefix_partial_hits": 0, "prefix_partial_tokens": 0,
                  "prefix_inserts": 0, "prefix_evictions": 0,
                  "prefix_cache_bytes": 0,
+                 # SLO control plane (mxtpu.sched): requests shed before
+                 # their deadline, decode slots preempted for a higher tier,
+                 # parked requests resumed
+                 "shed": 0, "preempted": 0, "resumed": 0,
+                 # batched prefill admissions (mxtpu.sched.admission): one
+                 # count per PrefillGroup launched, not per member
+                 "prefill_groups": 0,
                  # live elasticity: requests carried across an engine
                  # drain()/adopt() handoff (zero-drop contract)
                  "drained": 0, "adopted": 0,
@@ -400,6 +412,41 @@ def record_serving(key: str, n=1):
             _serving[key] += n
 
 
+# per-tenant serving series (mxtpu.sched satellite): counters here, latency
+# samples in the histogram store under "serving/tenant/<t>/<base>" (the
+# "serving/" prefix keeps them inside reset_serving_stats' blast radius and
+# gets them exported as quantile gauges for free). Cardinality is BOUNDED:
+# past _TENANT_CAP distinct tenants, everything folds into "__other__" so a
+# tenant-id-per-user caller can't grow the store (or the Prometheus page)
+# without bound.
+_TENANT_CAP = 32
+_OTHER_TENANT = "__other__"
+_tenants: Dict[str, Dict[str, float]] = {}
+
+
+def _tenant_key(tenant: str) -> str:
+    t = str(tenant)
+    if t not in _tenants and len(_tenants) >= _TENANT_CAP:
+        return _OTHER_TENANT
+    return t
+
+
+def record_tenant(tenant: str, key: str, n=1):
+    """One per-tenant serving sample. ``*_ms_last`` keys are histogram
+    samples (``serving/tenant/<t>/<base>``: TTFT, goodput latency);
+    everything else accumulates in the tenant's counter row (tokens_out,
+    completed, shed, ...)."""
+    if key.endswith("_ms_last"):
+        with _stats_lock:
+            t = _tenant_key(tenant)
+            _tenants.setdefault(t, {})
+        _hist.record_value(f"serving/tenant/{t}/{key[:-8]}", float(n))
+        return
+    with _stats_lock:
+        row = _tenants.setdefault(_tenant_key(tenant), {})
+        row[key] = row.get(key, 0) + n
+
+
 def record_serving_occupancy(active_slots: int, total_slots: int):
     """One decode-step occupancy sample (active slots / capacity) — the
     utilization series behind ``get_serving_stats()['slot_occupancy']``."""
@@ -442,13 +489,58 @@ def get_serving_stats() -> dict:
             out[base + "_count"] = 0
             for _q, name in _hist.QUANTILES:
                 out[f"{base}_{name}"] = 0.0
+    # per-tenant series (only when something recorded them — the plain
+    # engine's stats dict is unchanged): counters + quantiles of every
+    # "serving/tenant/<t>/<base>" histogram (read outside _stats_lock)
+    with _stats_lock:
+        tenants = {t: dict(row) for t, row in _tenants.items()}
+    if tenants:
+        for name, s in _hist.get_histogram_stats().items():
+            if not name.startswith("serving/tenant/"):
+                continue
+            _, _, rest = name.partition("serving/tenant/")
+            t, _, base = rest.partition("/")
+            if t in tenants and base:
+                tenants[t][base + "_count"] = s["count"]
+                for _q, qname in _hist.QUANTILES:
+                    tenants[t][f"{base}_{qname}"] = s[qname]
+        out["tenants"] = tenants
     return out
 
 
 def reset_serving_stats():
     with _stats_lock:
         _serving.update(_SERVING_ZERO)
+        _tenants.clear()
     _hist.reset_histograms(prefix="serving/")
+
+
+# ---------------------------------------------------------------------------
+# SLO scheduler observability (mxtpu.sched control plane)
+# ---------------------------------------------------------------------------
+
+# assign-style snapshot store: the engine pushes SLOScheduler.stats() (picks/
+# sheds/preemptions/resumes, fair-share tenant count, service-rate EWMAs) and
+# the autoscaler its latest decision — the exporter serves whatever was
+# pushed last, so a scrape never calls back into the scheduler thread
+_sched: Dict[str, object] = {}
+
+
+def record_sched(stats: Dict[str, object]):
+    """Replace-merge the scheduler/autoscaler snapshot block served at
+    ``collect_snapshot()['sched']``."""
+    with _stats_lock:
+        _sched.update(stats)
+
+
+def get_sched_stats() -> dict:
+    with _stats_lock:
+        return dict(_sched)
+
+
+def reset_sched_stats():
+    with _stats_lock:
+        _sched.clear()
 
 
 # ---------------------------------------------------------------------------

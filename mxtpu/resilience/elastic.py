@@ -125,6 +125,14 @@ class ElasticRun:
         with self._lock:
             self._pending = -1 if dp is None else int(dp)
 
+    @property
+    def pending_resize(self) -> bool:
+        """True while a requested resize has not yet been served — actuators
+        (e.g. the serving autoscaler) poll this to avoid stacking a second
+        resize on one that is still in flight."""
+        with self._lock:
+            return self._pending is not None
+
     def install_signal_handler(self,
                                signum: int = signal_mod.SIGTERM,
                                dp: Union[None, int, Callable[[], int]] = None

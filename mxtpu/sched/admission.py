@@ -1,0 +1,169 @@
+"""Batched prefill admission: several pending prompts, one chunk program.
+
+The plain engine admits one prompt at a time — each scheduler turn
+advances ONE partial prefill by one fixed-budget chunk (``kv.build_
+prefill_chunk``, B=1). Under a burst that serializes time-to-first-token
+across the whole arrival wave. This module packs up to ``N`` pending
+prompts into the batch dimension of one compiled chunk program instead:
+
+* the program is keyed ``(N, PB, csize)`` — N is the engine's configured
+  ``prefill_batch`` (short groups are padded with inert rows) and PB the
+  group's max prompt bucket, so any mix of prompts retraces nothing;
+* every per-request quantity — prompt row, prompt length ``t0``, cursor,
+  previous token, and the sampling triple — rides as a traced ``(N,)``
+  vector, exactly like the decode program's slot state;
+* ONE position cursor is shared by all rows, starting at the SHALLOWEST
+  member's prefix-cache match. A member whose own match is deeper simply
+  recomputes its cached span: those positions are all forced prompt
+  positions (``t < t0``), so the recomputed K/V rows are bit-identical to
+  the installed cached rows and nothing is emitted for them — and since
+  the group must scan from the shallowest start anyway, the deep rows
+  ride along at zero wall-clock cost. Rows that run past their own work
+  (padding, overshoot) re-feed the token they last fed at the clamped
+  position ``PB - 1``, an identical-rewrite no-op for the same reason:
+  K/V at position ``p`` is a pure function of tokens ``0..p``.
+
+The cross-chunk carry is (page, prev, lastfed) — running the chunks back
+to back reproduces each member's monolithic prefill scan token for token,
+which is the same bit-exactness-by-construction argument
+``kv.build_prefill_chunk`` makes for B=1. :class:`PrefillGroup` owns the
+host-side cursors; the engine dispatches one chunk per scheduler turn
+(the decode-stall bound is unchanged — one chunk of work, now shared by
+up to N admissions).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..serving import kv
+from ..serving.kv import _step_fn
+
+__all__ = ["build_prefill_batch", "PrefillGroup"]
+
+
+def build_prefill_batch(model, N: int, PB: int, csize: int, quant=None,
+                        decode_kernel=None):
+    """One compiled batched prefill CHUNK program for (rows ``N``, prompt
+    bucket ``PB``, chunk size ``csize``). Returns ``run(params, page,
+    prompts (N, PB) i32, t0 (N,), start (N,), prev (N,), lastfed (N,),
+    temp (N,) f32, topk (N,) i32, seed (N,) u32) -> (page
+    (L,2,N,H,PB,D), prev, lastfed, outs (csize, N))`` where ``outs[j, n]``
+    is row ``n``'s token for position ``start[n] + j + 1``; the valid
+    generated tokens of a chunk are those with ``t0 - 1 <= start + j <
+    PB`` (per row, decided on the host from scalar cursors). ``quant`` /
+    ``decode_kernel`` select the quantized step and fused KV read exactly
+    as in :func:`~mxtpu.serving.kv.build_prefill_chunk`."""
+    step = _step_fn(model, N, PB, quant, decode_kernel)
+    sample = model.serving_sample()
+
+    def run(params, page, prompts, t0, start, prev, lastfed,
+            temp, topk, seed):
+        def body(carry, j):
+            page, prev, lastfed = carry
+            t = start + j
+            live = t < PB
+            pos = jnp.minimum(t, PB - 1)
+            ptok = jnp.take_along_axis(prompts, pos[:, None], axis=1)[:, 0]
+            fed = jnp.where(live, jnp.where(t < t0, ptok, prev), lastfed)
+            new_page, logits = step(params, page, fed, pos)
+            nxt = sample(logits, temp, topk, seed, pos)
+            return (new_page,
+                    jnp.where(live, nxt, prev),
+                    jnp.where(live, fed, lastfed)), nxt
+
+        (page, prev, lastfed), outs = lax.scan(
+            body, (page, prev, lastfed), jnp.arange(csize, dtype=jnp.int32))
+        return page, prev, lastfed, outs
+
+    return jax.jit(run)
+
+
+class PrefillGroup:
+    """Host-side cursor state for one in-flight batched prefill.
+
+    ``members`` is a list of per-request dicts (engine-owned shape:
+    ``req`` / ``slot`` / ``t0`` / ``start`` (prefix-match length) /
+    ``left`` / ``done`` / ``blocks`` (cached K/V rows, consumed here) /
+    sampling triple); row ``n`` of the traced vectors belongs to
+    ``members[n]``, rows past ``len(members)`` are padding — their
+    ``t0 = PB`` keeps them feeding forced token 0 into their own
+    discarded page row for the whole scan. All rows share ONE cursor
+    advanced by ``csize`` per dispatched chunk, starting at the
+    shallowest member's prefix match (see module docstring for why deeper
+    matches riding along is both correct and free)."""
+
+    def __init__(self, model, members: List[dict], N: int, PB: int,
+                 kv_dtype, quant):
+        if not members or len(members) > N:
+            raise ValueError(f"bad group size {len(members)} for batch {N}")
+        self.members = members
+        self.N, self.PB = N, PB
+        prompts = np.zeros((N, PB), np.int32)
+        t0 = np.full(N, PB, np.int32)
+        temp = np.zeros(N, np.float32)
+        topk = np.zeros(N, np.int32)
+        seed = np.zeros(N, np.uint32)
+        page = kv.empty_cache(model, N, PB, kv_dtype, quant)
+        for n, mem in enumerate(members):
+            req = mem["req"]
+            prompts[n, :len(req.prompt)] = req.prompt
+            t0[n] = mem["t0"]
+            temp[n], topk[n], seed[n] = (mem["temp"], mem["topk"],
+                                         mem["seed"])
+            blocks = mem.pop("blocks", None)
+            if mem["start"] and blocks:
+                row = kv.install_rows(
+                    kv.empty_page(model, PB, kv_dtype, quant),
+                    blocks, mem["start"])
+                page = kv.merge_page(page, row, n)
+        self.prompts = jnp.asarray(prompts)
+        self.t0_np = t0
+        self.t0 = jnp.asarray(t0)
+        self.temp, self.topk = jnp.asarray(temp), jnp.asarray(topk)
+        self.seed = jnp.asarray(seed)
+        self.prev = jnp.zeros(N, jnp.int32)
+        self.lastfed = jnp.zeros(N, jnp.int32)
+        self.page = page
+        # shallowest member's match, aligned DOWN to the 32-token block
+        # grid: a partial-block tail is re-fed as an identical rewrite,
+        # and the aligned cursor keeps the ("batch", N, PB, csize) program
+        # keys to at most PB/32 shapes (each distinct csize is a separate
+        # multi-second XLA compile)
+        lo = min(mem["start"] for mem in members)
+        self.cursor = lo - (lo % kv.PrefixCache.BLOCK)
+
+    def remaining(self) -> int:
+        """Positions still to scan before every member row is done."""
+        return max(self.PB - self.cursor, 0)
+
+    def chunk_inputs(self):
+        """Traced inputs for one dispatch of :func:`build_prefill_batch`
+        at the current cursor."""
+        start = jnp.full((self.N,), self.cursor, jnp.int32)
+        return (self.page, self.prompts, self.t0, start, self.prev,
+                self.lastfed, self.temp, self.topk, self.seed)
+
+    def valid_range(self, n: int, csize: int):
+        """Host-side emission rule for member ``n`` over the chunk just
+        dispatched: ``(j_lo, j_hi)`` indices into ``outs[:, n]`` (empty
+        when ``j_lo >= j_hi``). Valid tokens satisfy
+        ``t0 - 1 <= cursor + j < PB``."""
+        j_lo = max(int(self.t0_np[n]) - 1 - self.cursor, 0)
+        j_hi = min(csize, self.PB - self.cursor)
+        return j_lo, j_hi
+
+    def advance(self, page, prev, lastfed, csize: int) -> None:
+        self.page, self.prev, self.lastfed = page, prev, lastfed
+        self.cursor += csize
+
+    def member_page(self, n: int):
+        """Row ``n``'s finished ``(L, 2, 1, H, PB, D)`` page, ready for
+        ``kv.merge_page`` into a decode slot (or prefix-cache insert)."""
+        return kv.slot_page(self.page, n)

@@ -16,9 +16,9 @@ See ``docs/serving.md`` for architecture, knobs, and the latency/goodput
 methodology behind ``bench.py serving``.
 """
 
-from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING,
+from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING, SHED, TIERS,
                   DeadlineExceeded, QueueFullError, RequestCancelled,
-                  SamplingParams, ServingConfig, ServingRequest)
+                  SamplingParams, ServingConfig, ServingRequest, ShedError)
 from .chained import ChainedPredictor
 from .engine import ServingEngine, ServingHandoff
 from . import kv
@@ -26,4 +26,6 @@ from . import kv
 __all__ = ["ChainedPredictor", "ServingEngine", "ServingHandoff",
            "ServingRequest", "SamplingParams", "ServingConfig",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
-           "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "kv"]
+           "ShedError", "TIERS",
+           "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "SHED",
+           "kv"]

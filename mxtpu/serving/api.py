@@ -23,19 +23,35 @@ from typing import List, Optional
 
 __all__ = ["ServingRequest", "SamplingParams", "ServingConfig",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
-           "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED"]
+           "ShedError", "TIERS",
+           "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "SHED"]
 
 PENDING = "pending"        # admitted to the queue, not yet prefilled
 RUNNING = "running"        # occupying a decode slot (or mid-prefill)
 DONE = "done"              # every requested token delivered
 CANCELLED = "cancelled"    # caller cancelled (or the engine shut down)
 EXPIRED = "expired"        # deadline passed before completion
+SHED = "shed"              # SLO scheduler shed it BEFORE the deadline passed
 
-_TERMINAL = frozenset({DONE, CANCELLED, EXPIRED})
+_TERMINAL = frozenset({DONE, CANCELLED, EXPIRED, SHED})
+
+# priority tiers of the SLO scheduler (mxtpu.sched.policy), ordered most- to
+# least-latency-sensitive; a request's tier is static for its lifetime
+TIERS = ("interactive", "standard", "batch")
 
 
 class QueueFullError(RuntimeError):
     """Admission queue at capacity — the submit was rejected, not queued."""
+
+
+class ShedError(RuntimeError):
+    """The SLO scheduler (``mxtpu.sched``) shed this request under overload:
+    its deadline was predicted unmeetable from the measured service rates, so
+    it was rejected EARLY — before occupying a prefill cursor or decode slot
+    and before the deadline actually passed — instead of burning capacity on
+    work that would expire anyway. Distinct from :exc:`QueueFullError`
+    (queue capacity, load-independent of deadlines) and from
+    :exc:`DeadlineExceeded` (the deadline really passed)."""
 
 
 class RequestCancelled(RuntimeError):
@@ -87,7 +103,14 @@ class ServingConfig:
     ``'int8_kv,int8_w'`` (see ``docs/quantization.md``). ``decode_kernel``
     pins the fused dequant-attention read of a quantized KV cache
     (``'pallas'``/``'xla'``; the ``MXTPU_DECODE_KERNEL`` knob — None defers
-    down the chain to backend auto)."""
+    down the chain to backend auto).
+
+    ``sched`` installs the multi-tenant SLO control plane (``mxtpu.sched``):
+    ``True`` for the default :class:`~mxtpu.sched.policy.SLOPolicy`, or a
+    policy/scheduler instance; None keeps the plain FIFO engine
+    byte-identical to before. ``prefill_batch`` (> 1, sched mode only)
+    packs up to that many pending prompts into ONE batched prefill chunk
+    program per scheduler turn (``mxtpu.sched.admission``)."""
     slots: Optional[int] = None
     queue_depth: Optional[int] = None
     chunk: Optional[int] = None
@@ -97,6 +120,8 @@ class ServingConfig:
     kv_dtype: Optional[str] = None
     quant: object = None
     decode_kernel: Optional[str] = None
+    sched: object = None
+    prefill_batch: Optional[int] = None
 
 
 class ServingRequest:
@@ -109,12 +134,17 @@ class ServingRequest:
     optional :class:`SamplingParams` (default greedy), and
     ``prefix_cache=False`` opts this request out of shared-prefix KV reuse
     AND of inserting its own prefix (for privacy-sensitive prompts that
-    must not seed a cache other requests can hit)."""
+    must not seed a cache other requests can hit). ``tenant`` names the
+    submitting tenant (fair-share + per-tenant telemetry key) and
+    ``priority`` its latency tier (one of :data:`TIERS`) — both are inert
+    on a plain FIFO engine and drive admission order, preemption, and
+    shedding when the SLO scheduler (``mxtpu.sched``) is installed."""
 
     def __init__(self, prompt, max_new: int,
                  deadline_s: Optional[float] = None,
                  sampling: Optional[SamplingParams] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 tenant: str = "default", priority: str = "standard"):
         self.id = next(_ids)
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
@@ -127,6 +157,11 @@ class ServingRequest:
             sampling = SamplingParams(**dict(sampling))
         self.sampling = sampling
         self.use_prefix_cache = bool(prefix_cache)
+        self.tenant = str(tenant)
+        if priority not in TIERS:
+            raise ValueError(f"priority must be one of {TIERS}, "
+                             f"got {priority!r}")
+        self.priority = priority
         self.t_submit = time.monotonic()
         self.deadline = None if deadline_s is None \
             else self.t_submit + float(deadline_s)

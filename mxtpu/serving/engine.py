@@ -92,8 +92,8 @@ from ..quant.serve import parse_quant, quantize_lm
 from ..resilience.watchdog import Watchdog, heartbeat
 from ..step_cache import ProgramCache
 from . import kv
-from .api import (CANCELLED, DONE, EXPIRED, RUNNING, QueueFullError,
-                  ServingConfig, ServingRequest)
+from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING, SHED,
+                  QueueFullError, ServingConfig, ServingRequest)
 
 __all__ = ["ServingEngine", "ServingHandoff"]
 
@@ -113,15 +113,24 @@ class ServingHandoff:
     #   req / page (L,2,1,H,PB,D np) / t (cursor) / prev / t0 / PB / left —
     #   adopt() resumes the SUFFIX prefill, never re-prefills from scratch
     pending: List[ServingRequest] = field(default_factory=list)  # admitted,
-    #   never prefilled — re-staged verbatim by adopt()
+    #   never prefilled — re-staged verbatim by adopt(). The request handles
+    #   everywhere in this handoff carry their own scheduling metadata
+    #   (tenant / priority / deadline), so SLO state survives the hop
     kv_dtype: str = "float32"                 # page storage: 'float32' /
     #   'bfloat16' / 'int8' / 'fp8' — adopt() refuses a mismatched engine
     #   (quantized pages are QuantKV hosts; reinterpreting them as another
     #   storage would corrupt every resumed request)
+    parked: List[dict] = field(default_factory=list)  # preempted decode
+    #   slots (mxtpu.sched): same shape as `entries` plus the park-time
+    #   "tot" — adopt() re-queues them for resume, sched-enabled engines only
+    sched_state: Optional[dict] = None        # SLOScheduler.export_state():
+    #   fair-share passes + service-rate EWMAs, so the successor's policy
+    #   doesn't restart cold
 
     @property
     def in_flight(self) -> int:
-        return len(self.entries) + len(self.partial) + len(self.pending)
+        return (len(self.entries) + len(self.partial) + len(self.pending)
+                + len(self.parked))
 
 
 def _env_int(name: str, default: int) -> int:
@@ -160,6 +169,7 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache_mb: Optional[float] = None,
                  kv_dtype=None, quant=None, decode_kernel=None,
+                 sched=None, prefill_batch: Optional[int] = None,
                  config: Optional[ServingConfig] = None):
         if config is not None:
             slots = slots or config.slots
@@ -175,6 +185,10 @@ class ServingEngine:
                 quant = config.quant
             if decode_kernel is None:
                 decode_kernel = config.decode_kernel
+            if sched is None:
+                sched = config.sched
+            if prefill_batch is None:
+                prefill_batch = config.prefill_batch
         self._model = model
         # low-precision execution (mxtpu.quant): ONE spec per engine
         # lifetime, resolved kwarg > config > env — the program caches stay
@@ -240,6 +254,32 @@ class ServingEngine:
         self._pf: Optional[dict] = None
         self._prefix: Optional[kv.PrefixCache] = None
         self._evict_seen = 0
+        # SLO control plane (mxtpu.sched) — strictly opt-in: with sched
+        # unset every code path below is byte-identical to the plain FIFO
+        # engine (the sched package is imported only when enabled)
+        self._sched = None
+        if sched:
+            from ..sched.policy import SLOPolicy, SLOScheduler
+            if sched is True:
+                self._sched = SLOScheduler()
+            elif isinstance(sched, SLOScheduler):
+                self._sched = sched
+            elif isinstance(sched, SLOPolicy):
+                self._sched = SLOScheduler(sched)
+            else:
+                raise ValueError(
+                    "sched must be True, an SLOPolicy, or an SLOScheduler; "
+                    f"got {type(sched).__name__}")
+        self._prefill_batch = int(prefill_batch) if prefill_batch else 1
+        if self._prefill_batch > 1 and self._sched is None:
+            raise ValueError("prefill_batch > 1 requires the SLO scheduler "
+                             "(pass sched=True / a policy)")
+        # staged (req, prompt) pairs awaiting a fair-share pick; preempted
+        # decode slots parked for resume; in-flight batched prefill group
+        # (all scheduler-thread-owned, sched mode only)
+        self._sched_pending: List[tuple] = []
+        self._parked: List[dict] = []
+        self._pfg = None
 
     # -- public surface ------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -264,21 +304,27 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int,
                deadline_s: Optional[float] = None,
-               sampling=None, prefix_cache: bool = True) -> ServingRequest:
+               sampling=None, prefix_cache: bool = True,
+               tenant: str = "default",
+               priority: str = "standard") -> ServingRequest:
         """Enqueue one generation request; returns its handle immediately.
         ``sampling`` takes :class:`~mxtpu.serving.api.SamplingParams` (or a
         mapping of its fields; omitted = bit-exact greedy);
         ``prefix_cache=False`` opts the request out of shared-prefix KV
-        reuse in both directions. Raises :exc:`QueueFullError` when the
-        admission queue is at capacity (backpressure, not silent growth)
-        and ``ValueError`` for requests the model can't hold."""
+        reuse in both directions. ``tenant``/``priority`` are the SLO
+        scheduling keys (inert without ``sched=...``; see
+        :class:`~mxtpu.serving.api.ServingRequest`). Raises
+        :exc:`QueueFullError` when the admission queue is at capacity
+        (backpressure, not silent growth) and ``ValueError`` for requests
+        the model can't hold."""
         if self._draining.is_set():
             raise RuntimeError(
                 "ServingEngine is draining — submit to the adopting engine")
         if self._stop.is_set():
             raise RuntimeError("ServingEngine is stopped")
         req = ServingRequest(prompt, max_new_tokens, deadline_s,
-                             sampling=sampling, prefix_cache=prefix_cache)
+                             sampling=sampling, prefix_cache=prefix_cache,
+                             tenant=tenant, priority=priority)
         if req.total > self._model._max_len:
             raise ValueError(
                 f"prompt {len(req.prompt)} + {req.max_new} new exceeds "
@@ -351,6 +397,11 @@ class ServingEngine:
                 raise self._error     # sweep already ran in the scheduler
             try:
                 fault_point("serving.drain")
+                # an in-flight batched prefill group is finished HERE, one
+                # chunk per turn (bounded: the cursor only advances), so its
+                # survivors freeze below as ordinary in-slot entries
+                while self._pfg is not None:
+                    self._prefill_group_chunk()
                 now = time.monotonic()
                 entries: List[dict] = []
                 for slot in np.flatnonzero(self._active):
@@ -422,6 +473,13 @@ class ServingEngine:
                         pending.append(self._submit_q.get_nowait())
                     except queue.Empty:
                         break
+                # sched mode: staged-but-unpicked requests ride as pending;
+                # preempted (parked) slots host-land like entries
+                pending.extend(r for r, _s in self._sched_pending)
+                self._sched_pending = []
+                parked = [{**e, "page": kv.host_page(e["page"])}
+                          for e in self._parked]
+                self._parked = []
                 heartbeat("elastic")
             except BaseException:
                 self._shutdown_sweep()
@@ -430,17 +488,21 @@ class ServingEngine:
             self._feed.close()
         if self._wd is not None:
             self._wd.stop()
-        handoff = ServingHandoff(tot=self._TOT or 0, entries=entries,
-                                 partial=partial, pending=pending,
-                                 kv_dtype=self._kv_dtype_str)
+        handoff = ServingHandoff(
+            tot=self._TOT or 0, entries=entries, partial=partial,
+            pending=pending, kv_dtype=self._kv_dtype_str, parked=parked,
+            sched_state=self._sched.export_state()
+            if self._sched is not None else None)
         profiler.record_serving("drained", handoff.in_flight)
         tracer.instant("serving/drained", cat="serving",
                        args={"in_slots": len(entries),
                              "partial": len(partial),
                              "pending": len(pending),
+                             "parked": len(parked),
                              "ids": [e["req"].id for e in entries]
                              + [e["req"].id for e in partial]
-                             + [r.id for r in pending]})
+                             + [r.id for r in pending]
+                             + [e["req"].id for e in parked]})
         return handoff
 
     def adopt(self, handoff: ServingHandoff) -> "ServingEngine":
@@ -467,6 +529,20 @@ class ServingEngine:
                     f"handoff pages are {handoff.kv_dtype} but this engine "
                     f"stores KV as {self._kv_dtype_str} — adopt on an "
                     "engine with the same kv_dtype/quant configuration")
+            if handoff.parked and self._sched is None:
+                raise ValueError(
+                    "handoff carries preempted (parked) requests — adopt on "
+                    "an engine with the SLO scheduler enabled (sched=...)")
+            if self._sched is not None:
+                if handoff.sched_state:
+                    self._sched.load_state(handoff.sched_state)
+                # re-register every surviving handle so fair-share charging
+                # and R008-shaped inflight tracking pick up where drain left
+                for req in ([e["req"] for e in handoff.entries]
+                            + [e["req"] for e in handoff.partial]
+                            + [e["req"] for e in handoff.parked]):
+                    self._sched.register(req)
+                self._parked.extend(dict(e) for e in handoff.parked)
             if handoff.entries or handoff.partial:
                 self._materialize_params()
             if handoff.entries:
@@ -566,11 +642,14 @@ class ServingEngine:
         try:
             while not self._stop.is_set():
                 heartbeat("serving")
-                busy = bool(self._active.any()) or self._pf is not None
+                busy = bool(self._active.any()) or self._pf is not None \
+                    or self._pfg is not None
                 self._admit(wait_s=0.0 if busy else 0.02)
                 if self._pf is not None:
                     self._prefill_chunk()     # ONE chunk, then yield to
-                if self._active.any():        # decode: the stall bound
+                elif self._pfg is not None:   # decode: the stall bound
+                    self._prefill_group_chunk()
+                if self._active.any():
                     self._decode_chunk()
                 self._maybe_log()
         except BaseException as e:
@@ -584,10 +663,14 @@ class ServingEngine:
             if self._error is not None or not self._draining.is_set():
                 self._shutdown_sweep()
 
-    def _free_slot(self) -> Optional[int]:
-        reserved = self._pf["slot"] if self._pf is not None else -1
+    def _free_slot(self, exclude=()) -> Optional[int]:
+        reserved = set(exclude)
+        if self._pf is not None:
+            reserved.add(self._pf["slot"])
+        if self._pfg is not None:
+            reserved.update(m["slot"] for m in self._pfg.members)
         for i in range(self.slots):
-            if not self._active[i] and i != reserved:
+            if not self._active[i] and i not in reserved:
                 return i
         return None
 
@@ -595,6 +678,9 @@ class ServingEngine:
         """Start at most one partial prefill per loop turn: pop a staged
         request, probe the prefix cache, reserve a slot, and leave the
         cursor for :meth:`_prefill_chunk` to advance between decodes."""
+        if self._sched is not None:
+            self._admit_sched(wait_s)
+            return
         while self._pf is None:
             slot = self._free_slot()
             if slot is None or self._feed is None:
@@ -617,6 +703,372 @@ class ServingEngine:
                 profiler.record_serving("expired")
                 continue
             self._begin_prefill(req, staged, slot, now)
+
+    # -- SLO scheduling (mxtpu.sched; every method below is sched-mode only) --
+    def _admit_sched(self, wait_s: float) -> None:
+        """Sched-mode admission: pull EVERY staged request into the pending
+        pool, then let the policy decide — shed the doomed, resume parked
+        requests into free slots, preempt a lower tier for a waiting higher
+        one, and start (batched) prefill on the fair-share winner(s)."""
+        while self._feed is not None:
+            try:
+                item = self._feed.poll(timeout=wait_s)
+            except StopIteration:
+                break
+            if item is None:
+                break
+            wait_s = 0.0
+            self._sched.register(item[0])
+            self._sched_pending.append(item)
+        now = time.monotonic()
+        keep = []
+        for req, staged in self._sched_pending:
+            if req._cancelled():
+                self._finish_unslotted(req, CANCELLED, now)
+            elif req._expired(now):
+                self._finish_unslotted(req, EXPIRED, now)
+            else:
+                keep.append((req, staged))
+        self._sched_pending = keep
+        self._resume_parked(now)
+        if self._pf is not None or self._pfg is not None \
+                or not self._sched_pending:
+            return
+        choice, shed = self._sched.select(
+            [r for r, _ in self._sched_pending], now)
+        self._apply_shed(shed, now)
+        if choice is None:
+            return
+        slot = self._free_slot()
+        if slot is None:
+            slot = self._preempt_for(choice, now)
+            if slot is None:
+                return                    # saturated; wait for a retire
+        self._sched.charge(choice)        # slot secured: commit the pick
+        if self._prefill_batch > 1 and len(self._sched_pending) > 1:
+            self._begin_group(choice, slot, now)
+        else:
+            staged = self._pop_pending(choice)
+            self._begin_prefill(choice, staged, slot, now)
+
+    def _pop_pending(self, req):
+        for i, (r, _s) in enumerate(self._sched_pending):
+            if r.id == req.id:
+                return self._sched_pending.pop(i)[1]
+        raise KeyError(req.id)     # unreachable: select() picked from pending
+
+    def _finish_unslotted(self, req, state: str, now: float) -> None:
+        req._finish(state, now)
+        profiler.record_serving({CANCELLED: "cancelled",
+                                 EXPIRED: "expired"}[state])
+        self._sched.forget(req)
+
+    def _apply_shed(self, shed, now: float) -> None:
+        for req in shed:
+            req._finish(SHED, now, error=self._sched.shed_error(req, now))
+            profiler.record_serving("shed")
+            profiler.record_tenant(req.tenant, "shed")
+            tracer.instant("serving/shed", cat="serving",
+                           args={"id": req.id, "tenant": req.tenant,
+                                 "priority": req.priority})
+            self._sched.forget(req)
+        if shed:
+            gone = {r.id for r in shed}
+            self._sched_pending = [(r, s) for r, s in self._sched_pending
+                                   if r.id not in gone]
+            profiler.record_sched(self._sched.stats())
+
+    def _preempt_for(self, incoming, now: float) -> Optional[int]:
+        """Park a lower-tier running request so ``incoming`` gets its
+        decode slot; returns the freed slot (None: nobody preemptible)."""
+        running = [self._reqs[int(s)] for s in np.flatnonzero(self._active)]
+        victim = self._sched.pick_victim(running, incoming)
+        if victim is None:
+            return None
+        slot = next(i for i, r in enumerate(self._reqs)
+                    if r is not None and r.id == victim.id)
+        self._park(slot, now)
+        return slot
+
+    def _park(self, slot: int, now: float) -> None:
+        """Freeze a running request out of its decode slot — exactly the
+        state a drain() entry carries (kept device-resident) — and queue
+        it for :meth:`_resume_parked`. The page plus (tok, p, limit)
+        cursors ARE the decode chain, so resume is bit-exact for the same
+        reason adopt() is."""
+        req = self._reqs[slot]
+        self._parked.append({
+            "req": req, "tot": self._TOT,
+            "page": kv.slot_page(self._caches, slot),
+            "tok": int(self._tok[slot]), "p": int(self._p[slot]),
+            "limit": int(self._limit[slot]), "left": int(self._left[slot]),
+            "temp": float(self._temp[slot]), "topk": int(self._topk[slot]),
+            "seed": int(self._seed[slot]),
+            "dec_emitted": bool(self._dec_emitted[slot]),
+        })
+        req._set_state(PENDING)
+        self._sched.note_preempt()
+        profiler.record_serving("preempted")
+        profiler.record_tenant(req.tenant, "preempted")
+        tracer.instant("serving/preempt", cat="serving",
+                       args={"id": req.id, "slot": slot,
+                             "p": int(self._p[slot]), "tenant": req.tenant,
+                             "priority": req.priority})
+        self._reqs[slot] = None
+        self._active[slot] = False
+        self._tok[slot] = 0
+        self._p[slot] = 0
+        self._limit[slot] = 0
+        self._left[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._seed[slot] = 0
+        self._dec_emitted[slot] = False
+
+    def _resume_parked(self, now: float) -> None:
+        """Re-slot parked requests (FIFO) while slots are free — unless a
+        pending request outranks the parked one, in which case the free
+        slot is left for admission (don't hand the slot straight back to
+        the tier that just lost it)."""
+        while self._parked:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            e = self._parked[0]
+            req = e["req"]
+            if req._cancelled() or req._expired(now):
+                self._parked.pop(0)
+                self._finish_unslotted(
+                    req, CANCELLED if req._cancelled() else EXPIRED, now)
+                continue
+            my_rank = self._sched.tier(req).rank
+            if any(self._sched.tier(r).rank < my_rank
+                   for r, _ in self._sched_pending):
+                return
+            self._parked.pop(0)
+            page = kv.device_page(e["page"])
+            self._ensure_capacity(e["tot"])
+            if e["tot"] < self._TOT:
+                page = kv.promote(page, self._TOT)
+            self._caches = kv.merge_page(self._caches, page, slot)
+            self._tok[slot] = e["tok"]
+            self._p[slot] = e["p"]
+            self._limit[slot] = e["limit"]
+            self._left[slot] = e["left"]
+            self._temp[slot] = e["temp"]
+            self._topk[slot] = e["topk"]
+            self._seed[slot] = e["seed"]
+            self._t_admit[slot] = now
+            self._dec_emitted[slot] = e["dec_emitted"]
+            self._active[slot] = True
+            self._reqs[slot] = req
+            req._set_state(RUNNING)
+            self._sched.note_resume()
+            profiler.record_serving("resumed")
+            tracer.instant("serving/resume", cat="serving",
+                           args={"id": req.id, "slot": slot, "p": e["p"],
+                                 "tenant": req.tenant})
+
+    def _begin_group(self, first, first_slot: int, now: float) -> None:
+        """Collect up to ``prefill_batch`` fair-share winners (bounded by
+        free slots) and start ONE batched prefill over their packed
+        prompts (``mxtpu.sched.admission``)."""
+        picked = [(first, self._pop_pending(first), first_slot)]
+        taken = {first_slot}
+        while len(picked) < self._prefill_batch and self._sched_pending:
+            slot = self._free_slot(exclude=taken)
+            if slot is None:
+                break
+            choice, shed = self._sched.select(
+                [r for r, _ in self._sched_pending], now)
+            self._apply_shed(shed, now)
+            if choice is None:
+                break
+            self._sched.charge(choice)    # joins the group: slot reserved
+            picked.append((choice, self._pop_pending(choice), slot))
+            taken.add(slot)
+        if len(picked) == 1:
+            self._begin_prefill(first, picked[0][1], first_slot, now)
+            return
+        from ..sched.admission import PrefillGroup
+        PB = max(s.shape[1] for _, s, _ in picked)
+        members = []
+        for req, staged, slot in picked:
+            t0 = len(req.prompt)
+            req._set_state(RUNNING)
+            profiler.record_serving("admitted")
+            profiler.record_serving("queue_wait_ms_last",
+                                    (now - req.t_submit) * 1e3)
+            tracer.instant("serving/admit", cat="serving",
+                           args={"id": req.id, "slot": slot,
+                                 "tenant": req.tenant,
+                                 "queue_wait_ms": round(
+                                     (now - req.t_submit) * 1e3, 3)})
+            m, blocks = 0, None
+            if self._prefix is not None and req.use_prefix_cache \
+                    and t0 - 1 >= kv.PrefixCache.BLOCK:
+                m, blocks, path = self._prefix.match(req.prompt, t0 - 1)
+                # the pins only guard the tree nodes; the block arrays stay
+                # alive through `blocks` itself, so release before install
+                # is safe here (PrefillGroup installs them immediately)
+                self._prefix.release(path)
+                self._note_prefix_probe(req, m)
+            temp, topk, seed = _req_sampling(req)
+            members.append({"req": req, "slot": slot, "t0": t0,
+                            "start": m, "blocks": blocks or None,
+                            "left": req.max_new, "done": False,
+                            "t_start": now, "temp": temp, "topk": topk,
+                            "seed": seed})
+        self._pfg = PrefillGroup(self._model, members, self._prefill_batch,
+                                 PB, self._kv_dtype, self._quant)
+        profiler.record_serving("prefill_groups")
+        tracer.instant("serving/prefill_group", cat="serving",
+                       args={"ids": [mm["req"].id for mm in members],
+                             "bucket": PB, "rows": len(members)})
+
+    def _prefill_group_chunk(self) -> None:
+        """Advance the batched prefill by ONE fixed-budget chunk (the same
+        stall bound as the scalar path — one chunk's work per turn, shared
+        by all members); emit each member's valid tokens, finish members
+        that complete at admission, and at scan end merge every survivor
+        into its reserved slot."""
+        g = self._pfg
+        now = time.monotonic()
+        for mem in g.members:
+            req = mem["req"]
+            if mem["done"]:
+                continue
+            if req._cancelled():
+                mem["done"] = True
+                self._finish_unslotted(req, CANCELLED, now)
+            elif req._expired(now):
+                mem["done"] = True
+                self._finish_unslotted(req, EXPIRED, now)
+        if all(m["done"] for m in g.members):
+            self._pfg = None
+            return
+        csize = min(self.prefill_chunk, g.remaining())
+        live_ids = [m["req"].id for m in g.members if not m["done"]]
+        with tracer.span("serving/prefill_chunk", cat="serving",
+                         args={"ids": live_ids, "start": g.cursor,
+                               "chunk": csize, "bucket": g.PB,
+                               "batched": len(live_ids)}):
+            from ..sched.admission import build_prefill_batch
+            fn = self._prefill_fns.get_or_build(
+                ("batch", g.N, g.PB, csize),
+                lambda: build_prefill_batch(
+                    self._model, g.N, g.PB, csize, quant=self._quant,
+                    decode_kernel=self._decode_kernel))
+            page, prev, lastfed, outs = fn(self._params, *g.chunk_inputs())
+            outs_np = np.asarray(outs)
+        profiler.record_serving("prefill_chunks")
+        self._sched.observe_prefill(csize * len(live_ids),
+                                    time.monotonic() - now)
+        for n, mem in enumerate(g.members):
+            if mem["done"]:
+                continue
+            req = mem["req"]
+            j_lo, j_hi = g.valid_range(n, csize)
+            if j_lo >= j_hi:
+                continue
+            valid = outs_np[j_lo:j_hi, n]
+            done_t = time.monotonic()
+            first = req.t_first_token is None
+            left = req._emit(valid.tolist(), done_t)
+            profiler.record_serving("tokens_out", mem["left"] - left)
+            mem["left"] = left
+            if first:
+                self._note_first_token(req, done_t, mem["t_start"])
+            if left == 0:
+                # short request: completed inside the group, never decodes.
+                # NB: slice the chunk's OUTPUT page — g.page is pre-advance
+                # here (advance runs after this loop), and inserting the
+                # stale rows would seed the prefix tree with blocks the
+                # scan hasn't written yet
+                mem["done"] = True
+                self._insert_prefix(req, kv.slot_page(page, n),
+                                    upto=g.cursor + csize)
+                req._finish(DONE, done_t)
+                profiler.record_serving("prefills")
+                profiler.record_serving("completed")
+                profiler.record_tenant(req.tenant, "completed")
+                profiler.record_tenant(req.tenant, "goodput_tokens",
+                                       req.max_new)
+                self._sched.forget(req)
+                tracer.instant("serving/retire", cat="serving",
+                               args={"id": req.id, "state": DONE,
+                                     "tenant": req.tenant,
+                                     "at_admission": True})
+        g.advance(page, prev, lastfed, csize)
+        if g.remaining() == 0:
+            self._finish_group()
+        profiler.record_sched(self._sched.stats())
+
+    def _finish_group(self) -> None:
+        """Batched-prefill phase three: every member row is scanned to the
+        bucket end — merge each survivor's page row into its reserved slot
+        and hand it to the decode batch (the groupwise twin of
+        :meth:`_finish_prefill`)."""
+        g, self._pfg = self._pfg, None
+        prev_np = np.asarray(g.prev)
+        now = time.monotonic()
+        survivors = [(n, m) for n, m in enumerate(g.members)
+                     if not m["done"]]
+        if not survivors:
+            return
+        need = max([g.PB] + [kv.bucket32(m["req"].total,
+                                         self._model._max_len)
+                             for _n, m in survivors])
+        self._ensure_capacity(need)
+        for n, mem in survivors:
+            req = mem["req"]
+            slot = mem["slot"]
+            self._insert_prefix(req, g.member_page(n), upto=mem["t0"] - 1)
+            self._caches = kv.merge_page(self._caches, g.member_page(n),
+                                         slot)
+            self._tok[slot] = int(prev_np[n])    # the token at position PB
+            self._p[slot] = g.PB                 # next position to feed
+            self._limit[slot] = req.total - 1
+            self._active[slot] = True
+            self._left[slot] = mem["left"]
+            self._temp[slot] = mem["temp"]
+            self._topk[slot] = mem["topk"]
+            self._seed[slot] = mem["seed"]
+            self._t_admit[slot] = now
+            self._dec_emitted[slot] = False
+            self._reqs[slot] = req
+            profiler.record_serving("prefills")
+
+    def _note_prefix_probe(self, req, m: int) -> None:
+        """Prefix-probe accounting shared by scalar and group admission
+        (partial-block hits count the sub-block tail separately)."""
+        if m:
+            profiler.record_serving("prefix_hits")
+            profiler.record_serving("prefix_hit_tokens", m)
+            if m % kv.PrefixCache.BLOCK:
+                profiler.record_serving("prefix_partial_hits")
+                profiler.record_serving("prefix_partial_tokens",
+                                        m % kv.PrefixCache.BLOCK)
+            tracer.instant("serving/prefix_hit", cat="serving",
+                           args={"id": req.id, "tokens": m})
+        else:
+            profiler.record_serving("prefix_misses")
+            tracer.instant("serving/prefix_miss", cat="serving",
+                           args={"id": req.id})
+
+    def _note_first_token(self, req, done_t: float,
+                          t_start: float) -> None:
+        profiler.record_serving("ttft_ms_last",
+                                (done_t - req.t_submit) * 1e3)
+        profiler.record_serving("prefill_ms_last",
+                                (done_t - t_start) * 1e3)
+        if self._sched is not None:
+            profiler.record_tenant(req.tenant, "ttft_ms_last",
+                                   (done_t - req.t_submit) * 1e3)
+        tracer.instant("serving/first_token", cat="serving",
+                       args={"id": req.id,
+                             "ttft_ms": round(
+                                 (done_t - req.t_submit) * 1e3, 3)})
 
     def _begin_prefill(self, req: ServingRequest, staged, slot: int,
                        now: float) -> None:
@@ -646,17 +1098,17 @@ class ServingEngine:
                 # quantized blocks install their bytes, never re-quantize)
                 page = kv.install_rows(page, blocks, m)
                 self._prefix.release(path)
-                profiler.record_serving("prefix_hits")
-                profiler.record_serving("prefix_hit_tokens", m)
-                tracer.instant("serving/prefix_hit", cat="serving",
-                               args={"id": req.id, "tokens": m})
-            else:
-                profiler.record_serving("prefix_misses")
-                tracer.instant("serving/prefix_miss", cat="serving",
-                               args={"id": req.id})
+            self._note_prefix_probe(req, m)
         temp, topk, seed = _req_sampling(req)
+        # scan from the last BLOCK boundary, not the raw match length: a
+        # partial-block hit (m % 32 != 0) re-feeds its sub-block tail as an
+        # identical rewrite (K/V at p is a pure function of tokens 0..p),
+        # which keeps the (PB, csize) program-key space bounded — an
+        # arbitrary mid-block cursor would mint a fresh multi-second XLA
+        # compile per distinct tail length
+        t_scan = m - (m % kv.PrefixCache.BLOCK)
         self._pf = {"req": req, "prompt": staged.data, "page": page,
-                    "t": m, "prev": 0, "t0": t0, "PB": PB,
+                    "t": t_scan, "prev": 0, "t0": t0, "PB": PB,
                     "left": req.max_new, "slot": slot, "t_start": now,
                     "temp": temp, "topk": topk, "seed": seed}
 
@@ -697,6 +1149,10 @@ class ServingEngine:
                 jnp.full((1,), pf["seed"], jnp.uint32))
             outs_np = np.asarray(outs)
         profiler.record_serving("prefill_chunks")
+        if self._sched is not None:
+            # scalar prefills must feed the rate EWMA too, or a sched-mode
+            # engine with prefill_batch=1 never warms its shed estimator
+            self._sched.observe_prefill(csize, time.monotonic() - now)
         pf["page"] = page
         pf["t"] = start + csize
         pf["prev"] = int(outs_np[-1])
@@ -710,14 +1166,7 @@ class ServingEngine:
             profiler.record_serving("tokens_out", pf["left"] - left)
             pf["left"] = left
             if first:
-                profiler.record_serving("ttft_ms_last",
-                                        (done_t - req.t_submit) * 1e3)
-                profiler.record_serving("prefill_ms_last",
-                                        (done_t - pf["t_start"]) * 1e3)
-                tracer.instant("serving/first_token", cat="serving",
-                               args={"id": req.id,
-                                     "ttft_ms": round(
-                                         (done_t - req.t_submit) * 1e3, 3)})
+                self._note_first_token(req, done_t, pf["t_start"])
             if left == 0:
                 # short request: completed at admission, never took a slot
                 self._pf = None
@@ -725,6 +1174,11 @@ class ServingEngine:
                 req._finish(DONE, done_t)
                 profiler.record_serving("prefills")
                 profiler.record_serving("completed")
+                if self._sched is not None:
+                    profiler.record_tenant(req.tenant, "completed")
+                    profiler.record_tenant(req.tenant, "goodput_tokens",
+                                           req.max_new)
+                    self._sched.forget(req)
                 # terminal timeline marker: every request's timeline ends in
                 # a retire even when it never occupied a decode slot
                 tracer.instant("serving/retire", cat="serving",
@@ -861,12 +1315,24 @@ class ServingEngine:
             profiler.record_serving("decode_ms_last",
                                     (now - t_dispatch) * 1e3)
             profiler.record_serving("decode_tokens", emitted_total)
+        if self._sched is not None:
+            if emitted_total:
+                self._sched.observe_decode(emitted_total, now - t_dispatch)
+            profiler.record_sched(self._sched.stats())
 
     def _retire(self, slot: int, state: str, now: float) -> None:
         req = self._reqs[slot]
         req._finish(state, now)
         profiler.record_serving({DONE: "completed", CANCELLED: "cancelled",
                                  EXPIRED: "expired"}[state])
+        if self._sched is not None:
+            self._sched.forget(req)
+            profiler.record_tenant(
+                req.tenant, {DONE: "completed", CANCELLED: "cancelled",
+                             EXPIRED: "expired"}[state])
+            if state == DONE:
+                profiler.record_tenant(req.tenant, "goodput_tokens",
+                                       len(req.tokens()))
         tracer.instant("serving/retire", cat="serving",
                        args={"id": req.id, "state": state})
         self._reqs[slot] = None
@@ -912,6 +1378,20 @@ class ServingEngine:
             pf, self._pf = self._pf, None
             pf["req"]._finish(CANCELLED, now)
             profiler.record_serving("cancelled")
+        if self._pfg is not None:
+            g, self._pfg = self._pfg, None
+            for mem in g.members:
+                if not mem["done"]:
+                    mem["req"]._finish(CANCELLED, now)
+                    profiler.record_serving("cancelled")
+        for e in self._parked:
+            e["req"]._finish(CANCELLED, now)
+            profiler.record_serving("cancelled")
+        self._parked = []
+        for req, _s in self._sched_pending:
+            req._finish(CANCELLED, now)
+            profiler.record_serving("cancelled")
+        self._sched_pending = []
         # staged by the feed but never admitted: drain until the producer's
         # end marker (it sees the stop flag within its 0.1s poll)
         deadline = time.monotonic() + 5.0

@@ -291,12 +291,19 @@ class PrefixCache:
         return len(self._nodes) * self.block_bytes
 
     def match(self, tokens, limit: int) -> Tuple[int, List, tuple]:
-        """Longest cached prefix of ``tokens`` in whole blocks, capped at
-        position ``limit`` (exclusive; the engine passes ``t0 - 1`` so the
-        final prompt position is always recomputed — its output token seeds
-        the feedback chain). Returns ``(matched_len, kv_blocks, path)``
-        with every matched node PINNED; call :meth:`release(path)` once the
-        rows are installed."""
+        """Longest cached prefix of ``tokens``, capped at position ``limit``
+        (exclusive; the engine passes ``t0 - 1`` so the final prompt
+        position is always recomputed — its output token seeds the feedback
+        chain). Whole 32-token blocks match by radix lookup; past the last
+        whole-block match, the children one block deeper are scanned for
+        the longest common token run and its leading rows are reused at
+        TOKEN granularity (K/V at position ``p`` depends only on tokens
+        ``0..p``, so the rows before the first divergent token are
+        bit-identical even though the blocks differ beyond it). Returns
+        ``(matched_len, kv_blocks, path)`` with every contributing node
+        PINNED — including a partially-matched child, whose key is the
+        returned ``path`` tail; call :meth:`release(path)` once the rows
+        are installed."""
         blocks: List = []
         path: tuple = ()
         m = 0
@@ -310,6 +317,27 @@ class PrefixCache:
             blocks.append(node["kv"])
             path = nxt
             m += self.BLOCK
+        # partial-block tail: best token-lcp among the children of `path`
+        depth, cap = len(path) + self.BLOCK, min(self.BLOCK, limit - m)
+        if cap > 0:
+            want = tuple(tokens[m:m + cap])
+            best_j, best_key = 0, None
+            for key in self._nodes:
+                if len(key) != depth or key[:len(path)] != path:
+                    continue
+                tail = key[len(path):]
+                j = 0
+                while j < cap and tail[j] == want[j]:
+                    j += 1
+                if j > best_j:
+                    best_j, best_key = j, key
+            if best_key is not None:
+                node = self._nodes[best_key]
+                node["refs"] += 1
+                self._nodes.move_to_end(best_key)
+                blocks.append(qkv.block_slice(node["kv"], 0, best_j))
+                path = best_key
+                m += best_j
         return m, blocks, path
 
     def release(self, path: tuple) -> None:

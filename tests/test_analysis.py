@@ -411,6 +411,62 @@ def test_r007_suppressed():
 
 
 # ---------------------------------------------------------------------------
+# R008 unbounded-map
+# ---------------------------------------------------------------------------
+
+def test_r008_positive_flags_per_request_growth_without_evict():
+    """The SLOScheduler._inflight leak shape: register grows req.id ->
+    tenant, but nothing in the class ever pops/clears it — one entry per
+    request until OOM, and every test still passes."""
+    findings = _lint("""
+        class Scheduler:
+            def __init__(self):
+                self._inflight = {}
+                self._t_start = {}
+            def register(self, req):
+                self._inflight[req.id] = req.tenant
+            def observe(self, req_id, t):
+                self._t_start[req_id] = t
+    """, select=["R008"])
+    assert len(findings) == 2
+    assert all(f.rule == "R008" for f in findings)
+    assert "_inflight" in findings[0].message
+    assert "pop" in findings[0].message
+
+
+def test_r008_negative_evicted_cleared_or_rebound():
+    assert _rules_hit("""
+        class PopsOnRetire:
+            def register(self, req):
+                self._inflight[req.id] = req.tenant
+            def forget(self, req):
+                self._inflight.pop(req.id, None)
+        class DeletesOnRetire:
+            def track(self, req):
+                self._per_req[req.id] = 1
+            def untrack(self, req):
+                del self._per_req[req.id]
+        class PeriodicReset:
+            def track(self, req):
+                self._requests[req.id] = req
+            def flush(self):
+                self._requests = {}
+        class NotRequestKeyed:
+            def bump(self, name):
+                self._counters[name] = self._counters.get(name, 0) + 1
+    """, select=["R008"]) == set()
+
+
+def test_r008_suppressed():
+    findings = _lint("""
+        class CappedByConstruction:
+            def record(self, tenant, req):
+                self._per_tenant[req.id] = 1  # mxtpu: ignore[R008]
+    """, select=["R008"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # linter plumbing
 # ---------------------------------------------------------------------------
 

@@ -50,7 +50,7 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
             "compile_caches", "mfu", "trace", "fsdp", "serving",
             "elastic", "quant", "long_context", "observability",
-            "ratchet"} <= set(doc)
+            "traffic", "ratchet"} <= set(doc)
     # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
     # mid-epoch crash survived by a supervised restart, final params equal
     # to the fault-free baseline
@@ -159,6 +159,20 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
         assert lctx[key]["tokens_s"] > 0
     assert lctx["mfu_t2048"] is not None and lctx["mfu_t2048"] > 0
     assert doc["ratchet"]["current"]["mfu_t2048"] == lctx["mfu_t2048"]
+    # traffic leg (ISSUE 17): the same seeded multi-tenant trace served
+    # FIFO vs SLO-scheduled — decode bit-exact in both legs, goodput under
+    # per-tenant SLO on the ratchet, and the dry-run autoscaler recorded
+    # decisions without ever actuating
+    traffic = doc["traffic"]
+    assert "error" not in traffic, traffic
+    assert traffic["decode_match"] is True
+    assert traffic["requests"] > 0
+    assert traffic["goodput_under_slo"] > 0
+    assert traffic["sched"]["preempted"] >= 0
+    assert "interactive" in traffic["sched"]["ttft_by_tier"]
+    assert traffic["sched"]["autoscale_dry_run"]["actuated"] is False
+    assert doc["ratchet"]["current"]["goodput_under_slo"] \
+        == traffic["goodput_under_slo"]
     # elastic leg (ISSUE 11): one live in-place dp shrink mid-fit — no
     # restart, no steps lost, bit-exact with a cold resume — and a serving
     # drain/adopt handoff that dropped nothing
@@ -218,10 +232,11 @@ def test_bench_leg_failure_yields_partial_json(tmp_path):
     doc, p = _run_fallback_bench(tmp_path, extra_env={
         # input_pipeline: fails every attempt → retries exhaust → error leg
         # zero_dp: fails once → the transient retry policy must recover it
-        # quant + long_context: fail every attempt too — more exhausted
-        # legs, and they keep this scenario fast (both are benched for real
-        # by the fallback test above / their CLI scenarios)
-        "MXTPU_BENCH_FAIL_LEG": "input_pipeline,quant,long_context,zero_dp:1",
+        # quant + long_context + traffic: fail every attempt too — more
+        # exhausted legs, and they keep this scenario fast (each is benched
+        # for real by the fallback test above / their CLI scenarios)
+        "MXTPU_BENCH_FAIL_LEG":
+            "input_pipeline,quant,long_context,traffic,zero_dp:1",
         "MXTPU_BENCH_RETRY_BACKOFF_S": "0.01",
         "MXTPU_RETRY_BACKOFF_MAX_S": "0.05",
     })
@@ -230,6 +245,7 @@ def test_bench_leg_failure_yields_partial_json(tmp_path):
     assert doc["input_pipeline"]["retried"] is True
     assert "error" in doc["quant"]
     assert "error" in doc["long_context"]
+    assert "error" in doc["traffic"]
     # the retried leg recovered — full payload, no error key
     assert "error" not in doc["zero_dp"]
     assert doc["zero_dp"]["zero1"]["step_ms"] > 0
@@ -290,6 +306,57 @@ def test_bench_elastic_scenario_cli(tmp_path):
     assert elastic["params_match_cold_resume"] is True
     assert elastic["serving"]["requests_dropped"] == 0
     assert elastic["serving"]["decode_match"] is True
+
+
+def test_bench_traffic_scenario_cli(tmp_path):
+    """``bench.py traffic`` (ISSUE 17): the traffic-replay CLI path must
+    exit 0 and emit a single traffic JSON doc — the SAME seeded bursty
+    multi-tenant trace served FIFO then SLO-scheduled, decode bit-exact in
+    BOTH legs (preempt/park/resume included), goodput-under-SLO on the
+    ratchet under the smoke harness key, and the dry-run autoscaler
+    recording decisions without ever touching an actuator."""
+    doc, _ = _run_fallback_bench(tmp_path, args=("traffic",))
+    assert doc["metric"] == "traffic_goodput_under_slo"
+    assert doc["value"] > 0
+    traffic = doc["traffic"]
+    assert "error" not in traffic, traffic
+    assert traffic["requests"] > 0
+    assert traffic["kind"] == "bursty"
+    # the acceptance pair: decode stays bit-exact under scheduling (both
+    # legs, so preempted requests resumed token-exactly), and aggregate
+    # goodput does not regress vs FIFO (loaded-machine slack on the floor;
+    # the full margin is visible in the emitted doc)
+    assert traffic["decode_match"] is True
+    assert traffic["fifo"]["decode_match"] is True
+    assert traffic["sched"]["decode_match"] is True
+    assert traffic["goodput_vs_fifo"] >= 0.7, traffic
+    assert traffic["goodput_under_slo"] == traffic["sched"][
+        "goodput_under_slo"] > 0
+    # tier-resolved TTFT shipped for both legs; the trace genuinely mixed
+    # all three tiers
+    for leg in ("fifo", "sched"):
+        tiers = traffic[leg]["ttft_by_tier"]
+        assert {"interactive", "standard", "batch"} <= set(tiers)
+        for t in tiers.values():
+            assert t["ttft_p99_ms"] >= t["ttft_p50_ms"] > 0
+    assert traffic["interactive_ttft_p99_ms"] > 0
+    assert traffic["interactive_ttft_p99_vs_fifo"] > 0
+    # the SLO plane demonstrably engaged: batched prefill groups formed,
+    # and preemption state round-tripped (resumed == preempted — nothing
+    # parked was ever dropped)
+    assert traffic["sched"]["prefill_groups"] >= 1
+    assert traffic["sched"]["preempted"] == traffic["sched"]["resumed"]
+    assert traffic["sched"]["shed"] == 0          # budgets are measure-only
+    # dry-run autoscaler: one tick per submit, decisions recorded, nothing
+    # actuated
+    scale = traffic["sched"]["autoscale_dry_run"]
+    assert scale["ticks"] == traffic["requests"]
+    assert scale["actuated"] is False
+    assert sum(scale["actions"].values()) == scale["ticks"]
+    cur = doc["ratchet"]["current"]
+    assert cur["goodput_under_slo"] == traffic["goodput_under_slo"]
+    assert doc["ratchet"]["harness"] == "traffic-smoke"
+    assert doc["ratchet"]["regressions"] == {}
 
 
 @pytest.mark.slow        # the fallback test above already runs the quant leg
@@ -353,7 +420,7 @@ def test_bench_sanitized_leg_exits_zero_with_no_violations(tmp_path):
     other fallback legs run unsanitized), and the long-context points pay
     two long-T compiles that the fallback test above already covers."""
     doc, _ = _run_fallback_bench(tmp_path, args=("--sanitize",), extra_env={
-        "MXTPU_BENCH_FAIL_LEG": "long_context",
+        "MXTPU_BENCH_FAIL_LEG": "long_context,traffic",
         "MXTPU_BENCH_RETRY_BACKOFF_S": "0.01",
         "MXTPU_RETRY_BACKOFF_MAX_S": "0.05",
     })

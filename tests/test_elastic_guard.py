@@ -416,6 +416,69 @@ def test_serving_drain_mid_admission_resumes_suffix_prefill(net):
     assert stats["completed"] == 1 and stats["adopted"] == 1
 
 
+def test_serving_handoff_carries_sched_state_and_parked_slots(net):
+    """ISSUE 17 satellite: a sched-mode drain freezes the SLO plane too —
+    the preempted (parked) request rides the handoff with its tenant /
+    priority / deadline metadata intact, ``sched_state`` carries the
+    fair-share passes + rate EWMAs so the successor never restarts cold,
+    and both the parked and the in-slot request finish bit-exact on the
+    adopting engine. A sched-less engine must REFUSE a handoff that
+    carries parked slots instead of silently dropping them."""
+    from mxtpu.serving import ServingEngine, ServingHandoff
+    profiler.reset_serving_stats()
+    rs = np.random.RandomState(31)
+    p_batch = rs.randint(1, VOCAB, size=11).tolist()
+    p_inter = rs.randint(1, VOCAB, size=7).tolist()
+    ref_b = _solo(net, p_batch, 48)
+    ref_i = _solo(net, p_inter, 40)
+
+    eng = ServingEngine(net, slots=1, queue_depth=8, chunk=4,
+                        sched=True).start()
+    rb = eng.submit(p_batch, 48, tenant="bulk", priority="batch")
+    t0 = time.monotonic()
+    while len(rb.tokens()) < 4:                    # mid-decode
+        assert time.monotonic() - t0 < 300, "batch decode never started"
+        time.sleep(0.001)
+    ri = eng.submit(p_inter, 40, tenant="chat", priority="interactive",
+                    deadline_s=600.0)
+    while profiler.get_serving_stats().get("preempted", 0) < 1:
+        assert time.monotonic() - t0 < 300, "preemption never happened"
+        time.sleep(0.001)
+    handoff = eng.drain()                          # interactive mid-decode
+
+    assert len(handoff.parked) == 1
+    parked = handoff.parked[0]["req"]
+    assert parked is rb
+    assert parked.tenant == "bulk" and parked.priority == "batch"
+    assert handoff.parked[0]["p"] > 0              # genuinely mid-stream
+    assert ri in [e["req"] for e in handoff.entries] \
+        or ri in [e["req"] for e in handoff.partial]
+    assert ri.deadline is not None                 # deadline rides along
+    state = handoff.sched_state
+    assert state["pass"].get("bulk", 0) > 0        # both tenants charged
+    assert state["pass"].get("chat", 0) > 0
+    assert state["ewma_decode_s"] is not None
+    assert handoff.in_flight == 2
+    assert profiler.get_serving_stats()["drained"] == 2
+
+    eng2 = ServingEngine(net, slots=1, queue_depth=8, chunk=4, sched=True)
+    eng2.adopt(handoff)
+    assert ri.result(timeout=300) == ref_i
+    assert rb.result(timeout=300) == ref_b         # park + hop, bit-exact
+    eng2.stop()
+    stats = profiler.get_serving_stats()
+    assert stats["adopted"] == 2
+    assert stats["cancelled"] == 0 and stats["expired"] == 0
+    # the successor's policy resumed warm, with the source's passes
+    assert eng2._sched.export_state()["pass"]["bulk"] \
+        >= state["pass"]["bulk"]
+
+    # parked slots need the SLO plane on the adopter
+    bare = ServingEngine(net, slots=1, queue_depth=8, chunk=4)
+    with pytest.raises(ValueError, match="parked"):
+        bare.adopt(ServingHandoff(tot=128, parked=[{"req": None}]))
+
+
 def test_serving_drain_fault_sweeps_instead_of_blocking(net, monkeypatch):
     """A fault at the ``serving.drain`` seam aborts the handoff — the
     cancel-everything sweep must still run so no caller blocks forever."""

@@ -90,19 +90,34 @@ def test_merge_of_aliased_prefix_page_copies():
 def test_prefix_cache_match_limit_and_block_granularity():
     cache = kv.PrefixCache(block_bytes=1, capacity_mb=1)
     tokens = list(range(100))
-    cache.insert(tokens, _page(96, seed=3), limit=96)
-    assert len(cache) == 3                       # whole blocks only
-    # a limit mid-block (the engine's t0 - 1) truncates to block boundary
+    page = _page(96, seed=3)
+    cache.insert(tokens, page, limit=96)
+    assert len(cache) == 3                       # insert stays whole-block
+    # a limit mid-block (the engine's t0 - 1): two whole blocks plus a
+    # TOKEN-granularity slice of the third — K/V at position p depends only
+    # on tokens 0..p, so the rows before the limit are bit-identical even
+    # though the cached block runs past it
     m, blocks, path = cache.match(tokens, limit=70)
-    assert m == 64 and len(blocks) == 2
+    assert m == 70 and len(blocks) == 3
+    assert blocks[2].shape[4] == 6               # rows 64..69 of block 3
+    np.testing.assert_array_equal(
+        np.asarray(jnp.concatenate(blocks, axis=4)),
+        np.asarray(page[..., :70, :]))
     cache.release(path)
-    # a diverging token ends the walk at the shared prefix
+    # a diverging token ends the walk at the last bit-identical row: one
+    # whole block, then an 8-row slice of the best sibling (tokens 32..39)
     fork = tokens[:40] + [7777] + tokens[41:]
     m, blocks, path = cache.match(fork, limit=96)
-    assert m == 32 and len(blocks) == 1
+    assert m == 40 and len(blocks) == 2
+    assert blocks[1].shape[4] == 8
     cache.release(path)
-    # under one block: nothing to match, nothing pinned
+    # under one block the partial tail still serves the leading rows, and
+    # the contributing child is pinned until released
     m, blocks, path = cache.match(tokens, limit=31)
+    assert m == 31 and len(blocks) == 1 and path != ()
+    cache.release(path)
+    # a first-token divergence: nothing to match, nothing pinned
+    m, blocks, path = cache.match([555] + tokens[1:], limit=96)
     assert m == 0 and blocks == [] and path == ()
 
 
