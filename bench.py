@@ -1718,6 +1718,12 @@ def apply_ratchet(doc: dict, harness: str):
             if isinstance(prefix_p99, (int, float)) and prefix_p99 > 0 \
             else None
         prefix_rate = prefix_block.get("hit_rate")
+        spec_block = serving_block.get("spec") \
+            if isinstance(serving_block, dict) else None
+        if not isinstance(spec_block, dict):
+            spec_block = {}
+        spec_speedup = spec_block.get("spec_decode_speedup")
+        accept_len = spec_block.get("accept_len_mean")
         comm_block = doc.get("comm")
         a2a_ratio = comm_block.get("a2a_vs_allreduce_ratio") \
             if isinstance(comm_block, dict) else None
@@ -1745,6 +1751,8 @@ def apply_ratchet(doc: dict, harness: str):
                          ("serving_goodput", serving_goodput),
                          ("serving_ttft_p99_inv", serving_ttft_inv),
                          ("prefix_hit_rate", prefix_rate),
+                         ("spec_decode_speedup", spec_speedup),
+                         ("accept_len_mean", accept_len),
                          ("a2a_vs_allreduce_ratio", a2a_ratio),
                          ("kv_bytes_shrink", kv_shrink),
                          ("quant_decode_speedup", quant_speedup),
@@ -1933,6 +1941,7 @@ def bench_serving(smoke: bool = False):
         f"{doc['ttft_p50_ms']:.1f} ms (queue {doc['ttft_queue_wait_ms_mean']:.1f}"
         f" + prefill {doc['ttft_prefill_ms_mean']:.1f}), match={decode_match}")
     doc["prefix"] = _bench_serving_prefix(net, vocab, smoke)
+    doc["spec"] = _bench_serving_spec(net, vocab, smoke)
     return doc
 
 
@@ -2024,6 +2033,99 @@ def _bench_serving_prefix(net, vocab: int, smoke: bool):
     return doc
 
 
+def _bench_serving_spec(net, vocab: int, smoke: bool):
+    """Speculative-decode A/B leg (ISSUE 18): the SAME draftable burst
+    trace served spec-off and spec-on (``SpecConfig(k=4)``, n-gram
+    drafter). Prompts repeat a short period — the shape boilerplate-heavy
+    prompts and greedy loops both have — so the drafter's self-context
+    lookup actually lands multi-token accepts. Both legs run ``chunk=1``
+    (incremental token-streaming decode, the mode speculation exists to
+    accelerate — the chunked scan is the orthogonal latency-for-throughput
+    trade). ``spec_decode_speedup`` is decode-ONLY throughput
+    (``decode_tokens / decode_ms_total``) spec-on over spec-off: one
+    verify dispatch emitting up to k+1 tokens per slot against one
+    single-token dispatch per turn, with prefill, queueing, and scheduler
+    sleeps excluded. Greedy decode is
+    asserted bit-exact against solo ``generate`` in BOTH legs (the
+    accept/reject contract: speculation must never buy speed with drift).
+    ``accept_len_mean`` (mean emitted tokens per live slot per verify
+    dispatch) rides the BENCH_BASELINE ratchet next to the speedup. All
+    compiles — verify program included, the warm prompt drafts too — off
+    the clock."""
+    import numpy as np
+
+    from mxtpu import nd, profiler
+    from mxtpu.serving import ServingEngine, SpecConfig
+
+    n_req = 4 if smoke else 8
+    max_new = 96 if smoke else 160
+    slots = 4
+    k = 4
+    rs = np.random.RandomState(13)
+    prompts = []
+    for n in rs.randint(9, 16, size=n_req):
+        period = rs.randint(1, vocab, size=4).tolist()
+        prompts.append((period * 8)[:int(n)])
+    warm_prompt = rs.randint(1, vocab, size=15).tolist()
+    refs = []
+    for p in prompts:
+        out = np.asarray(net.generate(
+            nd.array(np.array([p], np.int32)), max_new).data)
+        refs.append(out[0, len(p):].tolist())
+
+    def leg(spec):
+        eng = ServingEngine(net, slots=slots, queue_depth=n_req + 2,
+                            chunk=1, spec=spec)
+        eng.start()
+        eng.submit(warm_prompt, max_new).result(timeout=600)  # compile,
+        profiler.reset_serving_stats()                        # off-clock
+        t0 = time.monotonic()
+        reqs = [eng.submit(p, max_new) for p in prompts]      # burst
+        outs = [r.result(timeout=600) for r in reqs]
+        span = time.monotonic() - t0
+        stats = profiler.get_serving_stats()
+        eng.stop()
+        dec_ms = stats.get("decode_ms_total", 0.0)
+        return {
+            "decode_match": bool(outs == refs),
+            "span_ms": span * 1e3,
+            "decode_only_tok_s": (stats.get("decode_tokens", 0)
+                                  / (dec_ms / 1e3)) if dec_ms else 0.0,
+            "decode_tokens": stats.get("decode_tokens", 0),
+            "decode_steps": stats.get("decode_steps", 0),
+            "spec_dispatches": stats.get("spec_dispatches", 0),
+            "tokens_drafted": stats.get("tokens_drafted", 0),
+            "tokens_accepted": stats.get("tokens_accepted", 0),
+            "tokens_rejected": stats.get("tokens_rejected", 0),
+            "accept_len_mean": stats.get("accept_len_mean", 0.0),
+            "accept_len_p50": stats.get("accept_len_p50", 0.0),
+            "accept_len_p99": stats.get("accept_len_p99", 0.0),
+        }
+
+    off = leg(None)
+    on = leg(SpecConfig(k=k))
+    doc = {
+        "requests": n_req,
+        "max_new": max_new,
+        "slots": slots,
+        "k": k,
+        "off": off,
+        "on": on,
+        "spec_decode_speedup": on["decode_only_tok_s"]
+        / max(off["decode_only_tok_s"], 1e-9),
+        "accept_len_mean": on["accept_len_mean"],
+        "decode_match": off["decode_match"] and on["decode_match"],
+    }
+    log(f"[serving/spec] {n_req} reqs x {max_new} tok, k={k}: decode "
+        f"{on['decode_only_tok_s']:.1f} tok/s vs plain "
+        f"{off['decode_only_tok_s']:.1f} "
+        f"({doc['spec_decode_speedup']:.2f}x), accept_len mean "
+        f"{on['accept_len_mean']:.2f} "
+        f"({on['tokens_accepted']}/{on['tokens_drafted']} drafts), "
+        f"match={doc['decode_match']}")
+    return doc
+
+
 def bench_traffic(smoke: bool = False):
     """Multi-tenant traffic-replay scenario (ISSUE 17): the SAME seeded
     bursty arrival trace (``mxtpu.sched.replay``) — three tenants with
@@ -2098,11 +2200,12 @@ def bench_traffic(smoke: bool = False):
     warm_hit = [warm_prompt[:32] + rs.randint(1, vocab, size=5).tolist()
                 for _ in range(3)]
 
-    def leg(sched):
+    def leg(sched, spec=None):
         eng = ServingEngine(net, slots=slots, chunk=chunk,
                             queue_depth=len(trace) + 4,
                             sched=True if sched else None,
-                            prefill_batch=2 if sched else None)
+                            prefill_batch=2 if sched else None,
+                            spec=spec)
         eng.start()
 
         def warm(lead, pair=None):
@@ -2175,6 +2278,11 @@ def bench_traffic(smoke: bool = False):
             "prefix_hits": stats.get("prefix_hits"),
             "prefix_partial_hits": stats.get("prefix_partial_hits"),
         }
+        if spec is not None:
+            out["spec_dispatches"] = stats.get("spec_dispatches", 0)
+            out["tokens_drafted"] = stats.get("tokens_drafted", 0)
+            out["tokens_accepted"] = stats.get("tokens_accepted", 0)
+            out["accept_len_mean"] = stats.get("accept_len_mean", 0.0)
         if scaler is not None:
             table = scaler.decision_table()
             out["autoscale_dry_run"] = {
@@ -2187,6 +2295,11 @@ def bench_traffic(smoke: bool = False):
 
     fifo = leg(sched=False)
     sched = leg(sched=True)
+    # speculative A/B on the SAME trace: the sched leg re-run with the
+    # n-gram draft + batched-verify decode on (ISSUE 18) — goodput and
+    # bit-exactness must survive speculation under preemption and
+    # multi-tenant churn, not just in the clean serving bench
+    spec = leg(sched=True, spec=4)
     inter_fifo = fifo["ttft_by_tier"].get("interactive", {})
     inter_sched = sched["ttft_by_tier"].get("interactive", {})
     doc = {
@@ -2199,6 +2312,17 @@ def bench_traffic(smoke: bool = False):
         "chunk": chunk,
         "fifo": fifo,
         "sched": sched,
+        "spec": {
+            "goodput_under_slo": spec["goodput_under_slo"],
+            "goodput_vs_plain_sched": spec["goodput_under_slo"]
+            / max(sched["goodput_under_slo"], 1e-9),
+            "decode_match": spec["decode_match"],
+            "spec_dispatches": spec["spec_dispatches"],
+            "tokens_drafted": spec["tokens_drafted"],
+            "tokens_accepted": spec["tokens_accepted"],
+            "accept_len_mean": spec["accept_len_mean"],
+            "preempted": spec["preempted"],
+        },
         "goodput_under_slo": sched["goodput_under_slo"],
         "goodput_vs_fifo": sched["goodput_under_slo"]
         / max(fifo["goodput_under_slo"], 1e-9),
@@ -2215,6 +2339,11 @@ def bench_traffic(smoke: bool = False):
         f"{inter_sched.get('ttft_p99_ms', 0):.1f} ms vs fifo "
         f"{inter_fifo.get('ttft_p99_ms', 0):.1f} ms, preempted "
         f"{sched['preempted']}, match={doc['decode_match']}")
+    log(f"[traffic/spec] sched+spec leg: goodput "
+        f"{spec['goodput_under_slo']:.1f} tok/s "
+        f"({doc['spec']['goodput_vs_plain_sched']:.2f}x plain sched), "
+        f"accept_len mean {spec['accept_len_mean']:.2f}, "
+        f"match={spec['decode_match']}")
     return doc
 
 

@@ -7,9 +7,9 @@ catalog and the PR 2 / PR 4 incidents each one would have caught).
 """
 
 from . import (host_sync, donation, nondeterminism, thread_shared, excepts,
-               span_leak, quant_dequant, unbounded_map)
+               span_leak, quant_dequant, unbounded_map, accept_sync)
 
 RULES = [host_sync, donation, nondeterminism, thread_shared, excepts,
-         span_leak, quant_dequant, unbounded_map]
+         span_leak, quant_dequant, unbounded_map, accept_sync]
 
 __all__ = ["RULES"]

@@ -1,0 +1,110 @@
+"""R009 per-token-host-sync: accept-count readback inside a scheduler loop.
+
+The speculative-decode contract (``mxtpu.serving.spec``) is ONE sanctioned
+host readback per verify dispatch: the engine lands ``(outs, lives)`` with
+a single ``np.asarray`` pair and every per-slot decision — how many tokens
+were accepted, what to emit, where the cursor moved — runs on that host
+copy.  The tempting alternative is a per-slot (or worse, per-token) loop
+that calls ``.item()`` / ``int()`` / ``np.asarray()`` on the DEVICE
+accept-count array each iteration; on the tunneled TPU runtime each such
+call is a 30–100 ms device→host round trip, so a k=4 verify over 8 slots
+pays up to 32 syncs for a dispatch whose entire point was to cost one.
+The win silently inverts: speculation *slows decode down* while every
+bit-exactness test stays green.
+
+Flagged: a host-materializing call (``.item()`` / ``.tolist()`` /
+``int()`` / ``float()`` / ``np.asarray()``-family) **inside a ``for`` /
+``while`` loop** whose receiver/argument names an accept/verify-family
+value (``accept``/``accepted``/``accept_len``/``lives``/``verify_out``
+substrings).  The blessed shape — the one readback outside the loop,
+host-side indexing inside — never trips: names carrying a host-copy
+suffix (``lives_np`` / ``accepts_host`` / ``*_cpu``) are exempt, as are
+static quantities (``int(x.shape[0])``, ``len(...)``), mirroring R001.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, dotted_name
+
+RULE_ID = "R009"
+TITLE = "per-token-host-sync"
+
+# substrings marking an accept/verify-family value (the arrays the verify
+# program returns and the per-slot accept accounting derives from)
+_ACCEPT_HINTS = ("accept", "lives", "verify_out")
+
+_SYNC_METHODS = {"item", "asscalar", "tolist", "asnumpy"}
+_SYNC_FUNCS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+               "jax.device_get", "device_get"}
+_CONCRETIZERS = {"int", "float", "bool"}
+# static (python-int) quantities: int(acc.shape[0]) is not a host sync
+_STATIC_HINTS = {"shape", "ndim", "size", "len", "range", "dtype", "dims"}
+
+
+# suffixes declaring "already landed on the host" — the blessed readback
+# names its numpy copies this way (outs_np / lives_np), and touching those
+# in a loop is exactly the pattern the rule steers toward
+_HOST_SUFFIXES = ("_np", "_host", "_cpu")
+
+
+def _mentions_accept(node) -> bool:
+    for n in ast.walk(node):
+        name = None
+        if isinstance(n, ast.Name):
+            name = n.id
+        elif isinstance(n, ast.Attribute):
+            name = n.attr
+        if name is not None:
+            low = name.lower()
+            if any(low.endswith(s) for s in _HOST_SUFFIXES):
+                continue
+            if any(h in low for h in _ACCEPT_HINTS):
+                return True
+    return False
+
+
+def _mentions_static(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in _STATIC_HINTS:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_HINTS:
+            return True
+        if isinstance(n, ast.Call) and (dotted_name(n.func) or "") == "len":
+            return True
+    return False
+
+
+def _in_loop(ctx, node) -> bool:
+    return any(isinstance(a, (ast.For, ast.AsyncFor, ast.While))
+               for a in ctx.ancestors(node))
+
+
+def check(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = None
+        hit = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_METHODS:
+            target = node.func.value
+            hit = f".{node.func.attr}()"
+        else:
+            name = dotted_name(node.func)
+            if name in _SYNC_FUNCS and node.args:
+                target = node.args[0]
+                hit = f"{name}()"
+            elif name in _CONCRETIZERS and len(node.args) == 1:
+                target = node.args[0]
+                hit = f"{name}()"
+        if target is None or not _mentions_accept(target) \
+                or _mentions_static(target) or not _in_loop(ctx, node):
+            continue
+        yield Finding(
+            ctx.path, node.lineno, node.col_offset, RULE_ID,
+            f"{TITLE}: {hit} on an accept/verify-family array inside a "
+            f"loop syncs the host once per iteration — land (outs, lives) "
+            f"with ONE np.asarray per verify dispatch outside the loop and "
+            f"index the host copy inside it")

@@ -203,6 +203,94 @@ class TransformerLM(HybridBlock):
 
         return step
 
+    def serving_verify_step(self, S: int, TOT: int, K1: int):
+        """Speculative-decode verifier: one forward scoring ``K1`` = k + 1
+        consecutive positions per slot against the same paged KV cache.
+
+        Returns ``step(params, caches, toks, p) -> (new_caches, logits)``
+        where ``toks`` is ``(S, K1)`` int32 — ``toks[s, 0]`` is the slot's
+        current token (what plain decode would feed at ``p[s]``) and
+        ``toks[s, j]`` for ``j >= 1`` the j-th drafted token, fed at
+        position ``p[s] + j`` — and ``logits`` is ``(S, K1, vocab)``:
+        row ``j`` is the model's prediction for position ``p[s] + j + 1``.
+
+        Bit-exactness with :meth:`serving_step` is structural, not
+        approximate: the dense projections run on the flattened
+        ``(S * K1, U)`` row batch (each row the same dot product the
+        single-step path computes), all ``K1`` K/V rows are scattered
+        before any query attends, and attention runs per drafted position
+        ``j`` through the IDENTICAL ``"bhd,bhtd->bht"`` einsum with the
+        causal mask ``t <= p + j`` — so query ``j`` sees exactly the rows
+        sequential decode would have written by step ``j``. A rejected
+        draft leaves garbage K/V rows above the accept point; they sit
+        beyond every surviving query's mask and are overwritten in order
+        by the next dispatch before anything attends them, so rollback is
+        host cursor arithmetic only."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        H = self.blocks[0].attn._heads
+        U = self._units
+        D = U // H
+        scale = 1.0 / math.sqrt(D)
+
+        def ln(x, g, b, eps=1e-5):
+            m = jnp.mean(x, axis=-1, keepdims=True)
+            v = jnp.var(x, axis=-1, keepdims=True)
+            return (x - m) * lax.rsqrt(v + eps) * g + b
+
+        def step(params, caches, toks, p):
+            rows = jnp.arange(S)
+            # (S, K1) per-slot write positions p..p+K1-1, clipped like the
+            # single-step path; clipped duplicates land on row TOT-1, which
+            # no live query ever attends (max fed position is limit - 1)
+            pcs = jnp.clip(p[:, None] + jnp.arange(K1)[None, :], 0, TOT - 1)
+            x = params["embed"][toks] + params["pos"][pcs]     # (S, K1, U)
+            # query j may see rows 0..p+j only — the rows sequential decode
+            # would have written by its j-th step
+            mask = jnp.arange(TOT)[None, None, :] <= pcs[:, :, None]
+            new_caches = caches
+            for i, lp in enumerate(params["layers"]):
+                h = ln(x, lp["ln1_g"], lp["ln1_b"])
+                flat = h.reshape(S * K1, U)       # per-row dots == decode's
+                q = (flat @ lp["qw"].T + lp["qb"]).reshape(S, K1, H, D)
+                k = (flat @ lp["kw"].T + lp["kb"]).reshape(S, K1, H, D)
+                v = (flat @ lp["vw"].T + lp["vb"]).reshape(S, K1, H, D)
+                kv_dt = new_caches.dtype
+                # every position's row lands before any query attends; the
+                # j-loop keeps writes ordered so a clipped collision at
+                # TOT-1 resolves deterministically (last write wins)
+                for j in range(K1):
+                    new_caches = new_caches.at[i, 0, rows, :, pcs[:, j]].set(
+                        k[:, j].astype(kv_dt))
+                    new_caches = new_caches.at[i, 1, rows, :, pcs[:, j]].set(
+                        v[:, j].astype(kv_dt))
+                K = new_caches[i, 0]              # (S, H, TOT, D)
+                V = new_caches[i, 1]
+                ctxs = []
+                for j in range(K1):
+                    s = jnp.einsum("bhd,bhtd->bht", q[:, j], K) * scale
+                    s = jnp.where(mask[:, j][:, None, :], s, -1e30)
+                    att = jax.nn.softmax(s, axis=-1)
+                    ctxs.append(jnp.einsum("bht,bhtd->bhd", att, V))
+                ctx = jnp.stack(ctxs, axis=1).reshape(S, K1, U)
+                x = x + (ctx.reshape(S * K1, U) @ lp["ow"].T
+                         + lp["ob"]).reshape(S, K1, U)
+                g = ln(x, lp["ln2_g"], lp["ln2_b"])
+                g = jax.nn.gelu(g.reshape(S * K1, U) @ lp["f1w"].T
+                                + lp["f1b"], approximate=False)
+                x = x + (g @ lp["f2w"].T + lp["f2b"]).reshape(S, K1, U)
+            h = ln(x, params["ln_f_g"], params["ln_f_b"])
+            hf = h.reshape(S * K1, U)
+            if self._tie:
+                logits = hf @ params["embed"].T
+            else:
+                logits = hf @ params["head_w"].T + params["head_b"]
+            return new_caches, logits.reshape(S, K1, self._vocab)
+
+        return step
+
     def serving_sample(self):
         """Per-slot next-token selection shared by the serving decode and
         chunked-prefill programs (``serving/kv.py``): returns

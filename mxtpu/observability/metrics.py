@@ -342,6 +342,16 @@ _SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
                  # live elasticity: requests carried across an engine
                  # drain()/adopt() handoff (zero-drop contract)
                  "drained": 0, "adopted": 0,
+                 # speculative decode (mxtpu.serving.spec): verify dispatches
+                 # taken instead of plain decode turns; tokens the drafter
+                 # proposed vs how many the verify forward accepted/rejected
+                 # (accepted + rejected == drafted over any window); n-gram
+                 # side-index probes on the prefix radix tree. The
+                 # accept-length distribution itself is histogram-backed
+                 # ("serving/accept_len" -> accept_len_mean + percentiles)
+                 "spec_dispatches": 0, "tokens_drafted": 0,
+                 "tokens_accepted": 0, "tokens_rejected": 0,
+                 "ngram_hits": 0, "ngram_misses": 0,
                  "queue_depth_max": 0, "slots": 0,
                  "slot_occupancy_sum": 0.0, "occupancy_samples": 0,
                  "ttft_ms_total": 0.0, "ttft_ms_last": 0.0,
@@ -380,6 +390,10 @@ _SERVING_STR = ("kv_dtype", "decode_kernel")
 # percentiles in ``get_serving_stats()`` all derive from "serving/<base>"
 _SERVING_LATENCY = ("ttft_ms", "queue_wait_ms", "prefill_ms",
                     "first_decode_ms", "token_ms", "decode_ms")
+# non-latency histogram series: same "<base>_last" -> "serving/<base>"
+# routing and readback as the latency keys (accept_len is the per-slot
+# accepted-token count of one speculative verify dispatch)
+_SERVING_HIST = ("accept_len",)
 
 
 def record_serving(key: str, n=1):
@@ -392,7 +406,8 @@ def record_serving(key: str, n=1):
     guarded write per sample instead of the old torn last+total scalar
     pair — and read back (last/total/percentiles) by
     :func:`get_serving_stats`."""
-    if key.endswith("_ms_last"):
+    if key.endswith("_ms_last") or (key.endswith("_last")
+                                    and key[:-5] in _SERVING_HIST):
         _hist.record_value("serving/" + key[:-5], float(n))
         return
     with _stats_lock:
@@ -476,7 +491,7 @@ def get_serving_stats() -> dict:
     out["prefix_hit_rate"] = (out["prefix_hits"] / probes) if probes else 0.0
     # latency series: read outside _stats_lock (histogram store has its own
     # lock; never nest the two — R004 discipline)
-    for base in _SERVING_LATENCY:
+    for base in _SERVING_LATENCY + _SERVING_HIST:
         h = _hist.get_histogram("serving/" + base)
         if h is not None and h.count:
             s = h.summary()
@@ -489,6 +504,12 @@ def get_serving_stats() -> dict:
             out[base + "_count"] = 0
             for _q, name in _hist.QUANTILES:
                 out[f"{base}_{name}"] = 0.0
+    # the speculative-decode headline number: mean accepted tokens per live
+    # slot per verify dispatch (>= 1.0 always — the bonus token; > 1.0 means
+    # drafts are landing and decode is running faster than one token/turn)
+    out["accept_len_mean"] = (out.get("accept_len_total", 0.0)
+                              / out["accept_len_count"]
+                              if out["accept_len_count"] else 0.0)
     # per-tenant series (only when something recorded them — the plain
     # engine's stats dict is unchanged): counters + quantiles of every
     # "serving/tenant/<t>/<base>" histogram (read outside _stats_lock)

@@ -39,7 +39,7 @@ from jax import lax
 from . import kv_quant
 
 __all__ = ["QuantSpec", "parse_quant", "quantize_lm", "build_step",
-           "quant_param_specs"]
+           "build_verify_step", "quant_param_specs"]
 
 # weight tensors of one transformer layer's _gen_params dict that carry a
 # matmul (biases/norms excluded); "embed" is handled separately (tied head)
@@ -270,6 +270,118 @@ def build_step(model, S: int, TOT: int, spec: QuantSpec, decode_kernel=None):
         else:
             logits = h @ params["embed"].T                      # (S, vocab)
         return new_caches, logits
+
+    return step
+
+
+def build_verify_step(model, S: int, TOT: int, K1: int, spec: QuantSpec,
+                      decode_kernel=None):
+    """The quantized twin of :meth:`TransformerLM.serving_verify_step`:
+    one forward scoring ``K1`` = k + 1 consecutive positions per slot for
+    speculative decode, over quantized KV and/or int8 weights.
+
+    Bit-exactness with :func:`build_step` is structural, exactly as the
+    fp32 pair: dense matmuls run on the flattened ``(S * K1, in)`` row
+    batch (per-row activation scales make each row's int8 dot identical to
+    the single-step one), all ``K1`` K/V rows quantize-on-append before
+    any query reads, and the attention read loops the drafted positions
+    through the SAME :func:`~mxtpu.ops.quant_attention
+    .dequant_attention_decode` call the decode step issues — one position
+    per call, per-slot read cursor ``p + j`` — on both the pallas and the
+    xla kernel. Rejected drafts leave quantized garbage rows (data AND
+    per-row scales) above the accept point; both are overwritten
+    congruently by the next dispatch before anything attends them, so the
+    int8 scales roll back with the write cursor for free."""
+    H = model.blocks[0].attn._heads
+    U = model._units
+    D = U // H
+    scale = 1.0 / math.sqrt(D)
+    wq = spec.weights == "int8"
+    kvq = spec.kv
+    if kvq:
+        from ..ops import quant_attention
+        dec_kernel = quant_attention.resolve_decode_kernel(
+            decode_kernel, TOT=TOT, D=D)
+
+    def ln(x, g, b, eps=1e-5):
+        m = jnp.mean(x, axis=-1, keepdims=True)
+        v = jnp.var(x, axis=-1, keepdims=True)
+        return (x - m) * lax.rsqrt(v + eps) * g + b
+
+    def mm(h, lp, w, b):
+        if wq:
+            return _int8_matmul(h, lp[w + "_q"], lp[w + "_s"]) + lp[b]
+        return h @ lp[w].T + lp[b]
+
+    def step(params, caches, toks, p):
+        rows = jnp.arange(S)
+        pcs = jnp.clip(p[:, None] + jnp.arange(K1)[None, :], 0, TOT - 1)
+        if wq:
+            x = kv_quant.dequantize_rows(params["embed_q"][toks],
+                                         params["embed_s"][toks]) \
+                + params["pos"][pcs]
+        else:
+            x = params["embed"][toks] + params["pos"][pcs]   # (S, K1, U)
+        mask = jnp.arange(TOT)[None, None, :] <= pcs[:, :, None]
+        new_caches = caches
+        for i, lp in enumerate(params["layers"]):
+            h = ln(x, lp["ln1_g"], lp["ln1_b"])
+            flat = h.reshape(S * K1, U)
+            q = mm(flat, lp, "qw", "qb").reshape(S, K1, H, D)
+            k = mm(flat, lp, "kw", "kb").reshape(S, K1, H, D)
+            v = mm(flat, lp, "vw", "vb").reshape(S, K1, H, D)
+            if kvq:
+                data, scl = new_caches.data, new_caches.scale
+                for j in range(K1):
+                    k_q, k_s = kv_quant.quantize_rows(k[:, j], kvq)
+                    v_q, v_s = kv_quant.quantize_rows(v[:, j], kvq)
+                    data = data.at[i, 0, rows, :, pcs[:, j]].set(k_q) \
+                               .at[i, 1, rows, :, pcs[:, j]].set(v_q)
+                    scl = scl.at[i, 0, rows, :, pcs[:, j]].set(k_s) \
+                             .at[i, 1, rows, :, pcs[:, j]].set(v_s)
+                new_caches = kv_quant.QuantKV(data, scl, kvq)
+                ctx = jnp.stack([
+                    quant_attention.dequant_attention_decode(
+                        q[:, j], new_caches.data[i, 0],
+                        new_caches.scale[i, 0], new_caches.data[i, 1],
+                        new_caches.scale[i, 1], pcs[:, j], scale=scale,
+                        kernel=dec_kernel)
+                    for j in range(K1)], axis=1).reshape(S, K1, U)
+            else:
+                for j in range(K1):
+                    new_caches = new_caches \
+                        .at[i, 0, rows, :, pcs[:, j]].set(k[:, j]) \
+                        .at[i, 1, rows, :, pcs[:, j]].set(v[:, j])
+                K = new_caches[i, 0]            # (S, H, TOT, D)
+                V = new_caches[i, 1]
+                ctxs = []
+                for j in range(K1):
+                    s = jnp.einsum("bhd,bhtd->bht", q[:, j], K) * scale
+                    s = jnp.where(mask[:, j][:, None, :], s, -1e30)
+                    att = jax.nn.softmax(s, axis=-1)
+                    ctxs.append(jnp.einsum("bht,bhtd->bhd", att, V))
+                ctx = jnp.stack(ctxs, axis=1).reshape(S, K1, U)
+            x = x + mm(ctx.reshape(S * K1, U), lp, "ow",
+                       "ob").reshape(S, K1, U)
+            g = ln(x, lp["ln2_g"], lp["ln2_b"])
+            g = jax.nn.gelu(mm(g.reshape(S * K1, U), lp, "f1w", "f1b"),
+                            approximate=False)
+            x = x + mm(g, lp, "f2w", "f2b").reshape(S, K1, U)
+        h = ln(x, params["ln_f_g"], params["ln_f_b"])
+        hf = h.reshape(S * K1, U)
+        if wq:
+            if "head_w_q" in params:
+                logits = _int8_matmul(hf, params["head_w_q"],
+                                      params["head_w_s"]) + params["head_b"]
+            else:
+                logits = _int8_matmul(hf, params["embed_q"],
+                                      params["embed_s"])
+        elif "head_w" in params:
+            logits = hf @ params["head_w"].T + params["head_b"]
+        else:
+            logits = hf @ params["embed"].T
+        V = logits.shape[-1]
+        return new_caches, logits.reshape(S, K1, V)
 
     return step
 

@@ -8,8 +8,11 @@ at each scheduler-loop turn. Three decisions live here:
 * **admission order** — strict latency-tier priority (``interactive`` >
   ``standard`` > ``batch``) and, within a tier, weighted fair-share across
   tenants via stride scheduling: each tenant carries a *pass* value
-  advanced by ``request_tokens / weight`` when one of its requests is
-  picked, and the pending request of the lowest-pass tenant goes next, so
+  advanced by ``prompt_tokens / weight`` when one of its requests is
+  picked and by ``delivered_tokens / weight`` as decode actually serves
+  it (:meth:`SLOScheduler.charge_tokens` — so a speculative verify turn
+  that lands several tokens bills all of them, not one turn), and the
+  pending request of the lowest-pass tenant goes next, so
   a tenant flooding the queue cannot starve the others no matter how many
   requests it stacks up (selection and charging are split — see
   :meth:`SLOScheduler.charge` — so a saturated engine re-selecting every
@@ -203,15 +206,34 @@ class SLOScheduler:
 
     def charge(self, req) -> None:
         """Commit a :meth:`select` winner: advance its tenant's stride
-        pass by ``total / weight`` (a new tenant enters at the current
-        pass floor, not at zero, so it cannot monopolize on arrival) and
-        count the pick. Call exactly once per admitted request."""
+        pass by ``prompt_tokens / weight`` (a new tenant enters at the
+        current pass floor, not at zero, so it cannot monopolize on
+        arrival) and count the pick. Call exactly once per admitted
+        request. Admission bills the PROMPT only — decode work is billed
+        as it is actually served via :meth:`charge_tokens`, so a
+        speculative engine's accepted multi-token turns (and early
+        cancels/expiries) charge for real tokens delivered, not for the
+        ``max_new`` the request merely asked for."""
         with self._lock:
             floor = min(self._pass.values()) if self._pass else 0.0
             t = req.tenant
             self._pass[t] = (self._pass.get(t, floor)
-                             + req.total / self.weight(t))
+                             + len(req.prompt) / self.weight(t))
             self.picks += 1
+
+    def charge_tokens(self, tenant: str, tokens: int) -> None:
+        """Advance ``tenant``'s stride pass by ``tokens / weight`` for
+        decode tokens actually DELIVERED (the engine calls this per emit
+        with the accepted count — one per plain decode turn, up to
+        ``k + 1`` per speculative verify turn). Keeps fair share honest
+        under speculation: a tenant whose prompts draft well is billed
+        for every token it receives, not one unit per turn."""
+        if tokens <= 0:
+            return
+        with self._lock:
+            floor = min(self._pass.values()) if self._pass else 0.0
+            self._pass[tenant] = (self._pass.get(tenant, floor)
+                                  + tokens / self.weight(tenant))
 
     def shed_error(self, req, now: float) -> ShedError:
         est = self.estimate_service_s(req)

@@ -21,10 +21,12 @@ from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING, SHED, TIERS,
                   SamplingParams, ServingConfig, ServingRequest, ShedError)
 from .chained import ChainedPredictor
 from .engine import ServingEngine, ServingHandoff
+from .spec import Drafter, NgramDrafter, SpecConfig
 from . import kv
 
 __all__ = ["ChainedPredictor", "ServingEngine", "ServingHandoff",
            "ServingRequest", "SamplingParams", "ServingConfig",
+           "SpecConfig", "Drafter", "NgramDrafter",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
            "ShedError", "TIERS",
            "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "SHED",

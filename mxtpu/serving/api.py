@@ -110,7 +110,12 @@ class ServingConfig:
     policy/scheduler instance; None keeps the plain FIFO engine
     byte-identical to before. ``prefill_batch`` (> 1, sched mode only)
     packs up to that many pending prompts into ONE batched prefill chunk
-    program per scheduler turn (``mxtpu.sched.admission``)."""
+    program per scheduler turn (``mxtpu.sched.admission``).
+
+    ``spec`` enables speculative multi-token decode — a
+    :class:`~mxtpu.serving.spec.SpecConfig` or an integer draft depth
+    ``k`` (the ``MXTPU_SPEC_DECODE`` knob; see ``docs/serving.md``). None
+    keeps the engine byte-identical to the non-speculative path."""
     slots: Optional[int] = None
     queue_depth: Optional[int] = None
     chunk: Optional[int] = None
@@ -122,6 +127,7 @@ class ServingConfig:
     decode_kernel: Optional[str] = None
     sched: object = None
     prefill_batch: Optional[int] = None
+    spec: object = None
 
 
 class ServingRequest:

@@ -94,6 +94,7 @@ from ..step_cache import ProgramCache
 from . import kv
 from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING, SHED,
                   QueueFullError, ServingConfig, ServingRequest)
+from .spec import NgramDrafter, parse_spec, spec_from_env
 
 __all__ = ["ServingEngine", "ServingHandoff"]
 
@@ -126,6 +127,15 @@ class ServingHandoff:
     sched_state: Optional[dict] = None        # SLOScheduler.export_state():
     #   fair-share passes + service-rate EWMAs, so the successor's policy
     #   doesn't restart cold
+    spec: Optional[dict] = None               # speculative-decode state of the
+    #   source engine ({"k": draft depth}); entries/parked then also carry
+    #   per-slot "draft" (proposed tokens) + "dlen" (how many are live). The
+    #   verify cursor is the entry's own "p" — drafts are proposed BETWEEN
+    #   dispatches, so a drained slot's p is always at a verify boundary and
+    #   its in-flight drafts are pure proposals (no K/V written for them
+    #   yet). adopt() on a spec-less engine refuses in-flight drafts, the
+    #   parked-slots rule's mirror; a spec engine with a different k safely
+    #   truncates or re-proposes (drafts are advisory by construction)
 
     @property
     def in_flight(self) -> int:
@@ -170,7 +180,7 @@ class ServingEngine:
                  prefix_cache_mb: Optional[float] = None,
                  kv_dtype=None, quant=None, decode_kernel=None,
                  sched=None, prefill_batch: Optional[int] = None,
-                 config: Optional[ServingConfig] = None):
+                 spec=None, config: Optional[ServingConfig] = None):
         if config is not None:
             slots = slots or config.slots
             queue_depth = queue_depth or config.queue_depth
@@ -189,7 +199,16 @@ class ServingEngine:
                 sched = config.sched
             if prefill_batch is None:
                 prefill_batch = config.prefill_batch
+            if spec is None:
+                spec = config.spec
         self._model = model
+        # speculative multi-token decode (mxtpu.serving.spec): like quant,
+        # ONE resolved config per engine lifetime (kwarg > config >
+        # MXTPU_SPEC_DECODE env) — the verify program cache stays keyed on
+        # (slots, bucket, k); None keeps every path below byte-identical
+        self._spec = parse_spec(spec) if spec is not None else spec_from_env()
+        self._drafter = (self._spec.drafter
+                         if self._spec is not None else None)
         # low-precision execution (mxtpu.quant): ONE spec per engine
         # lifetime, resolved kwarg > config > env — the program caches stay
         # keyed on (slots, bucket, chunk) because the spec never changes
@@ -227,6 +246,7 @@ class ServingEngine:
         self._start_lock = threading.Lock()
         self._decode_fns = ProgramCache("serving_decode")
         self._prefill_fns = ProgramCache("serving_prefill")
+        self._verify_fns = ProgramCache("serving_verify")
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._started = threading.Event()
@@ -249,6 +269,15 @@ class ServingEngine:
         self._t_admit = np.zeros(self.slots, np.float64)
         self._dec_emitted = np.zeros(self.slots, bool)
         self._reqs: List[Optional[ServingRequest]] = [None] * self.slots
+        # per-slot speculative draft buffers (scheduler-thread-owned):
+        # proposed at the END of a decode turn, consumed by the next verify
+        # dispatch — so a drain() between turns carries genuine in-flight
+        # drafts. dlen == 0 means "plain decode this turn" for the slot
+        if self._spec is not None:
+            self._draft = np.zeros((self.slots, self._spec.k), np.int32)
+            self._dlen = np.zeros(self.slots, np.int32)
+        self._ngram_hits_seen = 0
+        self._ngram_misses_seen = 0
         # partial-prefill cursor (scheduler-thread-owned; at most one
         # request prefills at a time, one CHUNK dispatched per loop turn)
         self._pf: Optional[dict] = None
@@ -413,7 +442,7 @@ class ServingEngine:
                     if req._expired(now):
                         self._retire(slot, EXPIRED, now)
                         continue
-                    entries.append({
+                    entry = {
                         "req": req,
                         # one slot row, host-landed: survives the old mesh
                         # (quantized pages keep their data + scale leaves)
@@ -426,7 +455,15 @@ class ServingEngine:
                         "temp": float(self._temp[slot]),
                         "topk": int(self._topk[slot]),
                         "seed": int(self._seed[slot]),
-                    })
+                    }
+                    if self._spec is not None:
+                        # the slot's in-flight drafts (proposed at the end
+                        # of the last turn, not yet verified) ride along;
+                        # "p" doubles as the verify cursor — see the
+                        # ServingHandoff.spec field note
+                        entry["draft"] = self._draft[slot].tolist()
+                        entry["dlen"] = int(self._dlen[slot])
+                    entries.append(entry)
                     tracer.instant("serving/drain_freeze", cat="serving",
                                    args={"id": req.id, "slot": slot,
                                          "p": int(self._p[slot])})
@@ -492,7 +529,8 @@ class ServingEngine:
             tot=self._TOT or 0, entries=entries, partial=partial,
             pending=pending, kv_dtype=self._kv_dtype_str, parked=parked,
             sched_state=self._sched.export_state()
-            if self._sched is not None else None)
+            if self._sched is not None else None,
+            spec={"k": self._spec.k} if self._spec is not None else None)
         profiler.record_serving("drained", handoff.in_flight)
         tracer.instant("serving/drained", cat="serving",
                        args={"in_slots": len(entries),
@@ -533,6 +571,17 @@ class ServingEngine:
                 raise ValueError(
                     "handoff carries preempted (parked) requests — adopt on "
                     "an engine with the SLO scheduler enabled (sched=...)")
+            # mirror of the parked rule for speculation: in-flight drafts are
+            # proposals only (no K/V behind them — "p" is the verify cursor),
+            # but a spec-less engine has no verify program to consume them
+            # and silently dropping speculative state is how handoffs rot
+            in_flight_drafts = sum(
+                int(e.get("dlen") or 0)
+                for e in list(handoff.entries) + list(handoff.parked))
+            if in_flight_drafts and self._spec is None:
+                raise ValueError(
+                    "handoff carries in-flight speculative drafts — adopt on "
+                    "an engine with speculative decode enabled (spec=...)")
             if self._sched is not None:
                 if handoff.sched_state:
                     self._sched.load_state(handoff.sched_state)
@@ -559,6 +608,12 @@ class ServingEngine:
                     self._seed[i] = e.get("seed", 0)
                     self._t_admit[i] = time.monotonic()
                     self._dec_emitted[i] = False
+                    if self._spec is not None and e.get("dlen"):
+                        # a k mismatch truncates (advisory proposals — the
+                        # verify program re-scores whatever survives)
+                        n = min(int(e["dlen"]), self._spec.k)
+                        self._draft[i, :n] = e["draft"][:n]
+                        self._dlen[i] = n
                     self._active[i] = True
                     self._reqs[i] = e["req"]
                     tracer.instant("serving/adopt_resume", cat="serving",
@@ -637,6 +692,10 @@ class ServingEngine:
             block_bytes = kv.block_nbytes(self._model, self._kv_dtype,
                                           self._quant)
             self._prefix = kv.PrefixCache(block_bytes, self.prefix_cache_mb)
+        if self._spec is not None and self._drafter is None:
+            # default drafter: radix-tree n-grams + self-context lookup;
+            # works with the prefix cache disabled too (self-context only)
+            self._drafter = NgramDrafter.from_config(self._spec, self._prefix)
 
     def _run(self) -> None:
         try:
@@ -650,7 +709,10 @@ class ServingEngine:
                 elif self._pfg is not None:   # decode: the stall bound
                     self._prefill_group_chunk()
                 if self._active.any():
-                    self._decode_chunk()
+                    if self._spec is not None:
+                        self._spec_decode_turn()
+                    else:
+                        self._decode_chunk()
                 self._maybe_log()
         except BaseException as e:
             self._error = e
@@ -797,7 +859,7 @@ class ServingEngine:
         cursors ARE the decode chain, so resume is bit-exact for the same
         reason adopt() is."""
         req = self._reqs[slot]
-        self._parked.append({
+        entry = {
             "req": req, "tot": self._TOT,
             "page": kv.slot_page(self._caches, slot),
             "tok": int(self._tok[slot]), "p": int(self._p[slot]),
@@ -805,7 +867,14 @@ class ServingEngine:
             "temp": float(self._temp[slot]), "topk": int(self._topk[slot]),
             "seed": int(self._seed[slot]),
             "dec_emitted": bool(self._dec_emitted[slot]),
-        })
+        }
+        if self._spec is not None:
+            # in-flight drafts park with the slot (pure proposals — no K/V
+            # committed for them yet) and resume where they left off
+            entry["draft"] = self._draft[slot].tolist()
+            entry["dlen"] = int(self._dlen[slot])
+            self._dlen[slot] = 0
+        self._parked.append(entry)
         req._set_state(PENDING)
         self._sched.note_preempt()
         profiler.record_serving("preempted")
@@ -860,6 +929,10 @@ class ServingEngine:
             self._seed[slot] = e["seed"]
             self._t_admit[slot] = now
             self._dec_emitted[slot] = e["dec_emitted"]
+            if self._spec is not None and e.get("dlen"):
+                n = min(int(e["dlen"]), self._spec.k)
+                self._draft[slot, :n] = e["draft"][:n]
+                self._dlen[slot] = n
             self._active[slot] = True
             self._reqs[slot] = req
             req._set_state(RUNNING)
@@ -976,6 +1049,7 @@ class ServingEngine:
             first = req.t_first_token is None
             left = req._emit(valid.tolist(), done_t)
             profiler.record_serving("tokens_out", mem["left"] - left)
+            self._sched.charge_tokens(req.tenant, mem["left"] - left)
             mem["left"] = left
             if first:
                 self._note_first_token(req, done_t, mem["t_start"])
@@ -1164,6 +1238,8 @@ class ServingEngine:
             first = req.t_first_token is None
             left = req._emit(valid.tolist(), done_t)
             profiler.record_serving("tokens_out", pf["left"] - left)
+            if self._sched is not None:
+                self._sched.charge_tokens(req.tenant, pf["left"] - left)
             pf["left"] = left
             if first:
                 self._note_first_token(req, done_t, pf["t_start"])
@@ -1287,10 +1363,12 @@ class ServingEngine:
             fresh = toks_np[lives_np[:, slot], slot]
             if fresh.size:
                 left = req._emit(fresh.tolist(), now)
-                profiler.record_serving("tokens_out",
-                                        int(self._left[slot] - left))
-                emitted_total += int(self._left[slot] - left)
+                got = int(self._left[slot] - left)
+                profiler.record_serving("tokens_out", got)
+                emitted_total += got
                 self._left[slot] = left
+                if self._sched is not None:
+                    self._sched.charge_tokens(req.tenant, got)
                 if not self._dec_emitted[slot]:
                     self._dec_emitted[slot] = True
                     profiler.record_serving(
@@ -1312,6 +1390,150 @@ class ServingEngine:
             # decode-only throughput series: full dispatch wall + its token
             # yield, so decode_tokens / decode_ms_total excludes prefill and
             # scheduler time (the quant_decode_speedup denominator)
+            profiler.record_serving("decode_ms_last",
+                                    (now - t_dispatch) * 1e3)
+            profiler.record_serving("decode_tokens", emitted_total)
+        if self._sched is not None:
+            if emitted_total:
+                self._sched.observe_decode(emitted_total, now - t_dispatch)
+            profiler.record_sched(self._sched.stats())
+
+    # -- speculative decode (mxtpu.serving.spec; spec-mode only below) -------
+    def _spec_decode_turn(self) -> None:
+        """One decode turn under speculation: dispatch the verify program
+        when any slot holds drafts (a slot without them runs a plain
+        single-position step INSIDE the same program — no retrace), fall
+        back to the ordinary decode chunk when nobody does (a cold or
+        miss-everywhere turn keeps plain-chunk throughput), then propose
+        the NEXT turn's drafts from each survivor's updated stream. The
+        end-of-turn proposal order is what makes a drain() between turns
+        carry genuine in-flight drafts."""
+        if int(self._dlen.sum()) > 0:
+            self._verify_chunk()
+        else:
+            self._decode_chunk()
+        self._propose_drafts()
+
+    def _propose_drafts(self) -> None:
+        """Refill the per-slot draft buffers for the next dispatch. Greedy
+        slots only — a sampled slot's next token is a draw, not an argmax,
+        so speculation degrades it to dlen=0 plain decode per slot (the
+        verify program re-checks ``temp`` on-device as well). Proposals
+        are clipped to the slot's remaining live positions; the final
+        token of a request always decodes plain."""
+        k = self._spec.k
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            self._dlen[slot] = 0
+            if self._temp[slot] > 0:
+                continue
+            room = int(self._limit[slot]) - int(self._p[slot]) - 1
+            if room <= 0:
+                continue
+            req = self._reqs[slot]
+            prop = self._drafter.propose(req.prompt + req.tokens(),
+                                         min(k, room))
+            n = min(len(prop), k, room)
+            if n > 0:
+                self._draft[slot, :n] = prop[:n]
+                self._dlen[slot] = n
+                profiler.record_serving("tokens_drafted", n)
+        self._publish_ngram_stats()
+
+    def _publish_ngram_stats(self) -> None:
+        """Mirror the PrefixCache's n-gram lookup counters into the serving
+        stats as deltas (same idiom as prefix_evictions)."""
+        if self._prefix is None:
+            return
+        dh = self._prefix.ngram_hits - self._ngram_hits_seen
+        dm = self._prefix.ngram_misses - self._ngram_misses_seen
+        if dh:
+            profiler.record_serving("ngram_hits", dh)
+        if dm:
+            profiler.record_serving("ngram_misses", dm)
+        self._ngram_hits_seen = self._prefix.ngram_hits
+        self._ngram_misses_seen = self._prefix.ngram_misses
+
+    def _verify_chunk(self) -> None:
+        """Dispatch ONE batched verify: all k+1 positions of every slot
+        scored by a single target forward, greedy accept/reject on-device,
+        then exactly one host readback of (outs, lives) — the sanctioned
+        readback tpulint R009 polices; per-token ``.item()`` loops here
+        would serialize a device sync per accepted token."""
+        k = self._spec.k
+        n_active = int(self._active.sum())
+        span_args = {"active": n_active, "tot": self._TOT, "k": k}
+        if tracer.enabled():
+            span_args["ids"] = [self._reqs[int(s)].id
+                                for s in np.flatnonzero(self._active)]
+        t_dispatch = time.monotonic()
+        with tracer.span("serving/verify", cat="serving", args=span_args):
+            key = (self.slots, self._TOT, k)
+            fn = self._verify_fns.get_or_build(
+                key, lambda: kv.build_verify(
+                    self._model, *key, quant=self._quant,
+                    decode_kernel=self._decode_kernel))
+            caches, tok, p, outs, lives = fn(
+                self._params, self._caches, jnp.asarray(self._tok),
+                jnp.asarray(self._p), jnp.asarray(self._active),
+                jnp.asarray(self._limit), jnp.asarray(self._temp),
+                jnp.asarray(self._topk), jnp.asarray(self._seed),
+                jnp.asarray(self._draft), jnp.asarray(self._dlen))
+            outs_np = np.asarray(outs)
+            lives_np = np.asarray(lives)
+        self._caches = caches
+        self._tok = np.array(tok)
+        self._p = np.array(p)
+        now = time.monotonic()
+        profiler.record_serving("decode_steps")
+        profiler.record_serving("spec_dispatches")
+        profiler.record_serving("kv_dtype", self._kv_dtype_str)
+        if self._decode_kernel_str is not None:
+            profiler.record_serving("decode_kernel", self._decode_kernel_str)
+        profiler.record_serving("kv_bytes_resident",
+                                kv.cache_nbytes(self._caches))
+        profiler.record_serving_occupancy(n_active, self.slots)
+        emitted_total = 0
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            req = self._reqs[slot]
+            fresh = outs_np[slot, lives_np[slot]]
+            drafted = int(self._dlen[slot])
+            self._dlen[slot] = 0          # consumed, hit or miss
+            if fresh.size:
+                left = req._emit(fresh.tolist(), now)
+                got = int(self._left[slot] - left)
+                profiler.record_serving("tokens_out", got)
+                emitted_total += got
+                self._left[slot] = left
+                if self._sched is not None:
+                    self._sched.charge_tokens(req.tenant, got)
+                # accept-length sample: tokens this slot emitted from one
+                # dispatch (1 = no speculation win, k+1 = full accept)
+                e = int(fresh.size)
+                profiler.record_serving("accept_len_last", e)
+                confirmed = min(max(e - 1, 0), drafted)
+                if confirmed:
+                    profiler.record_serving("tokens_accepted", confirmed)
+                if drafted - confirmed:
+                    profiler.record_serving("tokens_rejected",
+                                            drafted - confirmed)
+                if not self._dec_emitted[slot]:
+                    self._dec_emitted[slot] = True
+                    profiler.record_serving(
+                        "first_decode_ms_last",
+                        (now - self._t_admit[slot]) * 1e3)
+                    tracer.instant("serving/first_decode", cat="serving",
+                                   args={"id": req.id})
+            if self._left[slot] == 0:
+                self._retire(slot, DONE, now)
+            elif req._cancelled():
+                self._retire(slot, CANCELLED, now)
+            elif req._expired(now):
+                self._retire(slot, EXPIRED, now)
+        if emitted_total:
+            profiler.record_serving(
+                "token_ms_last", (now - t_dispatch) * 1e3 / emitted_total)
             profiler.record_serving("decode_ms_last",
                                     (now - t_dispatch) * 1e3)
             profiler.record_serving("decode_tokens", emitted_total)
@@ -1345,6 +1567,8 @@ class ServingEngine:
         self._topk[slot] = 0
         self._seed[slot] = 0
         self._dec_emitted[slot] = False
+        if self._spec is not None:
+            self._dlen[slot] = 0
 
     def _maybe_log(self) -> None:
         """Per-interval engine log (``MXTPU_SERVING_LOG_S``): one line with

@@ -56,7 +56,8 @@ from ..quant import kv_quant as qkv
 __all__ = ["bucket32", "cache_dims", "empty_cache", "empty_page", "promote",
            "merge_page", "slot_page", "host_page", "device_page",
            "install_rows", "cache_nbytes", "block_nbytes",
-           "build_prefill_chunk", "build_decode", "PrefixCache"]
+           "build_prefill_chunk", "build_decode", "build_verify",
+           "PrefixCache"]
 
 
 def _kv_mode(quant) -> Optional[str]:
@@ -248,6 +249,86 @@ def build_decode(model, S: int, TOT: int, chunk: int, quant=None,
     return jax.jit(run)
 
 
+def _verify_step_fn(model, S: int, TOT: int, K1: int, quant,
+                    decode_kernel=None):
+    """The verify-step builder: ``serving_verify_step`` on the fp32 path,
+    its quantized twin when a spec is active (same selection rule as
+    :func:`_step_fn`)."""
+    if quant is not None and not isinstance(quant, str) \
+            and getattr(quant, "enabled", False):
+        from ..quant.serve import build_verify_step
+        return build_verify_step(model, S, TOT, K1, quant,
+                                 decode_kernel=decode_kernel)
+    return model.serving_verify_step(S, TOT, K1)
+
+
+def build_verify(model, S: int, TOT: int, k: int, quant=None,
+                 decode_kernel=None):
+    """One compiled speculative-decode VERIFY program for (slots ``S``,
+    KV bucket ``TOT``, draft depth ``k``): a single batched target forward
+    scores all ``k + 1`` positions per slot, then greedy accept/reject
+    runs entirely on-device so the host reads back one (tokens, lives)
+    pair per dispatch — exactly the plain decode chunk's readback shape,
+    transposed (tpulint R009's sanctioned readback).
+
+    Per-slot draft length ``dlen`` rides as a TRACED array: drafter
+    misses (``dlen == 0``), sampled slots, and every mixed accept-length
+    pattern reuse this ONE program — the trace-once contract extends to
+    (S, TOT, k). A ``dlen == 0`` slot degrades to a plain single-position
+    decode step inside the same program (its position-0 output is sampled
+    with the identical (seed, position) key the decode chunk would use),
+    so greedy/sampled mixes never retrace.
+
+    Returns ``verify(params, caches, tok, p, active, limit, temp, topk,
+    seed, draft (S, k) int32, dlen (S,) int32) -> (caches, tok, p,
+    outs (S, k+1), lives (S, k+1))``. ``outs[s, j]`` is the model's token
+    for position ``p[s] + j + 1``; ``lives[s, j]`` marks the emitted
+    prefix: position 0 always (the plain-decode token), position ``j``
+    while every draft below it matched (``draft[s, i] == outs[s, i]`` for
+    ``i < j``) — the emitted run is the accepted drafts plus the one
+    bonus token the verifier computed past them, capped at the slot's
+    live ``limit``. The accepted prefix's K/V rows were written by the
+    forward itself (one append); rejected rows above the accept point are
+    dead weight the next dispatch overwrites before anything attends them
+    (see :meth:`~mxtpu.gluon.model_zoo.transformer.TransformerLM
+    .serving_verify_step`), so rejection "rolls back" by pure host cursor
+    arithmetic — int8 KV scales included."""
+    K1 = k + 1
+    step = _verify_step_fn(model, S, TOT, K1, quant, decode_kernel)
+    sample = model.serving_sample()
+
+    def run(params, caches, tok, p, active, limit, temp, topk, seed,
+            draft, dlen):
+        feeds = jnp.concatenate([tok[:, None], draft], axis=1)  # (S, K1)
+        new_caches, logits = step(params, caches, feeds, p)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, K1)
+        # position 0 goes through the decode chunk's sampler with the
+        # SAME (seed, position) key — a sampled slot (forced dlen=0 by
+        # the drafter) emits a bit-identical stream to plain decode
+        nxt0 = sample(logits[:, 0], temp, topk, seed, p)
+        outs = jnp.concatenate([nxt0[:, None], greedy[:, 1:]], axis=1)
+        # greedy accept: draft j proposes the token for position p+j+1;
+        # its ground truth is outs[:, j] (valid by induction while every
+        # draft below it matched) — cumprod keeps the leading run only
+        dl = jnp.where(temp > 0, 0, dlen)       # sampled slots: k = 0
+        offs = jnp.arange(k)
+        acc = (offs[None, :] < dl[:, None]) & (draft == outs[:, :k])
+        chain = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+        a = chain.sum(axis=1)                   # accepted draft run
+        offs1 = jnp.arange(K1)
+        lives = (active[:, None]
+                 & (p[:, None] + offs1[None, :] < limit[:, None])
+                 & (offs1[None, :] <= a[:, None]))
+        e = lives.sum(axis=1).astype(jnp.int32)  # emitted this dispatch
+        last = jnp.take_along_axis(outs, jnp.maximum(e - 1, 0)[:, None],
+                                   axis=1)[:, 0]
+        tok2 = jnp.where(e > 0, last, tok)
+        p2 = p + e
+        return new_caches, tok2, p2, outs, lives
+
+    return jax.jit(run)
+
+
 # ---------------------------------------------------------------------------
 # shared-prefix radix KV reuse (SGLang RadixAttention over bucketed pages)
 # ---------------------------------------------------------------------------
@@ -276,12 +357,23 @@ class PrefixCache:
     every cached path prefix-closed."""
 
     BLOCK = 32
+    # n-gram side index over the tree's token-id paths (the speculative
+    # drafter's read path): suffix n-grams up to NGRAM tokens map to the
+    # next NGRAM_CONT tokens observed after them, recency-wins, capped at
+    # NGRAM_CAP entries (plain LRU — stale predictions are harmless, the
+    # verifier rejects them)
+    NGRAM = 3
+    NGRAM_CONT = 8
+    NGRAM_CAP = 1 << 16
 
     def __init__(self, block_bytes: int, capacity_mb: float):
         self.block_bytes = int(block_bytes)
         self.capacity_bytes = int(float(capacity_mb) * (1 << 20))
         self.evictions = 0
+        self.ngram_hits = 0
+        self.ngram_misses = 0
         self._nodes: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._ngram: "OrderedDict[tuple, tuple]" = OrderedDict()
 
     def __len__(self) -> int:
         return len(self._nodes)
@@ -371,7 +463,41 @@ class PrefixCache:
             m += self.BLOCK
         if created:
             self._evict()
+        self._index_ngrams(tokens[:m])
         return created
+
+    # -- n-gram side index (the speculative drafter's read path) ------------
+    def _index_ngrams(self, seq) -> None:
+        """Index every 1..NGRAM-token window of the freshly cached path
+        against its following tokens. Recency wins on collision (the tree
+        is LRU; so is its index) and the index is byte-bounded by
+        NGRAM_CAP — entries are token-id tuples, never K/V rows."""
+        seq = tuple(seq)
+        for n in range(1, self.NGRAM + 1):
+            for i in range(len(seq) - n):
+                cont = seq[i + n:i + n + self.NGRAM_CONT]
+                self._ngram[seq[i:i + n]] = cont
+                self._ngram.move_to_end(seq[i:i + n])
+        while len(self._ngram) > self.NGRAM_CAP:
+            self._ngram.popitem(last=False)
+
+    def ngram_lookup(self, suffix, k: int) -> List[int]:
+        """Up to ``k`` continuation tokens proposed for ``suffix`` from the
+        tree's token-id paths — longest indexed n-gram first (a 3-token
+        suffix match beats a 1-token one). Returns ``[]`` on a miss; hits
+        and misses are counted (``ngram_hits`` / ``ngram_misses``, surfaced
+        through ``get_serving_stats()``). Proposals are advisory: the
+        verify pass rejects anything the target model disagrees with, so a
+        stale entry costs speculation efficiency, never correctness."""
+        suffix = tuple(suffix)
+        for n in range(min(self.NGRAM, len(suffix)), 0, -1):
+            cont = self._ngram.get(suffix[len(suffix) - n:])
+            if cont:
+                self._ngram.move_to_end(suffix[len(suffix) - n:])
+                self.ngram_hits += 1
+                return list(cont[:k])
+        self.ngram_misses += 1
+        return []
 
     def _evict(self) -> None:
         while self.bytes > self.capacity_bytes:

@@ -467,6 +467,50 @@ def test_r008_suppressed():
 
 
 # ---------------------------------------------------------------------------
+# R009 per-token-host-sync
+# ---------------------------------------------------------------------------
+
+def test_r009_positive_flags_accept_readback_in_loop():
+    """The speculative-decode anti-pattern: the scheduler loop reads the
+    DEVICE accept-count array once per slot — one device→host round trip
+    per iteration, inverting the verify dispatch's whole point."""
+    findings = _lint("""
+        def scheduler_turn(accept_counts, outs, reqs):
+            for slot, req in enumerate(reqs):
+                n = int(accept_counts[slot])
+                req.emit(outs[slot][:n])
+    """, select=["R009"])
+    assert len(findings) == 1
+    assert findings[0].rule == "R009"
+    assert "np.asarray" in findings[0].message
+
+
+def test_r009_negative_single_readback_outside_loop():
+    """The sanctioned shape: land (outs, lives) with ONE np.asarray pair
+    per verify dispatch, then index the host copies inside the loop."""
+    assert _rules_hit("""
+        import numpy as np
+        def scheduler_turn(outs, lives, reqs):
+            outs_np = np.asarray(outs)
+            lives_np = np.asarray(lives)
+            for slot, req in enumerate(reqs):
+                req.emit(outs_np[slot, lives_np[slot]].tolist())
+        def static_ok(accepted, reqs):
+            for _ in reqs:
+                n = int(accepted.shape[0])
+    """, select=["R009"]) == set()
+
+
+def test_r009_suppressed():
+    findings = _lint("""
+        def turn(accepted, reqs):
+            for slot, req in enumerate(reqs):
+                n = accepted[slot].item()  # mxtpu: ignore[R009]
+    """, select=["R009"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # linter plumbing
 # ---------------------------------------------------------------------------
 

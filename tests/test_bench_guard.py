@@ -116,6 +116,26 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
         == prefix["hit_rate"]
     assert doc["ratchet"]["current"]["serving_ttft_p99_inv"] \
         == pytest.approx(1e3 / prefix["ttft_p99_ms"])
+    # speculative-decode A/B leg (ISSUE 18): the same draftable trace served
+    # spec-off and spec-on at chunk=1 — decode bit-exact in BOTH legs (the
+    # accept/reject contract), speculation demonstrably engaged (the mean
+    # emitted tokens per verify dispatch beat plain decode's 1.0), the
+    # drafted-token ledger balances, and both headline numbers ride the
+    # ratchet under the smoke harness key
+    spec = serving["spec"]
+    assert spec["decode_match"] is True
+    assert spec["off"]["decode_match"] is True
+    assert spec["on"]["decode_match"] is True
+    assert spec["off"]["spec_dispatches"] == 0          # A/B is honest
+    assert spec["on"]["spec_dispatches"] > 0
+    assert spec["on"]["tokens_accepted"] + spec["on"]["tokens_rejected"] \
+        == spec["on"]["tokens_drafted"] > 0
+    assert spec["accept_len_mean"] > 1.0, spec
+    assert spec["spec_decode_speedup"] > 0
+    assert doc["ratchet"]["current"]["spec_decode_speedup"] \
+        == spec["spec_decode_speedup"]
+    assert doc["ratchet"]["current"]["accept_len_mean"] \
+        == spec["accept_len_mean"]
     # TTFT decomposition keys shipped by the engine stats
     assert serving["ttft_queue_wait_ms_mean"] >= 0
     assert serving["ttft_prefill_ms_mean"] > 0
@@ -286,10 +306,20 @@ def test_bench_serving_scenario_cli(tmp_path):
     prefix = serving["prefix"]
     assert prefix["hit_rate"] >= (prefix["requests"] - 1) / prefix["requests"]
     assert prefix["decode_match"] is True
+    # spec A/B leg (ISSUE 18) ships in the serving-only doc too: bit-exact
+    # both legs, speedup + accept length on the ratchet
+    spec = serving["spec"]
+    assert spec["off"]["decode_match"] is True
+    assert spec["on"]["decode_match"] is True
+    assert spec["accept_len_mean"] > 1.0, spec
+    assert spec["on"]["tokens_accepted"] + spec["on"]["tokens_rejected"] \
+        == spec["on"]["tokens_drafted"] > 0
     cur = doc["ratchet"]["current"]
     assert cur["serving_goodput"] == serving["goodput_tok_s"]
     assert cur["prefix_hit_rate"] == prefix["hit_rate"]
     assert cur["serving_ttft_p99_inv"] > 0
+    assert cur["spec_decode_speedup"] == spec["spec_decode_speedup"] > 0
+    assert cur["accept_len_mean"] == spec["accept_len_mean"]
     assert doc["ratchet"]["harness"] == "serving-smoke"
 
 
@@ -353,6 +383,15 @@ def test_bench_traffic_scenario_cli(tmp_path):
     assert scale["ticks"] == traffic["requests"]
     assert scale["actuated"] is False
     assert sum(scale["actions"].values()) == scale["ticks"]
+    # sched+spec third leg (ISSUE 18): speculation under the full SLO
+    # control plane — preemption included — replays the same trace bit-exact
+    # and the drafted-token counters engaged
+    spec = traffic["spec"]
+    assert spec["decode_match"] is True
+    assert spec["spec_dispatches"] > 0
+    assert spec["tokens_drafted"] > 0
+    assert spec["accept_len_mean"] > 1.0, spec
+    assert spec["goodput_under_slo"] > 0
     cur = doc["ratchet"]["current"]
     assert cur["goodput_under_slo"] == traffic["goodput_under_slo"]
     assert doc["ratchet"]["harness"] == "traffic-smoke"

@@ -479,6 +479,31 @@ def test_serving_handoff_carries_sched_state_and_parked_slots(net):
         bare.adopt(ServingHandoff(tot=128, parked=[{"req": None}]))
 
 
+def test_spec_handoff_refused_by_specless_engine(net):
+    """ISSUE 18 satellite, mirror of the parked-slots rule above: a
+    handoff carrying in-flight speculative drafts (un-verified proposals
+    in an entry's ``draft``/``dlen``) needs a successor with a verify
+    program — a spec-less engine must refuse it up front, in both the
+    in-slot and the parked-while-drafted shapes, rather than silently
+    dropping speculative state."""
+    from mxtpu.serving import ServingEngine, ServingHandoff
+    bare = ServingEngine(net, slots=1, queue_depth=8, chunk=4)
+    with pytest.raises(ValueError, match="draft"):
+        bare.adopt(ServingHandoff(
+            tot=128, spec={"k": 4},
+            entries=[{"req": None, "dlen": 2, "draft": [3, 4, 0, 0]}]))
+    sched = ServingEngine(net, slots=1, queue_depth=8, chunk=4, sched=True)
+    with pytest.raises(ValueError, match="draft"):
+        sched.adopt(ServingHandoff(
+            tot=128, spec={"k": 4},
+            parked=[{"req": None, "dlen": 1, "draft": [5, 0, 0, 0]}]))
+    # drafts all verified by drain time: adoptable by anyone (advisory
+    # spec tag alone never blocks)
+    eng2 = ServingEngine(net, slots=1, queue_depth=8, chunk=4)
+    eng2.adopt(ServingHandoff(tot=0, spec={"k": 4}))
+    eng2.stop()
+
+
 def test_serving_drain_fault_sweeps_instead_of_blocking(net, monkeypatch):
     """A fault at the ``serving.drain`` seam aborts the handoff — the
     cancel-everything sweep must still run so no caller blocks forever."""

@@ -178,3 +178,33 @@ def test_prefix_cache_pins_block_eviction_newcomer_self_evicts():
     m, _, p = cache.match(t3, limit=32)
     assert m == 32
     cache.release(p)
+
+
+def test_prefix_cache_ngram_lookup_and_counters():
+    # the side index built during insert(): every 1..3-gram window of the
+    # cached token path maps to the tokens that followed it
+    cache = kv.PrefixCache(block_bytes=1, capacity_mb=1)
+    tokens = list(range(100))
+    cache.insert(tokens, _page(96, seed=3), limit=96)
+    # longest-suffix match first: the trailing 3-gram of the probe
+    assert cache.ngram_lookup([50, 51, 52], 4) == [53, 54, 55, 56]
+    assert cache.ngram_lookup([90, 30, 31], 2) == [32, 33]   # 2-gram backoff
+    assert cache.ngram_lookup([7777, 8888], 4) == []          # miss
+    assert cache.ngram_hits == 2 and cache.ngram_misses == 1
+    # k caps the continuation; the index itself stores a bounded window
+    assert cache.ngram_lookup([10], 3) == [11, 12, 13]
+    assert len(cache.ngram_lookup([20, 21], 99)) <= kv.PrefixCache.NGRAM_CONT
+
+
+def test_prefix_cache_ngram_recency_wins_and_is_bounded():
+    cache = kv.PrefixCache(block_bytes=1, capacity_mb=1)
+    cache.insert([1, 2, 3, 4, 5] + list(range(50, 77)),
+                 _page(32, seed=1), limit=32)
+    assert cache.ngram_lookup([1, 2, 3], 2) == [4, 5]
+    # a later insert re-binding the same 3-gram replaces the continuation
+    # (recency wins — the newest prompt's statistics are the freshest)
+    cache.insert([1, 2, 3, 9, 9] + list(range(80, 107)),
+                 _page(32, seed=2), limit=32)
+    assert cache.ngram_lookup([1, 2, 3], 2) == [9, 9]
+    # the index is LRU-bounded: it can never outgrow NGRAM_CAP entries
+    assert len(cache._ngram) <= kv.PrefixCache.NGRAM_CAP
