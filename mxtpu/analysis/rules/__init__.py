@@ -7,9 +7,11 @@ catalog and the PR 2 / PR 4 incidents each one would have caught).
 """
 
 from . import (host_sync, donation, nondeterminism, thread_shared, excepts,
-               span_leak, quant_dequant, unbounded_map, accept_sync)
+               span_leak, quant_dequant, unbounded_map, accept_sync,
+               router_block)
 
 RULES = [host_sync, donation, nondeterminism, thread_shared, excepts,
-         span_leak, quant_dequant, unbounded_map, accept_sync]
+         span_leak, quant_dequant, unbounded_map, accept_sync,
+         router_block]
 
 __all__ = ["RULES"]

@@ -33,6 +33,14 @@ from ..nn.basic_layers import Dense, Embedding, LayerNorm
 __all__ = ["TransformerBlock", "TransformerLM", "transformer_lm"]
 
 
+def _constrain_raw(x, entry: str):
+    """Raw-jnp twin of ``_layout_constrain`` for the serving step functions
+    (identity outside ``parallel.fsdp.layout_scope`` — the sharded serving
+    engine opens the scope around every program trace)."""
+    from ...parallel import fsdp as _fsdp
+    return _fsdp.constrain(x, entry)
+
+
 class TransformerBlock(HybridBlock):
     """One pre-LN decoder block: causal flash MHA + position-wise FFN."""
 
@@ -169,6 +177,7 @@ class TransformerLM(HybridBlock):
             rows = jnp.arange(S)
             pc = jnp.clip(p, 0, TOT - 1)
             x = params["embed"][tok] + params["pos"][pc]       # (S, U)
+            x = _constrain_raw(x, "activations")
             mask = jnp.arange(TOT)[None, :] <= pc[:, None]     # (S, TOT)
             new_caches = caches
             for i, lp in enumerate(params["layers"]):
@@ -189,16 +198,25 @@ class TransformerLM(HybridBlock):
                 s = jnp.where(mask[:, None, :], s, -1e30)
                 att = jax.nn.softmax(s, axis=-1)
                 ctx = jnp.einsum("bht,bhtd->bhd", att, V).reshape(S, U)
+                # all-gather the tp-sharded ctx/g before each row matmul:
+                # the weight is replicated under the serving layout, so the
+                # contraction stays a full local dot — never partial sums +
+                # psum (the bit-exactness contract; mxtpu/serving/sharded.py)
+                ctx = _constrain_raw(ctx, "activations")
                 x = x + ctx @ lp["ow"].T + lp["ob"]
                 g = ln(x, lp["ln2_g"], lp["ln2_b"])
                 g = jax.nn.gelu(g @ lp["f1w"].T + lp["f1b"],
                                 approximate=False)
+                g = _constrain_raw(g, "activations")
                 x = x + g @ lp["f2w"].T + lp["f2b"]
             h = ln(x, params["ln_f_g"], params["ln_f_b"])
             if self._tie:
                 logits = h @ params["embed"].T                  # (S, vocab)
             else:
                 logits = h @ params["head_w"].T + params["head_b"]
+            # pin the carry sharding so the scanned/returned cache matches
+            # the engine's canonical placement (trace-once across dispatches)
+            new_caches = _constrain_raw(new_caches, "kv_cache")
             return new_caches, logits
 
         return step
@@ -247,6 +265,7 @@ class TransformerLM(HybridBlock):
             # no live query ever attends (max fed position is limit - 1)
             pcs = jnp.clip(p[:, None] + jnp.arange(K1)[None, :], 0, TOT - 1)
             x = params["embed"][toks] + params["pos"][pcs]     # (S, K1, U)
+            x = _constrain_raw(x, "activations")
             # query j may see rows 0..p+j only — the rows sequential decode
             # would have written by its j-th step
             mask = jnp.arange(TOT)[None, None, :] <= pcs[:, :, None]
@@ -275,11 +294,14 @@ class TransformerLM(HybridBlock):
                     att = jax.nn.softmax(s, axis=-1)
                     ctxs.append(jnp.einsum("bht,bhtd->bhd", att, V))
                 ctx = jnp.stack(ctxs, axis=1).reshape(S, K1, U)
-                x = x + (ctx.reshape(S * K1, U) @ lp["ow"].T
-                         + lp["ob"]).reshape(S, K1, U)
+                # same all-gather-before-row-matmul contract as serving_step
+                # (replicated ow/f2w under the serving layout: no psum)
+                flatc = _constrain_raw(ctx.reshape(S * K1, U), "activations")
+                x = x + (flatc @ lp["ow"].T + lp["ob"]).reshape(S, K1, U)
                 g = ln(x, lp["ln2_g"], lp["ln2_b"])
                 g = jax.nn.gelu(g.reshape(S * K1, U) @ lp["f1w"].T
                                 + lp["f1b"], approximate=False)
+                g = _constrain_raw(g, "activations")
                 x = x + (g @ lp["f2w"].T + lp["f2b"]).reshape(S, K1, U)
             h = ln(x, params["ln_f_g"], params["ln_f_b"])
             hf = h.reshape(S * K1, U)
@@ -287,6 +309,7 @@ class TransformerLM(HybridBlock):
                 logits = hf @ params["embed"].T
             else:
                 logits = hf @ params["head_w"].T + params["head_b"]
+            new_caches = _constrain_raw(new_caches, "kv_cache")
             return new_caches, logits.reshape(S, K1, self._vocab)
 
         return step

@@ -50,6 +50,7 @@ def collect_snapshot() -> dict:
     from . import metrics
     snap = {
         "serving": metrics.get_serving_stats(),
+        "router": metrics.get_router_stats(),
         "sched": metrics.get_sched_stats(),
         "quant": metrics.get_quant_stats(),
         "comm": metrics.get_comm_stats(),
@@ -99,6 +100,20 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
     lines: list = []
     for store, block in snap.items():
         if store == "histograms":
+            continue
+        # the serving series carry the engine identity (minted at
+        # ServingEngine construction) as a proper Prometheus label, so a
+        # scrape of N sequential single-engine processes stays
+        # distinguishable; the store itself is process-global — with
+        # several in-process engines the label names the LAST writer
+        if store == "serving" and isinstance(block, dict) \
+                and block.get("engine") not in (None, "none"):
+            sub: list = []
+            _flatten(_metric_name("mxtpu", store), block, sub)
+            eng = str(block["engine"]).replace('"', "'")
+            lines.extend(f'{name}{{engine="{eng}"}} {val}'
+                         for name, _, val in
+                         (ln.rpartition(" ") for ln in sub))
             continue
         _flatten(_metric_name("mxtpu", store), block, lines)
     for name, s in snap.get("histograms", {}).items():

@@ -378,13 +378,19 @@ _SERVING_ZERO = {"submitted": 0, "admitted": 0, "completed": 0,
                  # quantized cache ('pallas' | 'xla'; 'none' when the cache
                  # is full-precision and the fused read never engages)
                  "kv_bytes_resident": 0, "kv_dtype": "float32",
-                 "decode_kernel": "none"}
+                 "decode_kernel": "none",
+                 # identity of the engine that last wrote this store (the
+                 # exporter's {engine=...} metric label) — the store is
+                 # process-global, so with several in-process engines the
+                 # label names the LAST writer; a router reads each
+                 # engine.load() for per-replica signals instead
+                 "engine": "none"}
 _serving = dict(_SERVING_ZERO)
 
 # keys that ASSIGN the latest value instead of accumulating
 _SERVING_ASSIGN = ("slots", "prefix_cache_bytes", "kv_bytes_resident")
 # string-valued keys (assign verbatim)
-_SERVING_STR = ("kv_dtype", "decode_kernel")
+_SERVING_STR = ("kv_dtype", "decode_kernel", "engine")
 # latency series backed by the histogram store (``histogram.record_value``):
 # the compat ``<base>_last``/``<base>_total`` keys AND the ``<base>_p*``
 # percentiles in ``get_serving_stats()`` all derive from "serving/<base>"
@@ -534,6 +540,59 @@ def reset_serving_stats():
         _serving.update(_SERVING_ZERO)
         _tenants.clear()
     _hist.reset_histograms(prefix="serving/")
+
+
+# ---------------------------------------------------------------------------
+# multi-replica router observability (mxtpu.serving.router)
+# ---------------------------------------------------------------------------
+
+_ROUTER_ZERO = {"submitted": 0,
+                # routing decisions: prefix-affinity target honored /
+                # affinity target over headroom so the request spilled to
+                # the least-loaded replica / no affinity (short or
+                # cache-opted-out prompt) -> least-loaded
+                "routed_affinity": 0, "routed_spill": 0,
+                "routed_least_loaded": 0,
+                # backpressure: one replica's queue was full and the
+                # request moved on to the next candidate (overflow), or
+                # EVERY replica was full and submit() raised (rejected)
+                "overflow": 0, "rejected": 0,
+                # live-rebalance lifecycle: engine swaps via drain/adopt,
+                # replicas removed, in-flight requests re-routed to a
+                # survivor, and requests LOST in a removal (the zero-drop
+                # contract: this stays 0; anything else is a bug a chaos
+                # test must catch)
+                "rebalanced": 0, "replicas_removed": 0,
+                "requests_rebalanced": 0, "requests_dropped": 0,
+                "fair_share_syncs": 0,
+                "replicas": 0}
+_router = dict(_ROUTER_ZERO)
+_ROUTER_ASSIGN = ("replicas",)
+
+
+def record_router(key: str, n=1):
+    """One router event (``mxtpu.serving.router.Router``): routing
+    decisions, backpressure overflow/rejection, rebalance lifecycle.
+    ``replicas`` assigns the current replica count; everything else
+    accumulates."""
+    with _stats_lock:
+        if key in _ROUTER_ASSIGN:
+            _router[key] = int(n)
+        else:
+            _router[key] += n
+
+
+def get_router_stats() -> dict:
+    """Router counters — the observability contract of
+    :class:`mxtpu.serving.router.Router` (``bench.py serving`` reads
+    these; the exporter serves them under the ``router`` block)."""
+    with _stats_lock:
+        return dict(_router)
+
+
+def reset_router_stats():
+    with _stats_lock:
+        _router.update(_ROUTER_ZERO)
 
 
 # ---------------------------------------------------------------------------

@@ -167,6 +167,13 @@ class SpecLayout:
         device group — the post-all-to-all Ulysses layout."""
         return P(self.data_axes, self.ulysses_axis)
 
+    def kv_cache(self) -> P:
+        """(L, 2, S, H, TOT, D) paged serving KV cache (and its rank-5
+        QuantKV scale): heads on tp. The serving-engine layout
+        (``mxtpu.serving.sharded.ServingLayout``) overrides this to also
+        shard slots over fsdp."""
+        return P(None, None, None, self.tp_axis)
+
 
 def scale_spec(weight_spec: Optional[P]) -> P:
     """Partition spec for a per-row quantization scale vector riding a 2-D

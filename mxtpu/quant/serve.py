@@ -49,6 +49,14 @@ _VALID_TOKENS = {"int8_kv": ("kv", "int8"), "fp8_kv": ("kv", "fp8"),
                  "int8_w": ("weights", "int8")}
 
 
+def _constrain_raw(x, entry: str):
+    """Activation/cache constraint hook mirroring the fp32 step functions
+    (identity outside ``parallel.fsdp.layout_scope``; the sharded serving
+    engine opens the scope while the quantized programs trace)."""
+    from ..parallel import fsdp as _fsdp
+    return _fsdp.constrain(x, entry)
+
+
 @dataclass(frozen=True)
 class QuantSpec:
     """Resolved low-precision configuration for one serving engine.
@@ -216,6 +224,7 @@ def build_step(model, S: int, TOT: int, spec: QuantSpec, decode_kernel=None):
                 + params["pos"][pc]
         else:
             x = params["embed"][tok] + params["pos"][pc]       # (S, U)
+        x = _constrain_raw(x, "activations")
         mask = jnp.arange(TOT)[None, :] <= pc[:, None]         # (S, TOT)
         new_caches = caches
         for i, lp in enumerate(params["layers"]):
@@ -253,9 +262,14 @@ def build_step(model, S: int, TOT: int, spec: QuantSpec, decode_kernel=None):
                 s = jnp.where(mask[:, None, :], s, -1e30)
                 att = jax.nn.softmax(s, axis=-1)
                 ctx = jnp.einsum("bht,bhtd->bhd", att, V).reshape(S, U)
+            # all-gather before each row matmul — replicated ow/f2w under
+            # the serving layout keep the contraction a full local dot
+            # (the sharded bit-exactness contract; mxtpu/serving/sharded.py)
+            ctx = _constrain_raw(ctx, "activations")
             x = x + mm(ctx, lp, "ow", "ob")
             g = ln(x, lp["ln2_g"], lp["ln2_b"])
             g = jax.nn.gelu(mm(g, lp, "f1w", "f1b"), approximate=False)
+            g = _constrain_raw(g, "activations")
             x = x + mm(g, lp, "f2w", "f2b")
         h = ln(x, params["ln_f_g"], params["ln_f_b"])
         if wq:
@@ -269,6 +283,13 @@ def build_step(model, S: int, TOT: int, spec: QuantSpec, decode_kernel=None):
             logits = h @ params["head_w"].T + params["head_b"]
         else:
             logits = h @ params["embed"].T                      # (S, vocab)
+        # pin the carry sharding to the engine's canonical placement
+        if kvq:
+            new_caches = kv_quant.QuantKV(
+                _constrain_raw(new_caches.data, "kv_cache"),
+                _constrain_raw(new_caches.scale, "kv_cache"), kvq)
+        else:
+            new_caches = _constrain_raw(new_caches, "kv_cache")
         return new_caches, logits
 
     return step
@@ -322,6 +343,7 @@ def build_verify_step(model, S: int, TOT: int, K1: int, spec: QuantSpec,
                 + params["pos"][pcs]
         else:
             x = params["embed"][toks] + params["pos"][pcs]   # (S, K1, U)
+        x = _constrain_raw(x, "activations")
         mask = jnp.arange(TOT)[None, None, :] <= pcs[:, :, None]
         new_caches = caches
         for i, lp in enumerate(params["layers"]):
@@ -361,11 +383,13 @@ def build_verify_step(model, S: int, TOT: int, K1: int, spec: QuantSpec,
                     att = jax.nn.softmax(s, axis=-1)
                     ctxs.append(jnp.einsum("bht,bhtd->bhd", att, V))
                 ctx = jnp.stack(ctxs, axis=1).reshape(S, K1, U)
-            x = x + mm(ctx.reshape(S * K1, U), lp, "ow",
-                       "ob").reshape(S, K1, U)
+            # all-gather-before-row-matmul, as in build_step
+            flatc = _constrain_raw(ctx.reshape(S * K1, U), "activations")
+            x = x + mm(flatc, lp, "ow", "ob").reshape(S, K1, U)
             g = ln(x, lp["ln2_g"], lp["ln2_b"])
             g = jax.nn.gelu(mm(g.reshape(S * K1, U), lp, "f1w", "f1b"),
                             approximate=False)
+            g = _constrain_raw(g, "activations")
             x = x + mm(g, lp, "f2w", "f2b").reshape(S, K1, U)
         h = ln(x, params["ln_f_g"], params["ln_f_b"])
         hf = h.reshape(S * K1, U)
@@ -381,6 +405,12 @@ def build_verify_step(model, S: int, TOT: int, K1: int, spec: QuantSpec,
         else:
             logits = hf @ params["embed"].T
         V = logits.shape[-1]
+        if kvq:
+            new_caches = kv_quant.QuantKV(
+                _constrain_raw(new_caches.data, "kv_cache"),
+                _constrain_raw(new_caches.scale, "kv_cache"), kvq)
+        else:
+            new_caches = _constrain_raw(new_caches, "kv_cache")
         return new_caches, logits.reshape(S, K1, V)
 
     return step

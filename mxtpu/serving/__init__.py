@@ -17,17 +17,20 @@ methodology behind ``bench.py serving``.
 """
 
 from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING, SHED, TIERS,
-                  DeadlineExceeded, QueueFullError, RequestCancelled,
-                  SamplingParams, ServingConfig, ServingRequest, ShedError)
+                  DeadlineExceeded, HandoffMismatch, QueueFullError,
+                  RequestCancelled, SamplingParams, ServingConfig,
+                  ServingRequest, ShedError)
 from .chained import ChainedPredictor
 from .engine import ServingEngine, ServingHandoff
-from .spec import Drafter, NgramDrafter, SpecConfig
+from .router import Replica, Router, RouterRequest
+from .spec import Drafter, ModelDrafter, NgramDrafter, SpecConfig
 from . import kv
 
 __all__ = ["ChainedPredictor", "ServingEngine", "ServingHandoff",
            "ServingRequest", "SamplingParams", "ServingConfig",
-           "SpecConfig", "Drafter", "NgramDrafter",
+           "Router", "Replica", "RouterRequest",
+           "SpecConfig", "Drafter", "NgramDrafter", "ModelDrafter",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
-           "ShedError", "TIERS",
+           "ShedError", "HandoffMismatch", "TIERS",
            "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "SHED",
            "kv"]

@@ -23,7 +23,7 @@ from typing import List, Optional
 
 __all__ = ["ServingRequest", "SamplingParams", "ServingConfig",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
-           "ShedError", "TIERS",
+           "ShedError", "HandoffMismatch", "TIERS",
            "PENDING", "RUNNING", "DONE", "CANCELLED", "EXPIRED", "SHED"]
 
 PENDING = "pending"        # admitted to the queue, not yet prefilled
@@ -52,6 +52,14 @@ class ShedError(RuntimeError):
     work that would expire anyway. Distinct from :exc:`QueueFullError`
     (queue capacity, load-independent of deadlines) and from
     :exc:`DeadlineExceeded` (the deadline really passed)."""
+
+
+class HandoffMismatch(ValueError):
+    """``adopt()`` on a :class:`ServingHandoff` whose KV geometry or mesh
+    placement is incompatible with the adopting engine — raised UP FRONT,
+    before any page merges, naming the mismatched dimension (model cache
+    rows, KV bucket page shapes, or mesh axis geometry) instead of letting
+    a later ``kv.merge_page`` die on a shape crash mid-adoption."""
 
 
 class RequestCancelled(RuntimeError):
@@ -115,7 +123,13 @@ class ServingConfig:
     ``spec`` enables speculative multi-token decode — a
     :class:`~mxtpu.serving.spec.SpecConfig` or an integer draft depth
     ``k`` (the ``MXTPU_SPEC_DECODE`` knob; see ``docs/serving.md``). None
-    keeps the engine byte-identical to the non-speculative path."""
+    keeps the engine byte-identical to the non-speculative path.
+
+    ``mesh`` shards the engine over a ``parallel.mesh`` Mesh carrying
+    ``fsdp``/``tp`` axes (``mxtpu.serving.sharded``); None is the
+    single-device engine. ``engine_id`` names this engine in the exporter's
+    ``{engine=...}`` metric label and in ``load()``/router telemetry
+    (auto-minted ``engineN`` when unset)."""
     slots: Optional[int] = None
     queue_depth: Optional[int] = None
     chunk: Optional[int] = None
@@ -128,6 +142,8 @@ class ServingConfig:
     sched: object = None
     prefill_batch: Optional[int] = None
     spec: object = None
+    mesh: object = None
+    engine_id: Optional[str] = None
 
 
 class ServingRequest:

@@ -69,11 +69,13 @@ are also settable programmatically via :class:`~mxtpu.serving.api
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import queue
 import threading
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -93,12 +95,18 @@ from ..resilience.watchdog import Watchdog, heartbeat
 from ..step_cache import ProgramCache
 from . import kv
 from .api import (CANCELLED, DONE, EXPIRED, PENDING, RUNNING, SHED,
-                  QueueFullError, ServingConfig, ServingRequest)
+                  HandoffMismatch, QueueFullError, ServingConfig,
+                  ServingRequest)
 from .spec import NgramDrafter, parse_spec, spec_from_env
 
 __all__ = ["ServingEngine", "ServingHandoff"]
 
 _log = logging.getLogger("mxtpu.serving")
+
+# replica ids minted at construction (satellite of the router work): every
+# serving metric series carries this label so N scraped replicas never
+# collide on one series name; a fronting Router overrides it per replica
+_ENGINE_IDS = itertools.count()
 
 
 @dataclass
@@ -136,6 +144,14 @@ class ServingHandoff:
     #   yet). adopt() on a spec-less engine refuses in-flight drafts, the
     #   parked-slots rule's mirror; a spec engine with a different k safely
     #   truncates or re-proposes (drafts are advisory by construction)
+    mesh: Optional[tuple] = None              # sharded.mesh_fingerprint() of
+    #   the source engine (None = single-device): adopt() refuses a
+    #   mismatched successor with HandoffMismatch UP FRONT — single-device
+    #   and sharded engines never silently exchange placement assumptions
+    kv_geometry: Optional[tuple] = None       # (L, H, D) cache-row geometry
+    #   of the source model; page shapes are validated against the adopting
+    #   model BEFORE any merge, so a wrong-geometry handoff is a named
+    #   error, never a shape crash mid-adopt
 
     @property
     def in_flight(self) -> int:
@@ -180,7 +196,8 @@ class ServingEngine:
                  prefix_cache_mb: Optional[float] = None,
                  kv_dtype=None, quant=None, decode_kernel=None,
                  sched=None, prefill_batch: Optional[int] = None,
-                 spec=None, config: Optional[ServingConfig] = None):
+                 spec=None, mesh=None, engine_id: Optional[str] = None,
+                 config: Optional[ServingConfig] = None):
         if config is not None:
             slots = slots or config.slots
             queue_depth = queue_depth or config.queue_depth
@@ -201,7 +218,14 @@ class ServingEngine:
                 prefill_batch = config.prefill_batch
             if spec is None:
                 spec = config.spec
+            if mesh is None:
+                mesh = config.mesh
+            engine_id = engine_id or config.engine_id
         self._model = model
+        # per-replica metric label (observability): minted here so every
+        # serving series this engine records carries a stable id from the
+        # first dispatch; a fronting Router names its replicas through this
+        self.engine_id = engine_id or f"engine{next(_ENGINE_IDS)}"
         # speculative multi-token decode (mxtpu.serving.spec): like quant,
         # ONE resolved config per engine lifetime (kwarg > config >
         # MXTPU_SPEC_DECODE env) — the verify program cache stays keyed on
@@ -220,6 +244,22 @@ class ServingEngine:
         # MXTPU_DECODE_KERNEL env) — an env flip while serving can never
         # reach a live program, let alone retrace it
         self._decode_kernel = quant_attention.decode_kernel_mode(decode_kernel)
+        # model-parallel serving (mxtpu.serving.sharded): ONE mesh per
+        # engine lifetime — params, the paged KV, and every compiled
+        # program place onto it at materialization, and each dispatch
+        # traces under fsdp.layout_scope so the step functions' activation
+        # constraints fire. mesh=None keeps every path below byte-identical
+        self._mesh = mesh
+        self._layout = None
+        if mesh is not None:
+            from . import sharded
+            sharded.validate_mesh(mesh)
+            self._layout = sharded.ServingLayout()
+            if self._quant.kv:
+                # the fused pallas read is refused under a mesh; auto pins
+                # the GSPMD-partitionable xla read (named error, up front)
+                self._decode_kernel = sharded.pin_decode_kernel(
+                    self._decode_kernel)
         self._decode_kernel_str = (
             quant_attention.resolve_decode_kernel(self._decode_kernel)
             if self._quant.kv else None)
@@ -317,6 +357,7 @@ class ServingEngine:
                 return self
             self._materialize_params()
             profiler.record_serving("slots", self.slots)
+            profiler.record_serving("engine", self.engine_id)
             profiler.record_serving("kv_dtype", self._kv_dtype_str)
             if self._decode_kernel_str is not None:
                 profiler.record_serving("decode_kernel",
@@ -378,6 +419,23 @@ class ServingEngine:
 
     def stats(self) -> dict:
         return profiler.get_serving_stats()
+
+    def load(self) -> dict:
+        """Cheap load signal for a fronting :class:`~mxtpu.serving.router
+        .Router`: queued admissions plus occupied/reserved work, plus the
+        queue bound so the router can reason about headroom. Lock-free
+        snapshot reads — safe from any thread, never blocks the scheduler
+        (the R010 contract: routers poll, they don't block a decode
+        turn)."""
+        active = int(self._active.sum())
+        waiting = (self._submit_q.qsize()
+                   + (1 if self._pf is not None else 0)
+                   + (len(self._pfg.members) if self._pfg is not None else 0)
+                   + len(self._sched_pending) + len(self._parked))
+        return {"engine": self.engine_id, "active": active,
+                "queued": waiting, "slots": self.slots,
+                "queue_depth": self.queue_depth,
+                "in_flight": active + waiting}
 
     def request_timeline(self, rid: int) -> List[dict]:
         """Every trace event tagged with request ``rid``, time-sorted —
@@ -525,12 +583,15 @@ class ServingEngine:
             self._feed.close()
         if self._wd is not None:
             self._wd.stop()
+        from . import sharded
         handoff = ServingHandoff(
             tot=self._TOT or 0, entries=entries, partial=partial,
             pending=pending, kv_dtype=self._kv_dtype_str, parked=parked,
             sched_state=self._sched.export_state()
             if self._sched is not None else None,
-            spec={"k": self._spec.k} if self._spec is not None else None)
+            spec={"k": self._spec.k} if self._spec is not None else None,
+            mesh=sharded.mesh_fingerprint(self._mesh),
+            kv_geometry=kv.cache_dims(self._model))
         profiler.record_serving("drained", handoff.in_flight)
         tracer.instant("serving/drained", cat="serving",
                        args={"in_slots": len(entries),
@@ -567,6 +628,7 @@ class ServingEngine:
                     f"handoff pages are {handoff.kv_dtype} but this engine "
                     f"stores KV as {self._kv_dtype_str} — adopt on an "
                     "engine with the same kv_dtype/quant configuration")
+            self._validate_handoff(handoff)
             if handoff.parked and self._sched is None:
                 raise ValueError(
                     "handoff carries preempted (parked) requests — adopt on "
@@ -597,8 +659,7 @@ class ServingEngine:
             if handoff.entries:
                 self._ensure_capacity(handoff.tot)
                 for i, e in enumerate(handoff.entries):
-                    self._caches = kv.merge_page(
-                        self._caches, kv.device_page(e["page"]), i)
+                    self._merge_page(kv.device_page(e["page"]), i)
                     self._tok[i] = e["tok"]
                     self._p[i] = e["p"]
                     self._limit[i] = e["limit"]
@@ -648,6 +709,51 @@ class ServingEngine:
                              + [r.id for r in handoff.pending]})
         return self
 
+    def _validate_handoff(self, handoff: ServingHandoff) -> None:
+        """Up-front handoff compatibility: mesh/sharding fingerprint and KV
+        page geometry are checked BEFORE any page merges, so an incompatible
+        adopt is a :class:`~mxtpu.serving.api.HandoffMismatch` naming the
+        mismatch — never a shape crash halfway through reinstalling slots
+        (which would strand the already-merged requests)."""
+        from . import sharded
+        mine = sharded.mesh_fingerprint(self._mesh)
+        if handoff.mesh != mine:
+            def _name(fp):
+                return ("single-device" if fp is None
+                        else "x".join(f"{a}={n}" for a, n in fp))
+            raise HandoffMismatch(
+                f"handoff was drained from a {_name(handoff.mesh)} engine "
+                f"but this engine is {_name(mine)} — drained pages only "
+                "re-place onto the same mesh geometry; adopt on a matching "
+                "engine (or drain/adopt through a host round-trip tool)")
+        geo = kv.cache_dims(self._model)
+        if handoff.kv_geometry is not None and \
+                tuple(handoff.kv_geometry) != tuple(geo):
+            raise HandoffMismatch(
+                f"handoff KV rows have (layers, heads, head_dim) = "
+                f"{tuple(handoff.kv_geometry)} but this engine's model "
+                f"has {tuple(geo)} — same-model adoption only")
+        L, H, D = geo
+
+        def _shape(page):
+            return tuple(getattr(page, "data", page).shape)
+
+        for kind, tot_of, lst in (
+                ("in-flight", lambda e: handoff.tot, handoff.entries),
+                ("mid-prefill", lambda e: e["PB"], handoff.partial),
+                ("parked", lambda e: e["tot"], handoff.parked)):
+            for e in lst:
+                page = e.get("page")
+                if page is None:     # page-less entry (e.g. a spec-only
+                    continue         # probe handoff) — nothing to re-place
+                want = (L, 2, 1, H, tot_of(e), D)
+                got = _shape(page)
+                if got != want:
+                    raise HandoffMismatch(
+                        f"{kind} page for request {e['req'].id} has shape "
+                        f"{got}, expected {want} — the handoff does not "
+                        "match this engine's model/bucket geometry")
+
     def __enter__(self) -> "ServingEngine":
         return self.start()
 
@@ -688,6 +794,14 @@ class ServingEngine:
         # identity pass-through on the fp32 path; int8 per-channel weights +
         # scales under int8_w (one host-side pass, then everything is traced)
         self._params = quantize_lm(self._model, self._quant)
+        if self._mesh is not None:
+            # one-time placement onto the SpecLayout table (column-parallel
+            # sharded, row-parallel replicated — mxtpu/serving/sharded.py);
+            # params ride every program as ALREADY-PLACED jit arguments, so
+            # the first trace keys on the canonical shardings
+            from . import sharded
+            self._params = sharded.place_params(self._params, self._mesh,
+                                                self._layout)
         if self._prefix is None and self.prefix_cache_mb > 0:
             block_bytes = kv.block_nbytes(self._model, self._kv_dtype,
                                           self._quant)
@@ -919,7 +1033,7 @@ class ServingEngine:
             self._ensure_capacity(e["tot"])
             if e["tot"] < self._TOT:
                 page = kv.promote(page, self._TOT)
-            self._caches = kv.merge_page(self._caches, page, slot)
+            self._merge_page(page, slot)
             self._tok[slot] = e["tok"]
             self._p[slot] = e["p"]
             self._limit[slot] = e["limit"]
@@ -994,6 +1108,10 @@ class ServingEngine:
                             "seed": seed})
         self._pfg = PrefillGroup(self._model, members, self._prefill_batch,
                                  PB, self._kv_dtype, self._quant)
+        # the group page must join the mesh's device set before the first
+        # batched-prefill dispatch (the slot dim shards when divisible,
+        # heads on tp — same filter path as the full cache)
+        self._pfg.page = self._place_caches(self._pfg.page)
         profiler.record_serving("prefill_groups")
         tracer.instant("serving/prefill_group", cat="serving",
                        args={"ids": [mm["req"].id for mm in members],
@@ -1027,12 +1145,16 @@ class ServingEngine:
                                "chunk": csize, "bucket": g.PB,
                                "batched": len(live_ids)}):
             from ..sched.admission import build_prefill_batch
-            fn = self._prefill_fns.get_or_build(
-                ("batch", g.N, g.PB, csize),
-                lambda: build_prefill_batch(
-                    self._model, g.N, g.PB, csize, quant=self._quant,
-                    decode_kernel=self._decode_kernel))
-            page, prev, lastfed, outs = fn(self._params, *g.chunk_inputs())
+            with self._scope():
+                fn = self._prefill_fns.get_or_build(
+                    ("batch", g.N, g.PB, csize),
+                    lambda: build_prefill_batch(
+                        self._model, g.N, g.PB, csize, quant=self._quant,
+                        decode_kernel=self._decode_kernel))
+                page, prev, lastfed, outs = fn(
+                    self._params,
+                    *(inp if i == 0 else self._dev(inp)
+                      for i, inp in enumerate(g.chunk_inputs())))
             outs_np = np.asarray(outs)
         profiler.record_serving("prefill_chunks")
         self._sched.observe_prefill(csize * len(live_ids),
@@ -1098,8 +1220,7 @@ class ServingEngine:
             req = mem["req"]
             slot = mem["slot"]
             self._insert_prefix(req, g.member_page(n), upto=mem["t0"] - 1)
-            self._caches = kv.merge_page(self._caches, g.member_page(n),
-                                         slot)
+            self._merge_page(g.member_page(n), slot)
             self._tok[slot] = int(prev_np[n])    # the token at position PB
             self._p[slot] = g.PB                 # next position to feed
             self._limit[slot] = req.total - 1
@@ -1181,6 +1302,10 @@ class ServingEngine:
         # arbitrary mid-block cursor would mint a fresh multi-second XLA
         # compile per distinct tail length
         t_scan = m - (m % kv.PrefixCache.BLOCK)
+        # mesh mode: the fresh page must live on the mesh's device set
+        # before it rides a dispatch next to the placed params (jnp-created
+        # arrays are committed to the default device)
+        page = self._place_caches(page)
         self._pf = {"req": req, "prompt": staged.data, "page": page,
                     "t": t_scan, "prev": 0, "t0": t0, "PB": PB,
                     "left": req.max_new, "slot": slot, "t_start": now,
@@ -1209,18 +1334,20 @@ class ServingEngine:
         with tracer.span("serving/prefill_chunk", cat="serving",
                          args={"id": req.id, "start": start,
                                "chunk": csize, "bucket": pf["PB"]}):
-            fn = self._prefill_fns.get_or_build(
-                (pf["PB"], csize),
-                lambda: kv.build_prefill_chunk(
-                    self._model, pf["PB"], csize, quant=self._quant,
-                    decode_kernel=self._decode_kernel))
-            page, outs = fn(
-                self._params, pf["page"], pf["prompt"],
-                jnp.int32(pf["t0"]), jnp.int32(start),
-                jnp.full((1,), pf["prev"], jnp.int32),
-                jnp.full((1,), pf["temp"], jnp.float32),
-                jnp.full((1,), pf["topk"], jnp.int32),
-                jnp.full((1,), pf["seed"], jnp.uint32))
+            with self._scope():
+                fn = self._prefill_fns.get_or_build(
+                    (pf["PB"], csize),
+                    lambda: kv.build_prefill_chunk(
+                        self._model, pf["PB"], csize, quant=self._quant,
+                        decode_kernel=self._decode_kernel))
+                page, outs = fn(
+                    self._params, pf["page"], self._dev(pf["prompt"]),
+                    self._dev(jnp.int32(pf["t0"])),
+                    self._dev(jnp.int32(start)),
+                    self._dev(jnp.full((1,), pf["prev"], jnp.int32)),
+                    self._dev(jnp.full((1,), pf["temp"], jnp.float32)),
+                    self._dev(jnp.full((1,), pf["topk"], jnp.int32)),
+                    self._dev(jnp.full((1,), pf["seed"], jnp.uint32)))
             outs_np = np.asarray(outs)
         profiler.record_serving("prefill_chunks")
         if self._sched is not None:
@@ -1274,7 +1401,7 @@ class ServingEngine:
         self._insert_prefix(req, pf["page"], upto=pf["t0"] - 1)
         self._ensure_capacity(
             kv.bucket32(req.total, self._model._max_len))
-        self._caches = kv.merge_page(self._caches, pf["page"], slot)
+        self._merge_page(pf["page"], slot)
         self._tok[slot] = pf["prev"]         # the token at position PB
         self._p[slot] = pf["PB"]             # next position to feed
         self._limit[slot] = req.total - 1
@@ -1307,18 +1434,61 @@ class ServingEngine:
     def _ensure_capacity(self, need: int) -> None:
         if self._TOT is None:
             self._TOT = need
-            self._caches = kv.empty_cache(self._model, self.slots, need,
-                                          self._kv_dtype, self._quant)
+            self._caches = self._place_caches(
+                kv.empty_cache(self._model, self.slots, need,
+                               self._kv_dtype, self._quant))
         elif need > self._TOT:
             with tracer.span("serving/kv_promote", cat="serving",
                              args={"from": self._TOT, "to": need}):
-                self._caches = kv.promote(self._caches, need)
+                self._caches = self._place_caches(
+                    kv.promote(self._caches, need))
             self._TOT = need
             profiler.record_serving("kv_promotions")
         else:
             return
         profiler.record_serving("kv_bytes_resident",
                                 kv.cache_nbytes(self._caches))
+
+    # -- sharded placement (mesh mode; all identity when mesh is None) -------
+    def _place_caches(self, caches):
+        """Pin a freshly created / promoted / page-merged cache onto the
+        canonical kv_cache sharding so dispatch-input shardings never drift
+        from what the first trace keyed on (trace-once over shardings)."""
+        if self._mesh is None:
+            return caches
+        from . import sharded
+        return sharded.place_cache(caches, self._mesh, self._layout)
+
+    def _merge_page(self, page, slot: int) -> None:
+        """``kv.merge_page`` + re-pin: every eager host-side cache mutation
+        funnels through here in mesh mode. The incoming page is placed
+        FIRST — a parked/adopted page arrives committed to the default
+        device, and an eager merge across mismatched device sets throws."""
+        page = self._place_caches(page)
+        self._caches = self._place_caches(
+            kv.merge_page(self._caches, page, slot))
+
+    def _scope(self):
+        """Layout scope for program dispatch: under a mesh every dispatch
+        (and therefore every first-call trace) runs with the serving layout
+        active, so the step functions' activation constraints fire."""
+        if self._mesh is None:
+            return nullcontext()
+        from ..parallel.fsdp import layout_scope
+        return layout_scope(self._layout, self._mesh)
+
+    def _dev(self, x):
+        """Replicate a small dispatch input (slot-state vectors, prompt
+        block, cursors) onto the mesh's device set. jnp-created arrays are
+        committed to the default device, and a jit mixing them with the
+        mesh-placed params throws; replicating through ONE NamedSharding
+        also keeps the dispatch-input shardings identical across calls
+        (trace-once)."""
+        if self._mesh is None:
+            return x
+        import jax
+        from ..parallel.mesh import NamedSharding, P
+        return jax.device_put(x, NamedSharding(self._mesh, P()))
 
     def _decode_chunk(self) -> None:
         n_active = int(self._active.sum())
@@ -1332,15 +1502,20 @@ class ServingEngine:
         t_dispatch = time.monotonic()
         with tracer.span("serving/decode", cat="serving", args=span_args):
             key = (self.slots, self._TOT, self.chunk)
-            fn = self._decode_fns.get_or_build(
-                key, lambda: kv.build_decode(
-                    self._model, *key, quant=self._quant,
-                    decode_kernel=self._decode_kernel))
-            caches, tok, p, toks, lives = fn(
-                self._params, self._caches, jnp.asarray(self._tok),
-                jnp.asarray(self._p), jnp.asarray(self._active),
-                jnp.asarray(self._limit), jnp.asarray(self._temp),
-                jnp.asarray(self._topk), jnp.asarray(self._seed))
+            with self._scope():
+                fn = self._decode_fns.get_or_build(
+                    key, lambda: kv.build_decode(
+                        self._model, *key, quant=self._quant,
+                        decode_kernel=self._decode_kernel))
+                caches, tok, p, toks, lives = fn(
+                    self._params, self._caches,
+                    self._dev(jnp.asarray(self._tok)),
+                    self._dev(jnp.asarray(self._p)),
+                    self._dev(jnp.asarray(self._active)),
+                    self._dev(jnp.asarray(self._limit)),
+                    self._dev(jnp.asarray(self._temp)),
+                    self._dev(jnp.asarray(self._topk)),
+                    self._dev(jnp.asarray(self._seed)))
             toks_np = np.asarray(toks)
             lives_np = np.asarray(lives)
         self._caches = caches
@@ -1351,6 +1526,7 @@ class ServingEngine:
         # re-assert per dispatch: these are assign-style stats, and callers
         # commonly reset_serving_stats() after warmup (which wiped the values
         # recorded at start()/cache creation)
+        profiler.record_serving("engine", self.engine_id)
         profiler.record_serving("kv_dtype", self._kv_dtype_str)
         if self._decode_kernel_str is not None:
             profiler.record_serving("decode_kernel", self._decode_kernel_str)
@@ -1469,16 +1645,22 @@ class ServingEngine:
         t_dispatch = time.monotonic()
         with tracer.span("serving/verify", cat="serving", args=span_args):
             key = (self.slots, self._TOT, k)
-            fn = self._verify_fns.get_or_build(
-                key, lambda: kv.build_verify(
-                    self._model, *key, quant=self._quant,
-                    decode_kernel=self._decode_kernel))
-            caches, tok, p, outs, lives = fn(
-                self._params, self._caches, jnp.asarray(self._tok),
-                jnp.asarray(self._p), jnp.asarray(self._active),
-                jnp.asarray(self._limit), jnp.asarray(self._temp),
-                jnp.asarray(self._topk), jnp.asarray(self._seed),
-                jnp.asarray(self._draft), jnp.asarray(self._dlen))
+            with self._scope():
+                fn = self._verify_fns.get_or_build(
+                    key, lambda: kv.build_verify(
+                        self._model, *key, quant=self._quant,
+                        decode_kernel=self._decode_kernel))
+                caches, tok, p, outs, lives = fn(
+                    self._params, self._caches,
+                    self._dev(jnp.asarray(self._tok)),
+                    self._dev(jnp.asarray(self._p)),
+                    self._dev(jnp.asarray(self._active)),
+                    self._dev(jnp.asarray(self._limit)),
+                    self._dev(jnp.asarray(self._temp)),
+                    self._dev(jnp.asarray(self._topk)),
+                    self._dev(jnp.asarray(self._seed)),
+                    self._dev(jnp.asarray(self._draft)),
+                    self._dev(jnp.asarray(self._dlen)))
             outs_np = np.asarray(outs)
             lives_np = np.asarray(lives)
         self._caches = caches
@@ -1487,6 +1669,7 @@ class ServingEngine:
         now = time.monotonic()
         profiler.record_serving("decode_steps")
         profiler.record_serving("spec_dispatches")
+        profiler.record_serving("engine", self.engine_id)
         profiler.record_serving("kv_dtype", self._kv_dtype_str)
         if self._decode_kernel_str is not None:
             profiler.record_serving("decode_kernel", self._decode_kernel_str)

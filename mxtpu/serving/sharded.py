@@ -1,0 +1,199 @@
+"""Model-parallel serving: fsdp×tp placement for :class:`ServingEngine`.
+
+``ServingEngine(mesh=...)`` runs the SAME compiled prefill-chunk / decode /
+verify programs sharded over a composed mesh (SNIPPETS [2]'s pitch: one
+NamedSharding program from 8 chips to a supercluster). This module is the
+placement table and the placement helpers; the engine calls them at
+parameter materialization, cache creation/promotion, and page merge, and
+opens ``fsdp.layout_scope`` around every dispatch so the step functions'
+activation constraints fire while the program traces.
+
+The layout — :class:`ServingLayout` — is the serving-specialized row of the
+:class:`~mxtpu.parallel.fsdp.SpecLayout` table:
+
+* **Column-parallel stays sharded**: q/k/v and ffn-up weights on ``tp``
+  (dim 0, the gluon ``(out, in)`` convention), the embedding table on
+  ``fsdp×tp`` over vocab rows, and the paged KV cache on ``tp`` over heads
+  + ``fsdp`` over slots. Attention (per-head einsums), the qkv/ffn-up
+  projections, and the tied-head logits all contract over UNSHARDED dims —
+  every device computes full local dot products over its output columns.
+* **Row-parallel goes replicated**: the base table's Megatron pair
+  (``attn_out``/``ffn_down`` sharded on dim 1) would make XLA compute
+  ``ctx @ ow.T`` as per-device partial sums + psum, changing the
+  floating-point reduction order (the exact hazard
+  ``fsdp.compose_spec``'s docstring documents for training). Serving's
+  contract is stronger than training's: greedy decode must be BIT-EXACT vs
+  the single-device engine. So ``ow``/``f2w`` replicate, and the step
+  functions constrain the compact ``(S, U)`` activations back to the
+  data-axes spec before each row matmul — an all-gather moves identical
+  bytes, a psum re-rounds them.
+
+With that layout every floating-point reduction in the forward runs over
+an unsharded dim on one device, so sharded greedy decode is bit-exact by
+construction, not by luck — the property ``tests/test_sharded_guard.py``
+asserts against the single-device engine.
+
+What composes: int8 KV (the :class:`~mxtpu.quant.kv_quant.QuantKV` data and
+scale leaves shard congruently — same head/slot axes), the radix prefix
+cache (host block round-trips gather/scatter through the placed pages),
+speculative decode (the verify step carries the same constraints), and the
+SLO scheduler (parked pages re-place on merge). What refuses: the Pallas
+fused dequant-attention read (``decode_kernel='pallas'``) — a
+``pallas_call`` body is opaque to GSPMD partitioning, so a sharded engine
+pins the ``xla`` read and an explicit pallas request raises
+:class:`ShardingUnsupported` instead of silently tracing a gather.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+
+from ..parallel.fsdp import SpecLayout, filter_spec, scale_spec
+from ..parallel.mesh import Mesh, NamedSharding, P
+
+__all__ = ["ServingLayout", "ShardingUnsupported", "serving_param_specs",
+           "place_params", "place_cache", "mesh_fingerprint",
+           "validate_mesh", "pin_decode_kernel"]
+
+
+class ShardingUnsupported(ValueError):
+    """A serving feature that cannot compose with a sharded engine (named
+    refusal, never a mid-dispatch shape crash)."""
+
+
+@dataclass(frozen=True)
+class ServingLayout(SpecLayout):
+    """Bit-exact serving specialization of the SpecLayout table: the
+    row-parallel Megatron pair replicates (see module docstring — replicated
+    row matmuls + all-gathered activations keep every float reduction
+    local), everything column-parallel inherits the base table."""
+
+    def attn_out(self) -> P:
+        return P()                       # replicated: no psum in ctx @ ow.T
+
+    def ffn_down(self) -> P:
+        return P()                       # replicated: no psum in g @ f2w.T
+
+    def kv_cache(self) -> P:
+        """(L, 2, S, H, TOT, D) paged KV (and its rank-5 QuantKV scale):
+        slots over fsdp, heads over tp — each (slot, head) shard attends
+        its own rows with no cross-device reduction."""
+        return P(None, None, self.fsdp_axis, self.tp_axis)
+
+
+# -- per-leaf spec table ------------------------------------------------------
+
+def _entry(name: str, layout: SpecLayout) -> P:
+    """SpecLayout entry for one ``_gen_params`` / ``quantize_lm`` leaf by
+    name: ``<w>_q`` inherits the fp32 weight's spec, ``<w>_s`` its
+    output-channel :func:`~mxtpu.parallel.fsdp.scale_spec`."""
+    if name.endswith("_q"):
+        return _entry(name[:-2], layout)
+    if name.endswith("_s"):
+        return scale_spec(_entry(name[:-2], layout))
+    if name in ("embed", "head_w"):
+        return layout.embeddings()
+    if name in ("qw", "kw", "vw"):
+        return layout.qkv_projection()
+    if name == "ow":
+        return layout.attn_out()
+    if name == "f1w":
+        return layout.ffn_up()
+    if name == "f2w":
+        return layout.ffn_down()
+    return layout.vector()               # biases, norms, pos table
+
+
+def serving_param_specs(params: dict, layout: Optional[SpecLayout] = None):
+    """The spec pytree matching a serving params pytree (fp32 or
+    ``quantize_lm``'d), same nesting, one :class:`PartitionSpec` per leaf."""
+    layout = layout or ServingLayout()
+    out = {}
+    for k, v in params.items():
+        if k == "layers":
+            out[k] = [{n: _entry(n, layout) for n in lp} for lp in v]
+        else:
+            out[k] = _entry(k, layout)
+    return out
+
+
+def _place(leaf, spec: P, mesh: Mesh):
+    return jax.device_put(
+        leaf, NamedSharding(mesh, filter_spec(spec, leaf.shape, mesh)))
+
+
+def place_params(params: dict, mesh: Mesh,
+                 layout: Optional[SpecLayout] = None) -> dict:
+    """Device-put every params leaf onto its mesh-filtered table spec —
+    non-divisible dims degrade to replicated (``fsdp.filter_spec``), so the
+    tiny presets and the flagship share one placement path."""
+    layout = layout or ServingLayout()
+    out = {}
+    for k, v in params.items():
+        if k == "layers":
+            out[k] = [{n: _place(w, _entry(n, layout), mesh)
+                       for n, w in lp.items()} for lp in v]
+        else:
+            out[k] = _place(v, _entry(k, layout), mesh)
+    return out
+
+
+def place_cache(caches, mesh: Mesh, layout: Optional[SpecLayout] = None):
+    """Pin a KV cache (raw array or :class:`QuantKV`) onto the canonical
+    ``kv_cache`` sharding. The engine re-pins after every host-side cache
+    mutation (create / promote / page merge) so the dispatch-input sharding
+    never drifts from what the first trace keyed on — the trace-once
+    contract extended to shardings."""
+    layout = layout or ServingLayout()
+    spec = layout.kv_cache()
+    from ..quant.kv_quant import QuantKV
+    if isinstance(caches, QuantKV):
+        return QuantKV(_place(caches.data, spec, mesh),
+                       _place(caches.scale, spec, mesh), caches.mode)
+    return _place(caches, spec, mesh)
+
+
+# -- mesh validation / identity ----------------------------------------------
+
+def mesh_fingerprint(mesh: Optional[Mesh]):
+    """Hashable mesh identity for handoff compatibility checks: the sorted
+    (axis, size) pairs, or None for a single-device engine. Two engines can
+    exchange a :class:`ServingHandoff` only when fingerprints match —
+    pages drained from a sharded cache re-place onto the SAME axis
+    geometry or not at all (see ``ServingEngine.adopt``)."""
+    if mesh is None:
+        return None
+    return tuple(sorted((str(a), int(mesh.shape[a]))
+                        for a in mesh.axis_names))
+
+
+def validate_mesh(mesh: Mesh, layout: Optional[SpecLayout] = None) -> None:
+    """Up-front refusal for a mesh the serving layout can't use at all: a
+    mesh carrying neither the tp nor the fsdp axis would replicate every
+    leaf — a silent single-device engine that LOOKS sharded. Raise
+    :class:`ShardingUnsupported` instead."""
+    layout = layout or ServingLayout()
+    names = set(mesh.axis_names)
+    if layout.tp_axis not in names and layout.fsdp_axis not in names:
+        raise ShardingUnsupported(
+            f"mesh axes {tuple(mesh.axis_names)} carry neither "
+            f"'{layout.tp_axis}' nor '{layout.fsdp_axis}' — the serving "
+            "layout would replicate every tensor; build the mesh with "
+            "make_mesh((fsdp, tp), ('fsdp', 'tp'))")
+
+
+def pin_decode_kernel(mode: Optional[str]) -> str:
+    """Resolve the quantized attention-read kernel for a sharded engine:
+    the Pallas fused read is refused (its kernel body is opaque to GSPMD —
+    sharding it would force a full cache gather per dispatch), auto pins
+    ``xla`` so a TPU backend never auto-selects pallas under a mesh."""
+    if mode == "pallas":
+        raise ShardingUnsupported(
+            "decode_kernel='pallas' cannot run sharded: the fused "
+            "dequant-attention pallas_call is opaque to GSPMD partitioning. "
+            "Use decode_kernel='xla' (or leave unset — sharded engines pin "
+            "it) for mesh serving")
+    return "xla"

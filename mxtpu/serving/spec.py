@@ -36,7 +36,8 @@ import os
 from dataclasses import dataclass
 from typing import List, Optional
 
-__all__ = ["SpecConfig", "parse_spec", "Drafter", "NgramDrafter"]
+__all__ = ["SpecConfig", "parse_spec", "Drafter", "NgramDrafter",
+           "ModelDrafter"]
 
 
 @dataclass(frozen=True)
@@ -156,3 +157,58 @@ class NgramDrafter(Drafter):
                     if cont:
                         return list(cont)
         return []
+
+
+class ModelDrafter(Drafter):
+    """Draft-LM proposer behind the :class:`Drafter` seam: a small
+    ``transformer_lm`` greedily continues the slot's context and its
+    tokens ride the SAME advisory verify contract as the n-gram drafter —
+    a weak draft model can slow decode down, never corrupt it.
+
+    The draft model runs its OWN cached decode program (the model zoo's
+    ``generate`` path), fully separate from the target engine's program
+    caches. To keep that cache bounded, the context is left-truncated to
+    the largest fitting bucket of ``buckets`` — at most ``len(buckets)``
+    compiled draft programs per draft depth, regardless of how long served
+    requests grow. Truncation only costs proposal quality (the verify
+    step re-scores everything with the full-context target); a context
+    shorter than the smallest bucket proposes nothing and the slot decodes
+    plain that turn.
+
+    Pair it with the engine via ``SpecConfig(k=..., drafter=
+    ModelDrafter(draft_net))``; ``bench.py serving`` A/Bs it against the
+    default :class:`NgramDrafter` on the spec leg."""
+
+    BUCKETS = (8, 32, 64)
+
+    def __init__(self, model, buckets=BUCKETS):
+        self._model = model
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"bad draft buckets {buckets!r}")
+        self.calls = 0
+        self.proposed = 0
+
+    def propose(self, context: List[int], k: int) -> List[int]:
+        if k <= 0:
+            return []
+        b = 0
+        for cand in self.buckets:
+            if cand <= len(context):
+                b = cand
+        if b == 0:
+            return []
+        if b + k > self._model._max_len:
+            return []
+        import numpy as np
+        from .. import nd
+        tail = np.asarray(context[-b:], np.int32)[None, :]
+        out = self._model.generate(nd.array(tail), k)
+        toks = [int(t) for t in np.asarray(out.data)[0, b:]]
+        self.calls += 1
+        self.proposed += len(toks)
+        return toks
+
+    def stats(self) -> dict:
+        return {"draft_lm_calls": self.calls,
+                "draft_lm_tokens": self.proposed}

@@ -511,6 +511,57 @@ def test_r009_suppressed():
 
 
 # ---------------------------------------------------------------------------
+# R010 blocking-call-in-decode-loop
+# ---------------------------------------------------------------------------
+
+def test_r010_positive_flags_network_io_in_scheduler_loop():
+    """The router anti-pattern: the scheduler decode loop scrapes a peer's
+    metrics endpoint (or rendezvouses over the transport) once per turn —
+    every slot's next token now waits on network tail latency."""
+    findings = _lint("""
+        import urllib.request
+        def run_scheduler(self):
+            while not self._stop.is_set():
+                load = urllib.request.urlopen(self._peer_url).read()
+                self._decode_turn(load)
+        def decode_turn(self, slots):
+            for slot in slots:
+                self._transport.connect(self._peers[slot])
+    """, select=["R010"])
+    assert len(findings) == 2
+    assert all(f.rule == "R010" for f in findings)
+    assert "lock-free" in findings[0].message
+
+
+def test_r010_negative_blessed_shapes():
+    """Never flagged: the router's own polling loop (not scheduler-family),
+    in-process load() snapshot reads, queue waits on non-transport
+    receivers, and transport use OUTSIDE the per-turn loop."""
+    assert _rules_hit("""
+        def route(self, prompt):
+            for rep in self._replicas:
+                load = rep.engine.load()
+        def run_scheduler(self):
+            while True:
+                item = self._submit_q.get(timeout=0.01)
+        def drain_handoff(self):
+            self._transport.disconnect()
+        def poll_replicas(self):
+            for rep in self._reps:
+                rep.load_fn()
+    """, select=["R010"]) == set()
+
+
+def test_r010_suppressed():
+    findings = _lint("""
+        def serve_forever(self):
+            while True:
+                self._sock.recv(4096)  # mxtpu: ignore[R010]
+    """, select=["R010"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # linter plumbing
 # ---------------------------------------------------------------------------
 

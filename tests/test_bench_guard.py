@@ -136,6 +136,28 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
         == spec["spec_decode_speedup"]
     assert doc["ratchet"]["current"]["accept_len_mean"] \
         == spec["accept_len_mean"]
+    # drafter A/B (ISSUE 19): the draft-LM seam served the same trace
+    # bit-exact (advisory contract) and actually drafted
+    draft_lm = spec["draft_lm"]
+    assert draft_lm["decode_match"] is True
+    assert draft_lm["draft_lm_calls"] > 0
+    assert draft_lm["tokens_drafted"] > 0
+    # router leg (ISSUE 19): 2-replica router over the same trace — zero
+    # drops, bit-exact, affinity engaged, >1.5x virtual-clock scale-out,
+    # and the goodput/TTFT pair rides the ratchet; the sharded-replica
+    # probe degrades gracefully in the 1-device subprocess
+    router = serving["router"]
+    assert router["decode_match"] is True
+    assert router["requests_dropped"] == 0
+    assert router["routed_affinity"] >= 1
+    assert sum(router["placement"].values()) == router["requests"]
+    assert router["scaleout_goodput_vs_single"] >= 1.5, router
+    assert router["ttft_p99_ms"] >= router["ttft_p50_ms"] > 0
+    assert router["sharded_replica"] == {"devices": 1, "skipped": True}
+    assert doc["ratchet"]["current"]["router_goodput"] \
+        == router["goodput_tok_s"] > 0
+    assert doc["ratchet"]["current"]["router_ttft_p99_inv"] \
+        == pytest.approx(1e3 / router["ttft_p99_ms"])
     # TTFT decomposition keys shipped by the engine stats
     assert serving["ttft_queue_wait_ms_mean"] >= 0
     assert serving["ttft_prefill_ms_mean"] > 0
@@ -314,12 +336,21 @@ def test_bench_serving_scenario_cli(tmp_path):
     assert spec["accept_len_mean"] > 1.0, spec
     assert spec["on"]["tokens_accepted"] + spec["on"]["tokens_rejected"] \
         == spec["on"]["tokens_drafted"] > 0
+    assert spec["draft_lm"]["decode_match"] is True
+    # router leg (ISSUE 19) ships in the serving-only doc too
+    router = serving["router"]
+    assert router["decode_match"] is True
+    assert router["requests_dropped"] == 0
+    assert router["scaleout_goodput_vs_single"] >= 1.5, router
+    assert router["sharded_replica"]["skipped"] is True
     cur = doc["ratchet"]["current"]
     assert cur["serving_goodput"] == serving["goodput_tok_s"]
     assert cur["prefix_hit_rate"] == prefix["hit_rate"]
     assert cur["serving_ttft_p99_inv"] > 0
     assert cur["spec_decode_speedup"] == spec["spec_decode_speedup"] > 0
     assert cur["accept_len_mean"] == spec["accept_len_mean"]
+    assert cur["router_goodput"] == router["goodput_tok_s"] > 0
+    assert cur["router_ttft_p99_inv"] > 0
     assert doc["ratchet"]["harness"] == "serving-smoke"
 
 

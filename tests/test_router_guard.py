@@ -1,0 +1,310 @@
+"""Multi-replica router guard (ISSUE 19 tentpole, part two).
+
+The load-bearing test is the CHAOS one: kill a replica mid-burst under a
+``mxtpu.sched.replay`` traffic trace and every request must still finish
+bit-exact against its serial ``generate`` baseline, with
+``router_stats['requests_dropped'] == 0`` and tenant/priority/deadline
+riding the re-routed continuation unchanged. Routing policy itself
+(prefix affinity, headroom spill, backpressure overflow, total-full
+rejection) is pinned against FAKE engines — the router only reads
+``load()`` dicts and calls ``submit()``, so the decision table is testable
+without burning XLA compiles; real engines are reserved for the tests
+where the drain/adopt/continuation machinery is the point.
+"""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, profiler
+from mxtpu.gluon.model_zoo import transformer_lm
+from mxtpu.sched.policy import SLOScheduler
+from mxtpu.sched.replay import TenantProfile, make_trace
+from mxtpu.serving import (QueueFullError, Router, RouterRequest,
+                           ServingEngine)
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.rng.seed(0)
+    model = transformer_lm("tiny", vocab_size=VOCAB)
+    model.initialize()
+    return model
+
+
+def _solo(model, prompt, max_new):
+    out = model.generate(nd.array(np.array([prompt], np.int32)), max_new)
+    return np.asarray(out.data)[0, len(prompt):].tolist()
+
+
+def _spin(cond, what, timeout=300):
+    t0 = time.monotonic()
+    while not cond():
+        assert time.monotonic() - t0 < timeout, f"{what} never happened"
+        time.sleep(0.001)
+
+
+# -- fake replicas: the routing decision table ------------------------------
+
+class _FakeSeg:
+    _ids = itertools.count(10_000)
+
+    def __init__(self, prompt, max_new, kw):
+        self.id = next(self._ids)
+        self.prompt = list(prompt)
+        self.max_new = max_new
+        self.kw = kw
+
+    def done(self):
+        return False
+
+
+class _FakeEngine:
+    """Just enough surface for Router: load()/submit()/start()/stop()."""
+
+    def __init__(self, rid, slots=4, queue_depth=4, full=False):
+        self.engine_id = rid
+        self.slots = slots
+        self.queue_depth = queue_depth
+        self.full = full
+        self.in_flight = 0
+        self.submitted = []
+        self._sched = None
+
+    def load(self):
+        return {"engine": self.engine_id, "active": 0, "queued": 0,
+                "slots": self.slots, "queue_depth": self.queue_depth,
+                "in_flight": self.in_flight}
+
+    def submit(self, prompt, max_new, **kw):
+        if self.full:
+            raise QueueFullError(f"{self.engine_id} full")
+        seg = _FakeSeg(prompt, max_new, kw)
+        self.submitted.append(seg)
+        self.in_flight += 1
+        return seg
+
+    def start(self):
+        return self
+
+    def stop(self):
+        pass
+
+
+def _prompt(rs, prefix, n_suffix=4):
+    return prefix + rs.randint(1, VOCAB, size=n_suffix).tolist()
+
+
+def test_affinity_groups_shared_prefixes_on_one_replica():
+    """Prompts sharing their first 32-token block must all route to the
+    SAME replica (rendezvous over the block hash), and a distinct prefix
+    population must be able to land elsewhere — affinity, not pinning."""
+    profiler.reset_router_stats()
+    a, b = _FakeEngine("replica0"), _FakeEngine("replica1")
+    router = Router([a, b])
+    rs = np.random.RandomState(7)
+    prefix1 = rs.randint(1, VOCAB, size=32).tolist()
+    for _ in range(6):
+        router.submit(_prompt(rs, prefix1), 8)
+    homes = {len(a.submitted) > 0, len(b.submitted) > 0}
+    assert homes == {True, False}, "shared-prefix requests split replicas"
+    stats = profiler.get_router_stats()
+    assert stats["routed_affinity"] == 6 and stats["submitted"] == 6
+    # a short prompt (< one block) cannot hash a block: least-loaded
+    router.submit([1, 2, 3], 8)
+    assert profiler.get_router_stats()["routed_least_loaded"] == 1
+    # prefix_cache=False opts out of affinity entirely
+    router.submit(_prompt(rs, prefix1), 8, prefix_cache=False)
+    assert profiler.get_router_stats()["routed_least_loaded"] == 2
+
+
+def test_hot_affinity_target_spills_to_least_loaded():
+    """An affinity target past the headroom fraction forfeits the request:
+    cache warmth never justifies queueing behind a hot spot."""
+    profiler.reset_router_stats()
+    a, b = _FakeEngine("replica0"), _FakeEngine("replica1")
+    router = Router([a, b], headroom=0.75)
+    rs = np.random.RandomState(9)
+    prefix = rs.randint(1, VOCAB, size=32).tolist()
+    router.submit(_prompt(rs, prefix), 8)
+    (hot, cold) = (a, b) if a.submitted else (b, a)
+    hot.in_flight = hot.slots + hot.queue_depth        # saturated
+    router.submit(_prompt(rs, prefix), 8)
+    assert len(cold.submitted) == 1, "hot affinity target did not spill"
+    stats = profiler.get_router_stats()
+    assert stats["routed_spill"] == 1 and stats["routed_affinity"] == 1
+
+
+def test_backpressure_overflows_then_rejects_only_when_all_full():
+    """A QueueFullError from the chosen replica moves the request to the
+    next candidate (overflow counter); only when EVERY replica refuses does
+    submit() re-raise (rejected counter)."""
+    profiler.reset_router_stats()
+    a = _FakeEngine("replica0", full=True)
+    b = _FakeEngine("replica1")
+    router = Router([a, b])
+    router.submit([1, 2, 3, 4], 8)
+    assert len(b.submitted) == 1
+    assert profiler.get_router_stats()["overflow"] >= 1
+    b.full = True
+    with pytest.raises(QueueFullError):
+        router.submit([1, 2, 3, 4], 8)
+    stats = profiler.get_router_stats()
+    assert stats["rejected"] == 1
+    assert stats["requests_dropped"] == 0      # rejected-at-admission != drop
+
+
+def test_fair_share_sync_merges_passes_across_replicas():
+    """A tenant's stride pass must be the MAX across replicas after a
+    sync — flooding replica A cannot restart at the floor on replica B."""
+    profiler.reset_router_stats()
+    a, b = _FakeEngine("replica0"), _FakeEngine("replica1")
+    a._sched, b._sched = SLOScheduler(), SLOScheduler()
+    a._sched.load_state({"pass": {"flood": 5.0, "light": 1.0}})
+    b._sched.load_state({"pass": {"flood": 2.0, "quiet": 3.0}})
+    router = Router([a, b])
+    router.sync_fair_share()
+    merged = {"flood": 5.0, "light": 1.0, "quiet": 3.0}
+    assert a._sched.export_state()["pass"] == merged
+    assert b._sched.export_state()["pass"] == merged
+    assert profiler.get_router_stats()["fair_share_syncs"] == 1
+
+
+def test_router_refuses_duplicate_or_last_replica():
+    a, b = _FakeEngine("replica0"), _FakeEngine("replica0")
+    with pytest.raises(ValueError, match="unique"):
+        Router([a, b])
+    router = Router([_FakeEngine("replica0")])
+    with pytest.raises(ValueError, match="last replica"):
+        router.remove_replica("replica0")
+
+
+# -- real replicas: chaos, rebalance, exporter label ------------------------
+
+def _factory(net, **kw):
+    def make(rid):
+        return ServingEngine(net, slots=4, queue_depth=16, chunk=4,
+                             engine_id=rid, **kw)
+    return make
+
+
+def test_chaos_remove_replica_mid_burst_zero_drops_bit_exact(net):
+    """THE acceptance test: replay a sched traffic trace into a 2-replica
+    router, kill the busier replica mid-burst, and require (a) zero drops,
+    (b) every output token-for-token equal to solo ``generate``, (c) the
+    re-routed continuations keep tenant, priority, and (remaining)
+    deadline."""
+    profiler.reset_router_stats()
+    trace = make_trace(
+        "bursty", seed=5, rate=8.0, duration_s=1.0, vocab=VOCAB,
+        tenants=(TenantProfile("chat", priority="interactive",
+                               suffix_len=4, max_new=12, deadline_s=120.0),
+                 TenantProfile("bulk", priority="batch",
+                               suffix_len=6, max_new=10)))
+    assert len(trace.requests) >= 4, "trace too small to be a burst"
+    refs = [_solo(net, list(tr.prompt), tr.max_new) for tr in trace.requests]
+
+    with Router.local(_factory(net, sched=True), 2) as router:
+        handles = [router.submit(list(tr.prompt), tr.max_new,
+                                 deadline_s=tr.deadline_s, tenant=tr.tenant,
+                                 priority=tr.priority)
+                   for tr in trace.requests]
+        # mid-burst: wait until decode is demonstrably under way, then
+        # kill whichever replica carries the most live requests
+        _spin(lambda: any(h.tokens() for h in handles), "first token")
+        books = {rid: sum(0 if h.done() else 1 for h in book.values())
+                 for rid, book in router._inflight.items()}
+        victim = max(books, key=books.get)
+        t_kill = time.monotonic()
+        moved = router.remove_replica(victim)
+        assert moved >= 1, "victim had no live requests — not mid-burst"
+        assert router.replica_ids != [] and victim not in router.replica_ids
+
+        outs = [h.result(timeout=300) for h in handles]
+
+    assert outs == refs, "post-removal streams diverged from solo"
+    stats = profiler.get_router_stats()
+    assert stats["requests_dropped"] == 0
+    assert stats["requests_rebalanced"] == moved >= 1
+    assert stats["replicas_removed"] == 1 and stats["replicas"] == 1
+    # continuation metadata: the surviving segment of every chat request
+    # still carries its tenant/priority, and its deadline is the REMAINING
+    # budget (absolute deadline preserved, never re-armed from submit time)
+    for tr, h in zip(trace.requests, handles):
+        seg, _gen = h._segment()
+        assert seg.tenant == tr.tenant and seg.priority == tr.priority
+        if tr.deadline_s is None:
+            assert seg.deadline is None
+        else:
+            assert seg.deadline is not None
+            assert seg.deadline <= t_kill + tr.deadline_s + 1e-3
+
+
+def test_rebalance_swaps_engine_under_caller_zero_drops(net):
+    """drain -> fresh engine -> adopt behind a live handle: the caller's
+    RouterRequest never notices the swap and the stream stays bit-exact."""
+    profiler.reset_router_stats()
+    rs = np.random.RandomState(21)
+    prompt = rs.randint(1, VOCAB, size=9).tolist()
+    ref = _solo(net, prompt, 40)
+    with Router.local(_factory(net), 2) as router:
+        h = router.submit(prompt, 40)
+        _spin(lambda: len(h.tokens()) >= 4, "mid-decode")
+        serving = next(rid for rid, book in router._inflight.items()
+                       if any(not hh.done() for hh in book.values()))
+        old_engine = router._replicas[serving].engine
+        router.rebalance(serving)
+        assert router._replicas[serving].engine is not old_engine
+        assert h.result(timeout=300) == ref
+    stats = profiler.get_router_stats()
+    assert stats["rebalanced"] == 1
+    assert stats["requests_dropped"] == 0
+
+
+def test_router_request_handle_spans_splices():
+    """RouterRequest bookkeeping in isolation: tokens()/result() present
+    one uninterrupted stream across a splice, and a splice racing result()
+    is followed rather than surfaced as cancellation."""
+    from mxtpu.serving.api import CANCELLED, DONE, ServingRequest
+    rr = RouterRequest([1, 2, 3], 6, None, None, True, "t", "standard")
+    seg1 = ServingRequest([1, 2, 3], 6, None, tenant="t")
+    rr._attach(seg1)
+    seg1._emit([7, 8], time.monotonic())
+    seg2 = ServingRequest([1, 2, 3, 7, 8], 4, None, tenant="t")
+    got = []
+    waiter = threading.Thread(target=lambda: got.append(rr.result(30)))
+    waiter.start()
+    rr._splice(seg1.tokens(), seg2)       # splice BEFORE finishing seg1
+    seg1._finish(CANCELLED, time.monotonic())
+    seg2._emit([9, 10, 11, 12], time.monotonic())
+    seg2._finish(DONE, time.monotonic())
+    waiter.join(timeout=30)
+    assert got == [[7, 8, 9, 10, 11, 12]]
+    assert rr.tokens() == [7, 8, 9, 10, 11, 12] and rr.done()
+
+
+def test_exporter_serving_series_carry_engine_label(net):
+    """Satellite: every serving gauge is labelled with the engine identity
+    minted at construction, and the router counters are scraped too."""
+    from mxtpu.observability import exporter
+    profiler.reset_serving_stats()
+    profiler.reset_router_stats()
+    with ServingEngine(net, slots=2, queue_depth=4, chunk=4,
+                       engine_id="scrape-me") as eng:
+        assert eng.submit([5, 4, 3], 4).result(timeout=300)
+    profiler.record_router("submitted")
+    text = exporter.prometheus_text()
+    assert 'mxtpu_serving_completed{engine="scrape-me"} 1' in text
+    assert 'mxtpu_serving_slots{engine="scrape-me"} 2' in text
+    assert "mxtpu_router_submitted 1" in text
+    assert "mxtpu_router_requests_dropped 0" in text
+    # JSON snapshot carries the same identity un-flattened
+    snap = exporter.collect_snapshot()
+    assert snap["serving"]["engine"] == "scrape-me"
+    assert snap["router"]["submitted"] == 1

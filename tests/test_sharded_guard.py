@@ -1,0 +1,191 @@
+"""Sharded-serving guard (ISSUE 19 tentpole): ``ServingEngine(mesh=...)``
+on a virtual-8 fsdp×tp mesh must decode greedy BIT-EXACT against the
+single-device engine, keep the trace-once contract (zero new traces on a
+replayed trace), compose with int8-KV quantization and speculative decode
+unchanged, and refuse what cannot compose (pallas fused read, mesh-mismatch
+handoffs) with NAMED errors up front — never a mid-dispatch shape crash.
+
+The conftest spoofs 8 virtual CPU devices, so ``make_mesh((4, 2),
+("fsdp", "tp"))`` is always available here; every sharded engine in this
+file shares that geometry. Engine instances stay scarce (each owns fresh
+``jax.jit`` wrappers -> its own XLA compiles).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, profiler
+from mxtpu.gluon.model_zoo import transformer_lm
+from mxtpu.parallel.mesh import make_mesh
+from mxtpu.serving import (HandoffMismatch, ServingConfig, ServingEngine,
+                           ServingHandoff)
+from mxtpu.serving.sharded import (ServingLayout, ShardingUnsupported,
+                                   mesh_fingerprint, serving_param_specs)
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.rng.seed(0)
+    model = transformer_lm("tiny", vocab_size=VOCAB)
+    model.initialize()
+    return model
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh((4, 2), ("fsdp", "tp"))
+
+
+def _solo(model, prompt, max_new):
+    out = model.generate(nd.array(np.array([prompt], np.int32)), max_new)
+    return np.asarray(out.data)[0, len(prompt):].tolist()
+
+
+def _trace(seed=3):
+    rs = np.random.RandomState(seed)
+    return [(rs.randint(1, VOCAB, size=n).tolist(), new)
+            for n, new in [(3, 40), (17, 30), (9, 45), (26, 35), (5, 12)]]
+
+
+def test_sharded_greedy_bit_exact_and_trace_once(net, mesh):
+    """The tentpole contract: staggered continuous batching on the 4x2
+    mesh matches solo generate token-for-token, and a replayed trace adds
+    ZERO decode/prefill traces (sharding drift would mint silent
+    recompiles)."""
+    trace = _trace()
+    refs = [_solo(net, p, m) for p, m in trace]
+
+    before = profiler.get_compile_stats()
+    base_d = before.get("serving_decode", {}).get("traces", 0)
+    base_p = before.get("serving_prefill", {}).get("traces", 0)
+    with ServingEngine(net, slots=4, queue_depth=8, chunk=4,
+                       mesh=mesh) as eng:
+        def run_trace():
+            reqs = []
+            for i, (p, m) in enumerate(trace):
+                reqs.append(eng.submit(p, m))
+                time.sleep(0.02 * (i % 3))       # staggered joins
+            return [r.result(timeout=300) for r in reqs]
+
+        assert run_trace() == refs
+        mid = profiler.get_compile_stats()
+        assert run_trace() == refs               # replay: same programs
+    after = profiler.get_compile_stats()
+    d1 = mid.get("serving_decode", {}).get("traces", 0) - base_d
+    p1 = mid.get("serving_prefill", {}).get("traces", 0) - base_p
+    assert d1 == 1, f"expected ONE decode program, traced {d1}"
+    assert after.get("serving_decode", {}).get("traces", 0) \
+        == mid.get("serving_decode", {}).get("traces", 0)
+    assert after.get("serving_prefill", {}).get("traces", 0) \
+        == mid.get("serving_prefill", {}).get("traces", 0)
+    assert p1 >= 1
+
+
+def test_sharded_param_placement_actually_shards(net, mesh):
+    """Placement sanity: column-parallel weights shard over tp, the
+    row-parallel pair replicates (the bit-exactness precondition), and the
+    KV spec keeps slots on fsdp + heads on tp."""
+    from mxtpu.parallel.fsdp import filter_spec
+
+    layout = ServingLayout()
+    lp = {"qw": None, "ow": None, "f1w": None, "f2w": None}
+    specs = serving_param_specs({"embed": None, "layers": [lp]}, layout)
+    assert specs["layers"][0]["qw"] == layout.qkv_projection()
+    assert tuple(specs["layers"][0]["ow"]) == ()      # replicated
+    assert tuple(specs["layers"][0]["f2w"]) == ()     # replicated
+    assert specs["layers"][0]["f1w"] == layout.ffn_up()
+    # tiny preset: units=64 divisible by tp=2 -> qw really shards; the
+    # filtered KV spec keeps (slots fsdp, heads tp) when divisible
+    assert filter_spec(layout.qkv_projection(), (64, 64), mesh)[0] == "tp"
+    kvspec = filter_spec(layout.kv_cache(), (2, 2, 4, 2, 64, 32), mesh)
+    assert kvspec[2] == "fsdp" and kvspec[3] == "tp"
+
+
+def test_sharded_quant_and_spec_compose_bit_exact(net, mesh):
+    """int8 KV + speculative decode ride the mesh unchanged: same tokens
+    as the SINGLE-DEVICE engine under the same quant/spec config (the
+    oracle is the unsharded engine, not fp32 — int8 KV rounds the same
+    bytes on both sides)."""
+    trace = _trace(seed=11)
+    cfg = dict(slots=4, queue_depth=8, chunk=4, quant="int8_kv", spec=4)
+    with ServingEngine(net, **cfg) as eng:
+        reqs = [eng.submit(p, m) for p, m in trace]
+        refs = [r.result(timeout=300) for r in reqs]
+    with ServingEngine(net, mesh=mesh, **cfg) as eng:
+        reqs = [eng.submit(p, m) for p, m in trace]
+        outs = [r.result(timeout=300) for r in reqs]
+    assert outs == refs
+    stats = profiler.get_serving_stats()
+    assert stats["kv_dtype"] == "int8"
+
+
+def test_sharded_refuses_pallas_decode_kernel(net, mesh):
+    with pytest.raises(ShardingUnsupported, match="pallas"):
+        ServingEngine(net, quant="int8_kv", decode_kernel="pallas",
+                      mesh=mesh)
+
+
+def test_sharded_refuses_axisless_mesh(net):
+    bad = make_mesh((8,), ("dp",))
+    with pytest.raises(ShardingUnsupported, match="neither"):
+        ServingEngine(net, mesh=bad)
+
+
+def test_handoff_mesh_mismatch_named_error(net, mesh):
+    """Satellite: adoption validates mesh/sharding compatibility UP FRONT.
+    A handoff drained from a sharded engine refuses adoption by a
+    single-device engine (and vice versa) with HandoffMismatch naming both
+    geometries — never a merge-time shape crash."""
+    eng = ServingEngine(net, slots=2, queue_depth=8, chunk=4,
+                        mesh=mesh).start()
+    reqs = [eng.submit(p, m) for p, m in _trace(seed=7)[:2]]
+    t0 = time.monotonic()
+    while profiler.get_serving_stats()["prefills"] < 1:
+        assert time.monotonic() - t0 < 300
+        time.sleep(0.02)
+    handoff = eng.drain()
+    assert handoff.mesh == mesh_fingerprint(mesh)
+    assert handoff.in_flight >= 1
+
+    bare = ServingEngine(net, slots=2, queue_depth=8, chunk=4)
+    with pytest.raises(HandoffMismatch, match="single-device"):
+        bare.adopt(handoff)
+
+    # matching geometry adopts and completes bit-exact (zero drops)
+    eng2 = ServingEngine(net, slots=2, queue_depth=8, chunk=4, mesh=mesh)
+    eng2.adopt(handoff)
+    outs = [r.result(timeout=300) for r in reqs]
+    eng2.stop()
+    assert outs == [_solo(net, p, m) for p, m in _trace(seed=7)[:2]]
+
+
+def test_handoff_geometry_mismatch_named_error(net, mesh):
+    """A handoff whose KV row geometry disagrees with the adopting model
+    is refused by name, before any page merge."""
+    eng = ServingEngine(net, slots=2, mesh=mesh)
+    with pytest.raises(HandoffMismatch, match="same-model"):
+        eng.adopt(ServingHandoff(tot=64, mesh=mesh_fingerprint(mesh),
+                                 kv_geometry=(99, 1, 7)))
+
+
+def test_engine_id_label_and_load(net):
+    """Satellite: the exporter's serving series carry an ``engine`` label
+    minted at construction; ``load()`` reports the queue/slot pressure the
+    router feeds on."""
+    eng = ServingEngine(net, slots=2, engine_id="replica-a")
+    assert eng.engine_id == "replica-a"
+    load = eng.load()
+    assert load["engine"] == "replica-a"
+    assert load["in_flight"] == 0 and load["slots"] == 2
+    with ServingEngine(net, slots=2, queue_depth=8, chunk=4,
+                       config=ServingConfig(engine_id="replica-b")) as eng:
+        assert eng.submit([1, 2, 3], 4).result(timeout=300)
+        assert profiler.get_serving_stats()["engine"] == "replica-b"
+    # auto-minted ids stay unique across engines
+    a, b = ServingEngine(net), ServingEngine(net)
+    assert a.engine_id != b.engine_id
