@@ -3021,6 +3021,57 @@ def bench_sanitizer(smoke: bool = False):
     return out
 
 
+def bench_analysis(smoke: bool = False):
+    """Static-analysis leg: wall-clock for the two tier-1 gates.  (a) tpulint
+    over the three committed trees (``mxtpu tests bench.py`` — the same
+    invocation ``tests/test_analysis_guard.py`` guards) in-process via
+    ``lint_paths``, with per-rule finding counts; (b) the jaxpr-level program
+    auditor as a subprocess (``--audit --format json`` — it bootstraps its
+    own 8-virtual-device re-exec), with finding and program counts.  Both
+    counts are contract-zero on the committed tree, so the leg doubles as a
+    scoreboard-visible drift alarm; the timings tell us when the gates get
+    slow enough to hurt the edit loop."""
+    import subprocess
+    from mxtpu.analysis import lint_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    trees = [os.path.join(repo, "mxtpu"), os.path.join(repo, "tests"),
+             os.path.join(repo, "bench.py")]
+    t0 = time.perf_counter()
+    findings = lint_paths(trees)
+    lint_s = time.perf_counter() - t0
+    rule_counts: dict = {}
+    for f in findings:
+        rule_counts[f.rule] = rule_counts.get(f.rule, 0) + 1
+
+    t0 = time.perf_counter()
+    p = subprocess.run(
+        [sys.executable, "-m", "mxtpu.analysis", "--audit",
+         "--format", "json"],
+        cwd=repo, capture_output=True, text=True, timeout=600)
+    audit_s = time.perf_counter() - t0
+    audit = {"rc": p.returncode, "findings": None, "programs": None}
+    try:
+        doc = json.loads(p.stdout)
+        audit["findings"] = len(doc.get("findings", []))
+        audit["programs"] = len(doc.get("report", {}).get("programs", {}))
+        audit["counts"] = doc.get("counts", {})
+    except ValueError:
+        audit["stderr"] = p.stderr[-500:]
+
+    out = {
+        "lint": {"trees": ["mxtpu", "tests", "bench.py"],
+                 "wall_s": round(lint_s, 3),
+                 "findings": len(findings),
+                 "counts": rule_counts},
+        "audit": {"wall_s": round(audit_s, 2), **audit},
+    }
+    log(f"[analysis] lint {len(findings)} finding(s) in {lint_s:.2f}s, "
+        f"audit rc={p.returncode} {audit.get('findings')} finding(s) over "
+        f"{audit.get('programs')} program(s) in {audit_s:.1f}s")
+    return out
+
+
 def _fallback_train_leg(smoke: bool) -> dict:
     """The fallback harness's train leg: a LeNet loop through the fused
     StepExecutor, measured three ways — a sync-per-step latency distribution
@@ -3422,6 +3473,7 @@ def bench_cpu_fallback():
     lctx = run_leg("long_context", bench_long_context, smoke=smoke)
     trace = run_leg("trace", bench_trace)
     obs = run_leg("observability", bench_observability, smoke=smoke)
+    analysis = run_leg("analysis", bench_analysis, smoke=smoke)
     san = run_leg("sanitizer", bench_sanitizer, smoke=smoke) \
         if _sanitize_requested() else None
     caches = profiler.get_compile_stats()
@@ -3450,6 +3502,7 @@ def bench_cpu_fallback():
         "long_context": lctx,
         "trace": trace,
         "observability": obs,
+        "analysis": analysis,
         "compile_caches": caches,
     }
     if not _leg_ok(train):
@@ -3553,6 +3606,7 @@ def main():
     lctx = run_leg("long_context", bench_long_context)
     trace = run_leg("trace", bench_trace)
     obs = run_leg("observability", bench_observability)
+    analysis = run_leg("analysis", bench_analysis)
     san = run_leg("sanitizer", bench_sanitizer) \
         if _sanitize_requested() else None
 
@@ -3596,6 +3650,7 @@ def main():
         "long_context": lctx,
         "trace": trace,
         "observability": obs,
+        "analysis": analysis,
         "compile_caches": _compile_caches(),
     }
     if san is not None:

@@ -12,10 +12,22 @@ the contract layer, in the spirit of compiler sanitizers (ASan/TSan) and
 JAX's ``transfer_guard``, specialized to this codebase:
 
 * **Static half** (``lint.py`` + ``rules/``): an AST linter, runnable as
-  ``python -m mxtpu.analysis <path>``, with per-line suppression
-  (``# mxtpu: ignore[R001]``).  Rules R001–R005 cover host-sync-in-step,
+  ``python -m mxtpu.analysis <path>``, with logical-statement suppression
+  (``# mxtpu: ignore[R001]``).  Rules R001–R010 cover host-sync-in-step,
   donation-use-after-pass, untracked nondeterminism, thread-shared mutables
-  without a lock, and overbroad excepts.
+  without a lock, overbroad excepts, span leaks, quant-cache materialize,
+  unbounded maps, per-token host syncs, and blocking decode loops.  v2
+  grounds the rules in a dataflow core — a statement-level CFG with
+  reaching definitions (``dataflow.py``) and a module call graph with
+  traced-context propagation (``callgraph.py``) — so cross-function forms
+  (aliased helpers, ``self.m()`` methods, lax-HOF bodies) are caught, and
+  ``--format json`` / ``--baseline`` support editor and ratchet workflows.
+* **Program auditor** (``audit.py``, ``python -m mxtpu.analysis --audit``):
+  abstractly traces the canonical compiled programs (fused step, serving
+  decode/verify/prefill, sharded fsdp×tp decode, ZeRO update) on a virtual
+  mesh and verifies jaxpr/HLO-level invariants — shardcheck (A101–A104),
+  collective/transfer budgets (A201/A202), retrace-key closure (A301);
+  ``--audit --expect-fail`` seeds each violation class to prove detection.
 * **Runtime half** (``sanitize.py``): opt-in via
   ``MXTPU_SANITIZE=transfers,donation,retrace,threads`` — transfer guards
   around the fused step, donated-buffer poisoning, retrace escalation with

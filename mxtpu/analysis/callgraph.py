@@ -1,0 +1,230 @@
+"""Module-level call graph with traced-context propagation for tpulint v2.
+
+v1's ``ModuleContext.step_functions`` chased plain-``Name`` calls only, so a
+fused step calling ``self._loss(...)``, an aliased helper (``h = helper``),
+or a ``lax.scan`` body was outside the traced set — a helper that does
+``float(x)`` two frames down was invisible to R001.  This module builds an
+explicit call graph:
+
+* **edges** — ``Name`` calls (lexically resolved, innermost scope wins, same
+  as v1), ``self.m()``/``cls.m()`` calls (resolved to methods of the caller's
+  enclosing class), and local aliases resolved through the reaching-definition
+  engine (:meth:`~mxtpu.analysis.dataflow.CFG.binds_value`).
+* **traced set** — seeded from jit/grad/vmap decorators and trace-entry calls
+  (as v1), plus function-valued arguments of jax control-flow HOFs
+  (``lax.scan``/``while_loop``/``cond``/…, which trace their bodies exactly
+  like ``jit`` traces its argument), then closed over the edges.  Each traced
+  function remembers the call chain that dragged it in, so findings can print
+  ``step -> helper -> helper2``.
+* **loop-called set** — functions whose body runs inside a ``for``/``while``
+  iteration of some caller (directly at a loop call site, or transitively
+  through the graph).  R009's per-token host-sync rule uses it to catch the
+  helper form: ``for t in ...: consume(accept)`` where ``consume`` does the
+  ``.item()``.
+
+Pure ``ast``; no jax import at lint time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .dataflow import CFG
+from . import lint as _lint
+
+__all__ = ["CallGraph"]
+
+# jax higher-order control flow: every function-valued argument is traced
+_TRACE_HOF_NAMES = {"scan", "while_loop", "fori_loop", "cond", "switch",
+                    "associative_scan", "checkpoint", "remat", "custom_root",
+                    "custom_linear_solve"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class CallGraph:
+    """Call graph + traced/loop contexts for one :class:`ModuleContext`."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._cfgs: Dict[int, CFG] = {}
+        # id(caller scope) -> [(callee FunctionDef, call site)]
+        self.edges: Dict[int, List[Tuple[ast.AST, ast.Call]]] = {}
+        # id(fn) -> (parent fn or None, call/seed site) for message paths
+        self._trace_parent: Dict[int, Tuple[Optional[ast.AST], ast.AST]] = {}
+        self._traced: Optional[List[ast.AST]] = None
+        self._loop_called: Optional[Dict[int, Tuple[ast.AST, ast.Call]]] = None
+        self._class_of: Dict[int, ast.ClassDef] = {}
+        self._build()
+
+    # -- plumbing -----------------------------------------------------------
+    def cfg(self, scope) -> CFG:
+        c = self._cfgs.get(id(scope))
+        if c is None:
+            c = self._cfgs[id(scope)] = CFG(scope)
+        return c
+
+    def _enclosing_class(self, fn) -> Optional[ast.ClassDef]:
+        cid = self._class_of.get(id(fn))
+        if cid is not None:
+            return cid
+        for a in self.ctx.ancestors(fn):
+            if isinstance(a, _FUNC_NODES):
+                return None                  # nested def, not a method
+            if isinstance(a, ast.ClassDef):
+                self._class_of[id(fn)] = a
+                return a
+        return None
+
+    def _methods(self, cls: ast.ClassDef, name: str) -> List[ast.AST]:
+        return [n for n in cls.body
+                if isinstance(n, _FUNC_NODES) and n.name == name]
+
+    def _resolve_callable(self, expr, at_node, caller) -> List[ast.AST]:
+        """Resolve a callable expression at a use site to FunctionDef nodes.
+
+        Order: lexical (v1 semantics — innermost visible scope, so a traced
+        inner ``def step`` never drags in a same-named eager method), then
+        ``self.m``/``cls.m`` against the caller's class, then a single
+        unambiguous local alias via reaching definitions."""
+        if isinstance(expr, ast.Name):
+            fns = self.ctx.resolve_function(expr.id, at_node)
+            if fns:
+                return fns
+            scope = self.ctx.enclosing_scope(at_node)
+            if isinstance(scope, _FUNC_NODES + (ast.Module,)):
+                value = self.cfg(scope).binds_value(expr.id, at_node)
+                if isinstance(value, _FUNC_NODES):
+                    return [value]
+                if isinstance(value, ast.Name) and value.id != expr.id:
+                    return self.ctx.resolve_function(value.id, value)
+            return []
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and caller is not None:
+            cls = self._enclosing_class(caller)
+            if cls is not None:
+                return self._methods(cls, expr.attr)
+        return []
+
+    # -- graph construction -------------------------------------------------
+    def _build(self):
+        ctx = self.ctx
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            caller = ctx.enclosing_scope(call)
+            caller_fn = caller if isinstance(caller, _FUNC_NODES) else None
+            callees = self._resolve_callable(call.func, call, caller_fn)
+            if callees:
+                self.edges.setdefault(id(caller), []).extend(
+                    (c, call) for c in callees)
+
+    # -- traced set ---------------------------------------------------------
+    def _seeds(self) -> List[Tuple[ast.AST, ast.AST]]:
+        """(fn, seed site) pairs that enter a jax trace directly."""
+        ctx = self.ctx
+        seeds: List[Tuple[ast.AST, ast.AST]] = []
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, _FUNC_NODES):
+                for dec in n.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _lint._is_trace_entry(target):
+                        seeds.append((n, dec))
+                    elif isinstance(dec, ast.Call) and dec.args \
+                            and _lint._is_trace_entry(dec.args[0]):
+                        seeds.append((n, dec))   # @partial(jax.jit, ...)
+            elif isinstance(n, ast.Call):
+                caller = ctx.enclosing_scope(n)
+                caller_fn = caller if isinstance(caller, _FUNC_NODES) else None
+                name = _lint.dotted_name(n.func)
+                last = name.rsplit(".", 1)[-1] if name else None
+                if _lint._is_trace_entry(n.func) and n.args:
+                    for fn in self._resolve_callable(n.args[0], n, caller_fn):
+                        seeds.append((fn, n))
+                elif last in _TRACE_HOF_NAMES:
+                    for arg in n.args:
+                        for fn in self._resolve_callable(arg, n, caller_fn):
+                            seeds.append((fn, n))
+        return seeds
+
+    @property
+    def traced_functions(self) -> List[ast.AST]:
+        """Functions that run under a jax trace, closed over call edges and
+        nested defs.  Order: seeds first, then discovery order."""
+        if self._traced is not None:
+            return self._traced
+        traced: Dict[int, ast.AST] = {}
+        for fn, site in self._seeds():
+            if id(fn) not in traced:
+                traced[id(fn)] = fn
+                self._trace_parent[id(fn)] = (None, site)
+        work = list(traced.values())
+        while work:
+            f = work.pop(0)
+            # nested defs trace with their parent
+            for n in ast.walk(f):
+                if isinstance(n, _FUNC_NODES) and n is not f \
+                        and id(n) not in traced:
+                    traced[id(n)] = n
+                    self._trace_parent[id(n)] = (f, n)
+                    work.append(n)
+            for callee, site in self.edges.get(id(f), ()):
+                if id(callee) not in traced:
+                    traced[id(callee)] = callee
+                    self._trace_parent[id(callee)] = (f, site)
+                    work.append(callee)
+        self._traced = list(traced.values())
+        return self._traced
+
+    def trace_path(self, fn) -> List[str]:
+        """Call chain from a trace seed to ``fn``, e.g. ``['step', 'helper',
+        'helper2']`` — empty if ``fn`` is not traced."""
+        _ = self.traced_functions            # force closure computation
+        if id(fn) not in self._trace_parent:
+            return []
+        path: List[str] = []
+        cur: Optional[ast.AST] = fn
+        guard = 0
+        while cur is not None and guard < 64:
+            guard += 1
+            path.append(getattr(cur, "name", "<lambda>"))
+            cur = self._trace_parent.get(id(cur), (None, None))[0]
+        return list(reversed(path))
+
+    # -- loop context -------------------------------------------------------
+    def _in_loop(self, node, within) -> bool:
+        """Is ``node`` lexically inside a for/while of ``within`` (not hidden
+        behind a nested function boundary)?"""
+        for a in self.ctx.ancestors(node):
+            if a is within:
+                return False
+            if isinstance(a, _FUNC_NODES + (ast.Lambda,)):
+                return False
+            if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+        return False
+
+    @property
+    def loop_called(self) -> Dict[int, Tuple[ast.AST, ast.Call]]:
+        """id(fn) -> (fn, loop call site): functions whose body executes per
+        loop iteration of some caller, transitively."""
+        if self._loop_called is not None:
+            return self._loop_called
+        out: Dict[int, Tuple[ast.AST, ast.Call]] = {}
+        work: List[ast.AST] = []
+        for pairs in self.edges.values():
+            for callee, site in pairs:
+                scope = self.ctx.enclosing_scope(site)
+                if self._in_loop(site, scope) and id(callee) not in out:
+                    out[id(callee)] = (callee, site)
+                    work.append(callee)
+        while work:
+            f = work.pop(0)
+            for callee, site in self.edges.get(id(f), ()):
+                if id(callee) not in out:
+                    out[id(callee)] = (callee, site)
+                    work.append(callee)
+        self._loop_called = out
+        return out

@@ -14,15 +14,20 @@ Usage (also via ``python -m mxtpu.analysis``)::
     findings = lint_paths(["mxtpu/"])
 
 Per-line suppression: append ``# mxtpu: ignore[R001]`` (or a comma list, or
-bare ``# mxtpu: ignore`` for all rules) to the flagged line.  Suppressions
-are honored only on the exact finding line, so they stay local and auditable.
+bare ``# mxtpu: ignore`` for all rules) to the flagged statement.  The
+comment covers every physical line of the *logical* statement it sits in
+(backslash and paren continuations included), so a suppression on any line
+of a multi-line call silences findings anchored on its other lines; it never
+leaks past the statement, so suppressions stay local and auditable.
 """
 
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
+import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = ["Finding", "ModuleContext", "lint_source", "lint_file",
@@ -100,6 +105,7 @@ class ModuleContext:
         self._suppress: Optional[Dict[int, Optional[Set[str]]]] = None
         self._functions_by_name: Optional[Dict[str, List[ast.AST]]] = None
         self._step_functions: Optional[List[ast.AST]] = None
+        self._callgraph = None
 
     # -- tree plumbing ------------------------------------------------------
     def parent(self, node) -> Optional[ast.AST]:
@@ -117,19 +123,88 @@ class ModuleContext:
             p = self.parent(p)
 
     # -- suppression --------------------------------------------------------
+    def _logical_groups(self):
+        """Tokenize the source into logical statements: a list of
+        ``(physical_line_span, comments)`` where ``comments`` is
+        ``[(line, text), ...]``.  None if tokenization fails (the caller
+        falls back to exact-physical-line suppression)."""
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.src).readline)
+            groups = []
+            cur_lines: Set[int] = set()
+            cur_comments: List[Tuple[int, str]] = []
+            has_code = False
+            for tok in toks:
+                tt = tok.type
+                if tt == tokenize.COMMENT:
+                    if has_code:             # trailing comment of a statement
+                        cur_comments.append((tok.start[0], tok.string))
+                        cur_lines.add(tok.start[0])
+                    else:                    # standalone comment line
+                        groups.append(({tok.start[0]},
+                                       [(tok.start[0], tok.string)]))
+                elif tt == tokenize.NEWLINE:  # logical line ends
+                    cur_lines.update(range(tok.start[0], tok.end[0] + 1))
+                    if has_code:
+                        groups.append((cur_lines, cur_comments))
+                    cur_lines, cur_comments, has_code = set(), [], False
+                elif tt in (tokenize.NL, tokenize.INDENT, tokenize.DEDENT,
+                            tokenize.ENDMARKER):
+                    continue
+                else:
+                    has_code = True
+                    cur_lines.update(range(tok.start[0], tok.end[0] + 1))
+            if has_code:
+                groups.append((cur_lines, cur_comments))
+            return groups
+        except (tokenize.TokenError, IndentationError, SyntaxError,
+                ValueError):
+            return None
+
+    @staticmethod
+    def _parse_suppress(text: str) -> Optional[object]:
+        """``# mxtpu: ignore[...]`` comment text → None (all rules) or the
+        rule-id set; ``False`` if the comment is not a suppression."""
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            return False
+        if m.group(1) is None:
+            return None                      # bare ignore: every rule
+        return {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+
+    def _suppress_table(self) -> Dict[int, Optional[Set[str]]]:
+        """line -> suppressed rule set (None = all).  A suppression comment
+        covers every physical line of the logical statement carrying it."""
+        table: Dict[int, Optional[Set[str]]] = {}
+
+        def apply(lines: Iterable[int], rules):
+            for ln in lines:
+                if ln in table and (table[ln] is None or rules is None):
+                    table[ln] = None
+                elif ln in table:
+                    table[ln] = table[ln] | rules
+                else:
+                    table[ln] = set(rules) if rules is not None else None
+
+        groups = self._logical_groups()
+        if groups is None:                   # unparseable: physical lines only
+            for i, text in enumerate(self.lines, start=1):
+                rules = self._parse_suppress(text)
+                if rules is not False:
+                    apply([i], rules)
+            return table
+        for span, comments in groups:
+            for _cline, ctext in comments:
+                rules = self._parse_suppress(ctext)
+                if rules is False:
+                    continue
+                lo, hi = min(span), max(span)
+                apply(range(lo, hi + 1), rules)
+        return table
+
     def suppressed(self, line: int, rule: str) -> bool:
         if self._suppress is None:
-            table: Dict[int, Optional[Set[str]]] = {}
-            for i, text in enumerate(self.lines, start=1):
-                m = _SUPPRESS_RE.search(text)
-                if not m:
-                    continue
-                if m.group(1) is None:
-                    table[i] = None          # bare ignore: every rule
-                else:
-                    table[i] = {r.strip().upper()
-                                for r in m.group(1).split(",") if r.strip()}
-            self._suppress = table
+            self._suppress = self._suppress_table()
         if line not in self._suppress:
             return False
         rules = self._suppress[line]
@@ -145,10 +220,36 @@ class ModuleContext:
                 return a
         return self.tree
 
+    def _scope_binds_name(self, scope, name: str) -> bool:
+        """Does ``scope`` bind ``name`` other than by a ``def`` — as a
+        parameter or a local store (assign/loop/with/import/except)?  Such a
+        binding shadows any same-named outer function for everything nested
+        inside ``scope`` (``while_loop(cond, ...)`` must not resolve its
+        ``cond`` parameter to a module-level ``def cond``)."""
+        from .dataflow import CFG, bindings_of
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            if any(d.name == name for d in CFG._param_defs(scope)):
+                return True
+        body = getattr(scope, "body", [])
+        stack = list(body) if isinstance(body, list) else []
+        while stack:
+            st = stack.pop()
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue                     # nested scope: its own bindings
+            if any(d.name == name and d.kind != "def"
+                   for d in bindings_of(st)):
+                return True
+            for c in ast.iter_child_nodes(st):
+                if isinstance(c, ast.stmt) or isinstance(c, ast.ExceptHandler):
+                    stack.append(c)
+        return False
+
     def resolve_function(self, name: str, at_node) -> List[ast.AST]:
         """Lexically resolve ``name`` at a reference site to function defs:
         innermost visible scope wins (a nested traced ``def step`` must not
-        drag a same-named eager method into the traced set). Unresolvable
+        drag a same-named eager method into the traced set), and a parameter
+        or local store of an inner scope shadows outer defs. Unresolvable
         names (parameters, imports) resolve to nothing rather than to every
         same-named def in the file."""
         cands = self.functions_by_name.get(name, [])
@@ -162,6 +263,8 @@ class ModuleContext:
                        if f is not scope and self.enclosing_scope(f) is scope]
             if visible:
                 return visible
+            if scope is not self.tree and self._scope_binds_name(scope, name):
+                return []                    # shadowed before any def is seen
         return []
 
     @property
@@ -175,50 +278,35 @@ class ModuleContext:
         return self._functions_by_name
 
     @property
+    def callgraph(self):
+        """The module's :class:`~mxtpu.analysis.callgraph.CallGraph` —
+        call edges, traced-context propagation, loop-called closure."""
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+    @property
     def step_functions(self) -> List[ast.AST]:
         """Functions that flow into a jax trace (jit/grad/vmap/… entry):
 
         * decorated with ``@jax.jit`` / ``@partial(jax.jit, …)``;
         * passed as the first argument of a trace-entry call
-          (``jax.jit(pure, donate_argnums=…)``, ``jax.value_and_grad(f)``);
-        * defined inside, or called by name from, one of the above
-          (fixpoint over same-module name resolution — ``pure`` calling a
-          local helper drags the helper into the traced set).
+          (``jax.jit(pure, donate_argnums=…)``, ``jax.value_and_grad(f)``),
+          including ``self.method`` references and locally aliased names;
+        * a function-valued argument of a jax control-flow HOF
+          (``lax.scan`` / ``while_loop`` / ``cond`` / …);
+        * defined inside, or reachable through the call graph from, one of
+          the above — ``Name`` calls, ``self.m()`` method calls, and
+          reaching-definition-resolved aliases (``h = helper; h(x)``).
+
+        v2: computed by :class:`~mxtpu.analysis.callgraph.CallGraph`;
+        resolution stays lexically scoped (innermost visible scope wins), so
+        a traced inner ``def step`` does not drag a same-named eager method
+        into the traced set.
         """
-        if self._step_functions is not None:
-            return self._step_functions
-        seeds: List[ast.AST] = []
-        for n in ast.walk(self.tree):
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in n.decorator_list:
-                    target = dec.func if isinstance(dec, ast.Call) else dec
-                    if _is_trace_entry(target):
-                        seeds.append(n)
-                    elif isinstance(dec, ast.Call) and dec.args \
-                            and _is_trace_entry(dec.args[0]):
-                        seeds.append(n)      # @partial(jax.jit, ...)
-            elif isinstance(n, ast.Call) and _is_trace_entry(n.func):
-                if n.args and isinstance(n.args[0], ast.Name):
-                    seeds.extend(self.resolve_function(n.args[0].id, n))
-        # fixpoint closure: nested defs + same-module callees of step fns
-        step: Dict[int, ast.AST] = {id(f): f for f in seeds}
-        changed = True
-        while changed:
-            changed = False
-            for f in list(step.values()):
-                for n in ast.walk(f):
-                    targets: List[ast.AST] = []
-                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                            and n is not f:
-                        targets = [n]
-                    elif isinstance(n, ast.Call) \
-                            and isinstance(n.func, ast.Name):
-                        targets = self.resolve_function(n.func.id, n)
-                    for t in targets:
-                        if id(t) not in step:
-                            step[id(t)] = t
-                            changed = True
-        self._step_functions = list(step.values())
+        if self._step_functions is None:
+            self._step_functions = list(self.callgraph.traced_functions)
         return self._step_functions
 
     def in_step_function(self, node) -> bool:

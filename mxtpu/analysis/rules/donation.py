@@ -8,6 +8,20 @@ the exact shape of the PR 2 snapshot bug: the async checkpoint held device
 references that the next fused step's donation invalidated.  The runtime
 twin is ``MXTPU_SANITIZE=donation`` (poisoned donated references raise a
 named error on CPU too, where XLA silently skips donation).
+
+v2 (dataflow port): post-donation reads are found by walking the scope's CFG
+(:meth:`mxtpu.analysis.dataflow.CFG.uses_after`), so
+
+* a read on only *one* branch after the donating call is caught, and a read
+  on a path where the name was already rebound is **not** (v1's positional
+  scan flagged loads by line order alone);
+* the loop form falls out of the same query: the loop back edge re-reaches
+  the donating call's own argument load, which is exactly "next iteration
+  re-passes a deleted buffer";
+* donated program handles bound to attributes (``self._step = jax.jit(pure,
+  donate_argnums=…)`` in a builder method, called as ``self._step(params,…)``
+  somewhere else — the cross-function PR 2 shape) are tracked by dotted
+  name, not just local ``Name`` bindings.
 """
 
 from __future__ import annotations
@@ -43,7 +57,7 @@ def _donated_indices(call: ast.Call) -> Optional[List[int]]:
 def _scopes(tree):
     yield tree
     for n in ast.walk(tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
             yield n
 
 
@@ -56,102 +70,131 @@ def _end(node) -> Tuple[int, int]:
             getattr(node, "end_col_offset", node.col_offset))
 
 
+def _owned_by(ctx, node, scope) -> bool:
+    """Is ``node`` evaluated by ``scope`` itself (not a nested function)?"""
+    for a in ctx.ancestors(node):
+        if a is scope:
+            return True
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+    return scope is ctx.tree
+
+
 def check(ctx):
-    # pass 1 (whole module): names bound to a donating jit program
+    # pass 1 (whole module): callables bound to a donating jit program —
+    # plain names (step = jax.jit(...)) and dotted handles
+    # (self._step = jax.jit(...)), the cross-method form
     donated_fns: Dict[str, List[int]] = {}
     for n in ast.walk(ctx.tree):
         if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
             idxs = _donated_indices(n.value)
             if idxs is not None:
                 for t in n.targets:
-                    if isinstance(t, ast.Name):
-                        donated_fns[t.id] = idxs
+                    key = t.id if isinstance(t, ast.Name) else dotted_name(t)
+                    if key:
+                        donated_fns[key] = idxs
 
-    # pass 2 (per scope): donated calls vs later loads of the passed names
+    # pass 2 (per scope): donated calls vs reachable post-donation reads
     for scope in _scopes(ctx.tree):
-        body = scope.body if not isinstance(scope, ast.Lambda) else [scope.body]
-        calls: List[Tuple[ast.Call, List[str]]] = []
-        loads: Dict[str, List[Tuple[int, int]]] = {}
-        stores: Dict[str, List[Tuple[int, int]]] = {}
-        own_funcs = set()
+        cfg = ctx.callgraph.cfg(scope)
+        calls: List[Tuple[ast.Call, List[ast.expr]]] = []
+        for n in ast.walk(scope):
+            if not isinstance(n, ast.Call) or not _owned_by(ctx, n, scope):
+                continue
+            idxs = None
+            callee = dotted_name(n.func)
+            if callee and callee in donated_fns:
+                idxs = donated_fns[callee]
+            elif isinstance(n.func, ast.Call):
+                idxs = _donated_indices(n.func)   # jit(f, donate...)(x)
+            if idxs:
+                args = [a for i, a in enumerate(n.args) if i in idxs]
+                if args:
+                    calls.append((n, args))
 
-        def walk_scope(nodes):
-            for stmt in nodes:
-                for n in ast.walk(stmt):
-                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                      ast.Lambda)) and n is not stmt:
-                        own_funcs.add(id(n))
-                    if any(id(a) in own_funcs for a in ctx.ancestors(n)):
-                        continue          # nested scope: analyzed separately
-                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                        own_funcs.add(id(n))
-                        continue
-                    if isinstance(n, ast.Call):
-                        idxs = None
-                        if isinstance(n.func, ast.Name) \
-                                and n.func.id in donated_fns:
-                            idxs = donated_fns[n.func.id]
-                        elif isinstance(n.func, ast.Call):
-                            idxs = _donated_indices(n.func)
-                        if idxs:
-                            names = [a.id for i, a in enumerate(n.args)
-                                     if i in idxs and isinstance(a, ast.Name)]
-                            if names:
-                                calls.append((n, names))
-                    if isinstance(n, ast.Name):
-                        tgt = loads if isinstance(n.ctx, ast.Load) else stores
-                        tgt.setdefault(n.id, []).append(_pos(n))
+        # dotted-name loads/stores for attribute-valued donated args
+        # (self.params re-read after donation) — positional, v1 style
+        attr_loads: Dict[str, List[Tuple[int, int]]] = {}
+        attr_stores: Dict[str, List[Tuple[int, int]]] = {}
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Attribute) and _owned_by(ctx, n, scope):
+                d = dotted_name(n)
+                if d is None:
+                    continue
+                tgt = attr_loads if isinstance(n.ctx, ast.Load) else attr_stores
+                tgt.setdefault(d, []).append(_pos(n))
 
-        walk_scope(body)
-
-        for call, names in calls:
+        for call, args in calls:
+            stmt = cfg.carrier(call)
             callpos = _end(call)
-            # the statement holding the call: its assign targets rebind the
-            # name at the call itself (x = f(x) is the blessed pattern)
-            stmt = ctx.parent(call)
-            while stmt is not None and not isinstance(stmt, ast.stmt):
-                stmt = ctx.parent(stmt)
-            rebound_here = set()
-            if isinstance(stmt, ast.Assign):
-                for t in stmt.targets:
-                    for n in ast.walk(t):
-                        if isinstance(n, ast.Name):
-                            rebound_here.add(n.id)
-            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) \
-                    and isinstance(stmt.target, ast.Name):
-                rebound_here.add(stmt.target.id)
-
             enclosing_loop = next(
                 (a for a in ctx.ancestors(call)
                  if isinstance(a, (ast.For, ast.While, ast.AsyncFor))), None)
-
-            for name in names:
-                if name in rebound_here:
-                    continue
-                next_store = min(
-                    (p for p in stores.get(name, []) if p > callpos),
-                    default=(1 << 30, 0))
-                bad = [p for p in loads.get(name, [])
-                       if callpos < p < next_store
-                       and not (_pos(call) <= p <= callpos)]
-                if bad:
-                    line, col = bad[0]
-                    yield Finding(
-                        ctx.path, line, col, RULE_ID,
-                        f"{TITLE}: '{name}' was passed at a donated argnum "
-                        f"on line {call.lineno} — its buffer is deleted on "
-                        f"accelerators; rebind the name to the program's "
-                        f"output before reading it again")
-                elif enclosing_loop is not None:
-                    loop_stores = [
-                        n for n in ast.walk(enclosing_loop)
-                        if isinstance(n, ast.Name) and n.id == name
-                        and isinstance(n.ctx, ast.Store)
-                        and not any(id(a) in own_funcs
-                                    for a in ctx.ancestors(n))]
-                    if not loop_stores:
+            for arg in args:
+                if isinstance(arg, ast.Name):
+                    name = arg.id
+                    if stmt is None:
+                        continue
+                    hits = cfg.uses_after(stmt, name)
+                    # a hit that is the donating call's own argument load is
+                    # the back edge: the loop never rebound the name
+                    own_arg_ids = {id(arg)}
+                    loop_hits = [h for h in hits if id(h) in own_arg_ids]
+                    flow_hits = [h for h in hits if id(h) not in own_arg_ids]
+                    if flow_hits:
+                        h = flow_hits[0]
+                        yield Finding(
+                            ctx.path, h.lineno, h.col_offset, RULE_ID,
+                            f"{TITLE}: '{name}' was passed at a donated "
+                            f"argnum on line {call.lineno} — its buffer is "
+                            f"deleted on accelerators; rebind the name to "
+                            f"the program's output before reading it again")
+                    elif loop_hits or (
+                            enclosing_loop is not None and not hits
+                            and not any(d.name == name for d in
+                                        _stmt_bindings(stmt))
+                            and not _rebound_in(ctx, enclosing_loop, name,
+                                                scope)):
                         yield Finding(
                             ctx.path, call.lineno, call.col_offset, RULE_ID,
                             f"{TITLE}: '{name}' is passed at a donated "
                             f"argnum inside a loop but never rebound — the "
                             f"next iteration re-passes a deleted buffer")
+                else:
+                    d = dotted_name(arg)
+                    if not d:
+                        continue
+                    next_store = min(
+                        (p for p in attr_stores.get(d, []) if p > callpos),
+                        default=(1 << 30, 0))
+                    bad = [p for p in attr_loads.get(d, [])
+                           if callpos < p < next_store]
+                    if bad:
+                        line, col = bad[0]
+                        yield Finding(
+                            ctx.path, line, col, RULE_ID,
+                            f"{TITLE}: '{d}' was passed at a donated argnum "
+                            f"on line {call.lineno} — its buffer is deleted "
+                            f"on accelerators; rebind it to the program's "
+                            f"output before reading it again")
+                    elif enclosing_loop is not None and not [
+                            p for p in attr_stores.get(d, [])
+                            if _pos(enclosing_loop) <= p]:
+                        yield Finding(
+                            ctx.path, call.lineno, call.col_offset, RULE_ID,
+                            f"{TITLE}: '{d}' is passed at a donated argnum "
+                            f"inside a loop but never rebound — the next "
+                            f"iteration re-passes a deleted buffer")
+
+
+def _stmt_bindings(stmt):
+    from ..dataflow import bindings_of
+    return bindings_of(stmt) if stmt is not None else []
+
+
+def _rebound_in(ctx, loop, name, scope) -> bool:
+    for n in ast.walk(loop):
+        if isinstance(n, ast.Name) and n.id == name \
+                and isinstance(n.ctx, ast.Store) and _owned_by(ctx, n, scope):
+            return True
+    return False

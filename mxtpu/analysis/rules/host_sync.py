@@ -46,6 +46,10 @@ def _mentions_static(node) -> bool:
 def check(ctx):
     seen = set()
     for fn in ctx.step_functions:
+        # v2: step_functions is closed over the call graph (self-method,
+        # alias, lax HOF edges); name the drag-in chain for transitive hits
+        path = ctx.callgraph.trace_path(fn)
+        via = f" (traced via {' -> '.join(path)})" if len(path) > 1 else ""
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
@@ -70,4 +74,5 @@ def check(ctx):
                     ctx.path, node.lineno, node.col_offset, RULE_ID,
                     f"{TITLE}: {hit} inside a function that flows into a jax "
                     f"trace (jit/grad) forces a host sync every step — read "
-                    f"results outside the step, or keep the value traced")
+                    f"results outside the step, or keep the value traced"
+                    f"{via}")

@@ -175,6 +175,32 @@ class SpecLayout:
         return P(None, None, None, self.tp_axis)
 
 
+def audit_spec_table(layout: Optional[SpecLayout] = None,
+                     units: int = 64, vocab: int = 64, ffn: int = 256,
+                     layers: int = 2, heads: int = 2, slots: int = 4,
+                     tot: int = 64, head_dim: int = 32):
+    """``(role, probe shape, spec)`` rows over the canonical tiny-model
+    geometry — what the program auditor (``--audit``) shardchecks.  Kept
+    next to :class:`SpecLayout` so a new table entry is audited the moment
+    it is added: every axis a spec names must exist on the audit mesh
+    (A101) and every sharded probe dim must divide cleanly (A102) — a
+    table change that silently degrades to replicated via
+    :func:`filter_spec` shows up here instead of as a perf mystery.
+    Shapes follow the gluon ``(out, in)`` weight convention; the kv row is
+    the serving ``(L, 2, S, TOT? H, ...)`` page geometry."""
+    layout = layout or SpecLayout()
+    return [
+        ("embeddings", (vocab, units), layout.embeddings()),
+        ("qkv_projection", (units, units), layout.qkv_projection()),
+        ("attn_out", (units, units), layout.attn_out()),
+        ("ffn_up", (ffn, units), layout.ffn_up()),
+        ("ffn_down", (units, ffn), layout.ffn_down()),
+        ("vector", (units,), layout.vector()),
+        ("kv_cache", (layers, 2, slots, heads, tot, head_dim),
+         layout.kv_cache()),
+    ]
+
+
 def scale_spec(weight_spec: Optional[P]) -> P:
     """Partition spec for a per-row quantization scale vector riding a 2-D
     ``(out, in)`` weight (``mxtpu.quant``): the scale has one entry per OUTPUT

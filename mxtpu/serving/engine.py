@@ -1820,3 +1820,39 @@ class ServingEngine:
                 break
             req._finish(CANCELLED, now)
             profiler.record_serving("cancelled")
+
+
+def audit_key_specs(max_len: int, slots: int, chunk: int, prefill_chunk: int,
+                    k: int, bucket=None):
+    """The live ProgramCache key sites above, as data — the program
+    auditor's retrace-closure proof (rule A301).  Each row is ``(name,
+    keys_of, component_bounds)``: ``keys_of(prompt_len, total)`` returns
+    every program key a request with that geometry can dispatch under
+    (prefill returns one key per chunk step), and ``component_bounds[i]``
+    caps how many distinct values component ``i`` may take across the
+    WHOLE admissible request domain.  The product of the bounds caps the
+    program count, which is exactly the trace-once contract: bucketing is
+    what closes the key set, so a raw length leaking into a key (the
+    seeded ``--expect-fail`` case passes ``bucket=lambda n: n``) blows a
+    component's bound and the audit fails before the recompile storm
+    ships.  Keep these in lockstep with the ``get_or_build`` tuples in
+    ``_dispatch_decode`` / ``_dispatch_verify`` / the two prefill sites."""
+    b = bucket or (lambda n: kv.bucket32(n, max_len))
+    nb = (max_len + 31) // 32          # distinct 32-token bucket values
+
+    def decode_keys(plen, total):
+        return [(slots, b(total), chunk)]
+
+    def verify_keys(plen, total):
+        return [(slots, b(total), k)]
+
+    def prefill_keys(plen, total):
+        PB = b(plen)
+        return [(PB, min(prefill_chunk, PB - s))
+                for s in range(0, PB, prefill_chunk)]
+
+    return [
+        ("serving_decode", decode_keys, (1, nb, 1)),
+        ("serving_verify", verify_keys, (1, nb, 1)),
+        ("serving_prefill", prefill_keys, (nb, nb + 1)),
+    ]

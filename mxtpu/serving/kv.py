@@ -329,6 +329,45 @@ def build_verify(model, S: int, TOT: int, k: int, quant=None,
     return jax.jit(run)
 
 
+def audit_programs(model, slots: int, TOT: int, chunk: int, k: int,
+                   PB: int = 32, csize: int = 16, quant=None):
+    """The canonical serving programs plus example arguments, built exactly
+    as the engine's ProgramCache sites build them — the program auditor's
+    (``python -m mxtpu.analysis --audit``) trace/compile entry points for
+    the transfer (A202) and collective-budget (A201) invariants.  Returns
+    ``[(name, fn, args), ...]`` where ``fn(*args)`` is dispatchable and
+    ``jax.make_jaxpr(fn)(*args)`` is the audited trace; ``name`` matches
+    the live ProgramCache name so audit findings read like compile-guard
+    counters."""
+    params = model._gen_params()
+    caches = empty_cache(model, slots, TOT, quant=quant)
+    tok = jnp.ones((slots,), jnp.int32)
+    p = jnp.zeros((slots,), jnp.int32)
+    active = jnp.ones((slots,), jnp.bool_)
+    limit = jnp.full((slots,), TOT - 1, jnp.int32)
+    temp = jnp.zeros((slots,), jnp.float32)
+    topk = jnp.zeros((slots,), jnp.int32)
+    seed = jnp.zeros((slots,), jnp.uint32)
+    draft = jnp.ones((slots, k), jnp.int32)
+    dlen = jnp.full((slots,), k, jnp.int32)
+    page = empty_page(model, PB, quant=quant)
+    prompt = jnp.ones((1, PB), jnp.int32)
+    return [
+        ("serving_decode",
+         build_decode(model, slots, TOT, chunk, quant=quant),
+         (params, caches, tok, p, active, limit, temp, topk, seed)),
+        ("serving_verify",
+         build_verify(model, slots, TOT, k, quant=quant),
+         (params, caches, tok, p, active, limit, temp, topk, seed,
+          draft, dlen)),
+        ("serving_prefill",
+         build_prefill_chunk(model, PB, csize, quant=quant),
+         (params, page, prompt, jnp.int32(PB), jnp.int32(0),
+          jnp.ones((1,), jnp.int32), jnp.zeros((1,), jnp.float32),
+          jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.uint32))),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # shared-prefix radix KV reuse (SGLang RadixAttention over bucketed pages)
 # ---------------------------------------------------------------------------

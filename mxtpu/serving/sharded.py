@@ -185,6 +185,23 @@ def validate_mesh(mesh: Mesh, layout: Optional[SpecLayout] = None) -> None:
             "make_mesh((fsdp, tp), ('fsdp', 'tp'))")
 
 
+def audit_layout_invariants(layout: Optional[SpecLayout] = None):
+    """The PR 19 bit-exactness precondition as data, for the program auditor
+    (rule A104): the Megatron row-parallel pair MUST replicate under a
+    serving layout — sharding either contraction dim turns ``ctx @ ow.T`` /
+    ``g @ f2w.T`` into per-device partial sums + psum, which reorders the
+    float reduction and silently breaks token parity with solo ``generate``
+    while every shape check stays green.  Returns the violating
+    ``(entry, actual spec)`` pairs (empty == invariant holds)."""
+    layout = layout or ServingLayout()
+    bad = []
+    for entry in ("attn_out", "ffn_down"):
+        spec = getattr(layout, entry)()
+        if tuple(spec) != ():
+            bad.append((entry, spec))
+    return bad
+
+
 def pin_decode_kernel(mode: Optional[str]) -> str:
     """Resolve the quantized attention-read kernel for a sharded engine:
     the Pallas fused read is refused (its kernel body is opaque to GSPMD —

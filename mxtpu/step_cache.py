@@ -709,6 +709,21 @@ class StepExecutor:
             flops_mod.set_step_flops(entry["flops"])
         return entry["flops"]
 
+    def audit_entry(self):
+        """``(jitted program, abstract args)`` of the most recently
+        dispatched fused-step signature — the program auditor's entry point
+        (``python -m mxtpu.analysis --audit``).  The avals are the same
+        shape/dtype skeleton :meth:`program_flops` lowers against, so the
+        auditor re-traces the EXACT program the trainer runs (donation map
+        included) without pinning any live buffers.  Raises until one real
+        step has populated the cache."""
+        entry = self._cache.get(self._last_sig)
+        if entry is None or "avals" not in entry:
+            raise RuntimeError(
+                "audit_entry: no fused step has been dispatched yet — run "
+                "one training step before auditing the step program")
+        return entry["jitted"], entry["avals"]
+
     # -- the step ----------------------------------------------------------
     def step(self, data: Sequence, label, batch_size: Optional[int] = None):
         """Run one fused train step. Returns a dict with detached

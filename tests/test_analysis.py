@@ -9,7 +9,11 @@ the rule name in the message), the retrace escalation's diff names the
 changed signature key, and the profiler counters record coverage.
 """
 
+import ast
+import json
+import os
 import textwrap
+from collections import Counter
 
 import numpy as np
 import pytest
@@ -17,7 +21,10 @@ import pytest
 import mxtpu as mx
 from mxtpu import nd, profiler
 from mxtpu.analysis import (DonationError, HostSyncError, RetraceError,
-                            ThreadOwnershipError, lint_source, sanitize)
+                            ThreadOwnershipError, lint_file, lint_source,
+                            sanitize)
+from mxtpu.analysis.dataflow import CFG, bindings_of
+from mxtpu.analysis.lint import ModuleContext
 from mxtpu.analysis.sanitize import sig_diff
 from mxtpu.gluon import nn
 from mxtpu.gluon.block import HybridBlock
@@ -806,3 +813,430 @@ def test_sanitizer_stats_reset_and_summary_line():
     assert "sanitizer:" in profiler.compile_cache_summary()
     profiler.reset_sanitizer_stats()
     assert not any(profiler.get_sanitizer_stats().values())
+
+
+# ---------------------------------------------------------------------------
+# v2 dataflow core: CFG + reaching definitions
+# ---------------------------------------------------------------------------
+
+def _cfg_of(src, name):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef) and n.name == name)
+    return CFG(fn), fn
+
+
+def test_cfg_uses_after_is_branch_and_rebind_precise():
+    """uses_after follows paths, not line order: a read on ONE branch after
+    the call is reported; a read behind a rebinding on the other branch is
+    not (v1's positional scan could not tell these apart)."""
+    cfg, fn = _cfg_of("""
+        def f(p, flag):
+            q = g(p)
+            if flag:
+                r = p
+            else:
+                p = q
+                s = p
+            return 0
+    """, "f")
+    call_stmt = fn.body[0]                       # q = g(p)
+    hits = cfg.uses_after(call_stmt, "p")
+    assert len(hits) == 1
+    assert hits[0].lineno == fn.body[1].body[0].lineno   # r = p only
+
+
+def test_cfg_uses_after_follows_loop_back_edge():
+    """A name never rebound in a loop body re-reaches the call's own argument
+    load on the next iteration — the R002 'never rebound' form falls out of
+    plain reachability."""
+    cfg, fn = _cfg_of("""
+        def f(p, xs):
+            for x in xs:
+                out = g(p)
+            return out
+    """, "f")
+    call_stmt = fn.body[0].body[0]               # out = g(p)
+    hits = cfg.uses_after(call_stmt, "p")
+    assert len(hits) == 1 and hits[0].id == "p"
+    # ...and the blessed rebind (p = f(p)) flows nothing
+    cfg2, fn2 = _cfg_of("""
+        def f(p, xs):
+            for x in xs:
+                p = g(p)
+            return p
+    """, "f")
+    assert cfg2.uses_after(fn2.body[0].body[0], "p") == []
+
+
+def test_bindings_of_kinds():
+    tree = ast.parse(textwrap.dedent("""
+        import numpy as np
+        for i in rng:
+            pass
+        x = 1
+        y = (z := 2)
+    """))
+    kinds = {d.name: d.kind
+             for st in tree.body for d in bindings_of(st)}
+    assert kinds["np"] == "import"
+    assert kinds["i"] == "loop"
+    assert kinds["x"] == "assign"
+    assert kinds["y"] == "assign"
+    assert kinds["z"] == "walrus"
+
+
+def test_binds_value_resolves_single_alias_only():
+    """An alias rebound on any path resolves to nothing — conservative by
+    design, so the call graph never follows an ambiguous handle."""
+    cfg, fn = _cfg_of("""
+        def f(flag):
+            h = helper
+            if flag:
+                h = other
+            return h(1)
+    """, "f")
+    call = fn.body[2].value                      # h(1)
+    assert cfg.binds_value("h", call) is None
+    cfg2, fn2 = _cfg_of("""
+        def f():
+            h = helper
+            return h(1)
+    """, "f")
+    v = cfg2.binds_value("h", fn2.body[1].value)
+    assert isinstance(v, ast.Name) and v.id == "helper"
+
+
+# ---------------------------------------------------------------------------
+# v2 call graph: traced closure, aliases, loop context
+# ---------------------------------------------------------------------------
+
+def _ctx(src):
+    return ModuleContext("fixture.py", textwrap.dedent(src))
+
+
+def test_callgraph_alias_and_trace_path():
+    ctx = _ctx("""
+        import jax
+
+        def helper(x):
+            return x + 1
+
+        @jax.jit
+        def step(x):
+            h = helper
+            return h(x)
+    """)
+    traced = {f.name for f in ctx.callgraph.traced_functions}
+    assert traced == {"step", "helper"}
+    helper = ctx.functions_by_name["helper"][0]
+    assert ctx.callgraph.trace_path(helper) == ["step", "helper"]
+
+
+def test_callgraph_self_method_trace_entry():
+    """self._fwd passed to jax.jit inside a method resolves against the
+    enclosing class — the builder-method shape step_cache.py uses."""
+    ctx = _ctx("""
+        import jax
+
+        class Engine:
+            def _fwd(self, x):
+                return x * 2
+
+            def build(self):
+                self._step = jax.jit(self._fwd)
+    """)
+    assert {f.name for f in ctx.callgraph.traced_functions} == {"_fwd"}
+
+
+def test_callgraph_lax_hof_traces_body():
+    ctx = _ctx("""
+        from jax import lax
+
+        def body(carry, x):
+            return carry, x
+
+        def run(xs):
+            return lax.scan(body, 0.0, xs)
+    """)
+    assert {f.name for f in ctx.callgraph.traced_functions} == {"body"}
+
+
+def test_callgraph_loop_called_is_transitive():
+    ctx = _ctx("""
+        def a(x):
+            return b(x)
+
+        def b(x):
+            return x
+
+        def run(xs):
+            for x in xs:
+                a(x)
+    """)
+    names = {fn.name for fn, _site in ctx.callgraph.loop_called.values()}
+    assert names == {"a", "b"}
+
+
+# ---------------------------------------------------------------------------
+# v2 cross-function rule forms
+# ---------------------------------------------------------------------------
+
+def test_r001_cross_function_helper_names_trace_path():
+    findings = _lint("""
+        import jax
+
+        def helper(x):
+            return float(x)
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+    """)
+    assert [f.rule for f in findings] == ["R001"]
+    assert "traced via step -> helper" in findings[0].message
+
+
+def test_r001_negative_uncalled_helper_stays_eager():
+    """float() in a helper nothing traced calls is one-off host work, not a
+    per-step sync — the closure must not over-approximate."""
+    assert _rules_hit("""
+        def helper(x):
+            return float(x)
+
+        def eager(x):
+            return helper(x)
+    """) == set()
+
+
+def test_r002_attribute_handle_cross_method():
+    """The PR 2 shape: a donating program bound to self._step in one method,
+    self.params re-read after calling it in another."""
+    findings = _lint("""
+        import jax
+
+        class Trainer:
+            def build(self, impl):
+                self._step = jax.jit(impl, donate_argnums=(0,))
+
+            def train(self):
+                new = self._step(self.params)
+                snap = self.params
+                self.params = new
+                return snap
+    """)
+    assert [f.rule for f in findings] == ["R002"]
+    assert "'self.params'" in findings[0].message
+    # store-before-read is the blessed order: nothing to flag
+    assert _rules_hit("""
+        import jax
+
+        class Trainer:
+            def build(self, impl):
+                self._step = jax.jit(impl, donate_argnums=(0,))
+
+            def train(self):
+                new = self._step(self.params)
+                self.params = new
+                snap = self.params
+                return snap
+    """) == set()
+
+
+def test_r002_branch_precise():
+    """Read on one branch after donation: flagged.  Read after a rebind on
+    the same path: clean."""
+    findings = _lint("""
+        import jax
+
+        step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+        def run(params, flag):
+            out = step(params)
+            if flag:
+                return params
+            return out
+    """)
+    assert [f.rule for f in findings] == ["R002"]
+    assert _rules_hit("""
+        import jax
+
+        step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+        def run(params):
+            out = step(params)
+            params = out
+            return params
+    """) == set()
+
+
+def test_r009_cross_function_loop_helper():
+    findings = _lint("""
+        def consume(acc):
+            return acc.item()
+
+        def schedule(accept):
+            for s in range(8):
+                consume(accept[s])
+    """)
+    assert [f.rule for f in findings] == ["R009"]
+    assert "in 'consume'" in findings[0].message
+
+
+def test_r009_cross_function_host_copy_negative():
+    """The blessed shape — one readback lands lives_np outside the loop,
+    the helper only ever sees the host copy."""
+    assert _rules_hit("""
+        import numpy as np
+
+        def consume(acc):
+            return acc.item()
+
+        def schedule(accept):
+            lives_np = np.asarray(accept)
+            for s in range(8):
+                consume(lives_np[s])
+    """) == set()
+
+
+# ---------------------------------------------------------------------------
+# v2 suppression: logical-statement coverage
+# ---------------------------------------------------------------------------
+
+def test_suppression_covers_paren_continuation():
+    """The ignore comment sits on the opening line; the finding anchors on
+    the continuation line — one logical statement, so it is covered."""
+    assert _lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = (  # mxtpu: ignore[R001]
+                float(x)
+            )
+            return y
+    """) == []
+
+
+def test_suppression_covers_backslash_continuation():
+    assert _lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = float(x) + \\
+                float(x)  # mxtpu: ignore[R001]
+            return y
+    """) == []
+
+
+def test_suppression_does_not_leak_past_statement():
+    findings = _lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = float(x)  # mxtpu: ignore[R001]
+            z = float(x)
+            return y + z
+    """)
+    assert len(findings) == 1 and findings[0].rule == "R001"
+    assert findings[0].line == 7                 # the z line, not the y line
+
+
+# ---------------------------------------------------------------------------
+# v2 CLI: --format json, --baseline ratchet
+# ---------------------------------------------------------------------------
+
+_DIRTY = ("import jax\n"
+          "def pure(x):\n"
+          "    return float(x)\n"
+          "f = jax.jit(pure)\n")
+
+
+def test_cli_format_json(tmp_path, capsys):
+    from mxtpu.analysis.__main__ import main
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(_DIRTY)
+    rc = main([str(dirty), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == 2
+    assert doc["counts"] == {"R001": 1}
+    (f0,) = doc["findings"]
+    assert f0["rule"] == "R001" and f0["line"] == 3
+    assert f0["path"] == str(dirty)
+
+
+def test_cli_baseline_ratchet(tmp_path, capsys):
+    """--write-baseline records the debt; --baseline exits 0 while the debt
+    holds and 1 only on findings beyond it (count-based, line-shift-proof)."""
+    from mxtpu.analysis.__main__ import main
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(_DIRTY)
+    base = tmp_path / "base.json"
+    assert main([str(dirty), "--write-baseline", str(base)]) == 0
+    capsys.readouterr()
+    # unchanged tree: ratchet holds
+    assert main([str(dirty), "--baseline", str(base)]) == 0
+    capsys.readouterr()
+    # same finding on a shifted line: still inside the per-(path, rule) budget
+    dirty.write_text("# a new leading comment\n" + _DIRTY)
+    assert main([str(dirty), "--baseline", str(base)]) == 0
+    capsys.readouterr()
+    # a genuinely new finding: exit 1, and json mode names it
+    dirty.write_text(_DIRTY.replace("return float(x)",
+                                    "return float(x) + int(x)"))
+    assert main([str(dirty), "--baseline", str(base),
+                 "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["findings"]) == 2
+    assert len(doc["new_findings"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# v2 rule-interaction fixture
+# ---------------------------------------------------------------------------
+
+_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "lint_interaction.pytxt")
+
+
+def _fixture_src():
+    with open(_FIXTURE, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _suppress_on(src, needle, rule):
+    out = []
+    for line in src.splitlines():
+        if needle in line:
+            line += f"  # mxtpu: ignore[{rule}]"
+        out.append(line)
+    return "\n".join(out) + "\n"
+
+
+def test_fixture_trips_all_three_rules():
+    findings = lint_file(_FIXTURE)
+    assert Counter(f.rule for f in findings) == \
+        {"R001": 2, "R002": 1, "R009": 1}
+    # R001 and R009 share the .tolist() line yet report independently
+    shared = [f for f in findings if ".tolist()" in f.message]
+    assert {f.rule for f in shared} == {"R001", "R009"}
+    assert len({f.line for f in shared}) == 1
+
+
+def test_fixture_rules_suppress_independently():
+    src = _fixture_src()
+    # R002 alone
+    fs = lint_source(_suppress_on(src, "return params, probs, outs", "R002"),
+                     path=_FIXTURE)
+    assert {f.rule for f in fs} == {"R001", "R009"}
+    # R009 alone — its line keeps reporting R001
+    fs = lint_source(_suppress_on(src, "return accepted.tolist()", "R009"),
+                     path=_FIXTURE)
+    assert Counter(f.rule for f in fs) == {"R001": 2, "R002": 1}
+    # R001 on both sync lines leaves R002 + R009 standing
+    s = _suppress_on(_suppress_on(src, "return float(x)", "R001"),
+                     "return accepted.tolist()", "R001")
+    assert {f.rule for f in lint_source(s, path=_FIXTURE)} == {"R002", "R009"}

@@ -1,0 +1,76 @@
+"""Tier-1 program-auditor guards (tpulint v2 tentpole).
+
+Two contracts future PRs cannot silently break:
+
+1. **Audit clean** — ``python -m mxtpu.analysis --audit`` exits 0: every
+   canonical compiled program (fused module step, serving decode/verify/
+   prefill, the sharded fsdp×tp decode, the ZeRO-3 update) satisfies the
+   shardcheck table, its collective/transfer budgets, and the retrace-key
+   closure on the committed tree.  A new all-reduce sneaking into the
+   bit-exact decode, a debug callback left in a step, or an unbucketed
+   program-key component fails CI with the Annn rule name, not as a silent
+   perf or parity regression three PRs later.
+2. **Detection proven** — ``--audit --expect-fail`` seeds one violation per
+   invariant class and requires each to surface its rule.  This is the
+   auditor's own regression test: a refactor that quietly stops counting
+   collectives (or stops tracing under ``layout_scope``) turns a seed from
+   DETECTED to MISSED and exits 1.
+
+Both run the CLI as a subprocess with 8 forced CPU devices — the same
+virtual mesh the audit's self-respawn path builds, minus the double spawn.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import conftest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every seeded violation class the auditor must prove it detects
+_SEEDS = [
+    ("spec_axis", "A101"),          # shardcheck: named axis absent from mesh
+    ("contraction_shard", "A103"),  # shardcheck: PR 8 contraction-dim ban
+    ("row_parallel", "A104"),       # shardcheck: PR 19 replicate-or-psum
+    ("extra_collective", "A201"),   # collective budget on the lowered HLO
+    ("host_transfer", "A202"),      # host callback inside a program
+    ("open_keys", "A301"),          # retrace closure: unbucketed key site
+]
+
+
+def _run_audit(*extra):
+    env = conftest.subprocess_env(virtual_devices=8)
+    env["MXTPU_AUDIT_CHILD"] = "1"   # devices are forced; skip the re-exec
+    return subprocess.run(
+        [sys.executable, "-m", "mxtpu.analysis", "--audit", *extra],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=600)
+
+
+def test_audit_clean_on_committed_tree():
+    p = _run_audit("--format", "json")
+    assert p.returncode == 0, (
+        f"program audit found violations (rc={p.returncode}):\n"
+        f"{p.stdout[-4000:]}\n{p.stderr[-1000:]}")
+    doc = json.loads(p.stdout)
+    assert doc["audit"] is True
+    assert doc["findings"] == []
+    # ...and the auditor demonstrably covered the canonical program set
+    progs = set(doc["report"]["programs"])
+    assert {"module_step", "serving_decode", "serving_verify",
+            "serving_prefill", "serving_decode[fsdp=4,tp=2]",
+            "zero_update[dp=8]"} <= progs
+    legs = {leg["leg"] for leg in doc["report"]["legs"]}
+    assert legs == {"shardcheck", "serving", "zero", "fused_step", "keys"}
+
+
+def test_audit_expect_fail_detects_every_invariant_class():
+    p = _run_audit("--expect-fail")
+    assert p.returncode == 0, (
+        f"a seeded violation went undetected (rc={p.returncode}):\n"
+        f"{p.stdout[-4000:]}\n{p.stderr[-1000:]}")
+    for seed, rule in _SEEDS:
+        assert f"seed '{seed}' -> {rule}: DETECTED" in p.stdout, (
+            f"no DETECTED line for seed {seed!r} ({rule}):\n{p.stdout}")
+    assert "MISSED" not in p.stdout

@@ -50,7 +50,17 @@ def test_bench_cpu_fallback_exits_zero_and_emits_json(tmp_path):
     assert {"checkpoint", "input_pipeline", "zero_dp", "resilience",
             "compile_caches", "mfu", "trace", "fsdp", "serving",
             "elastic", "quant", "long_context", "observability",
-            "traffic", "ratchet"} <= set(doc)
+            "traffic", "analysis", "ratchet"} <= set(doc)
+    # analysis leg (ISSUE 20): lint + audit both ran and both report the
+    # contract-zero finding counts of the committed tree
+    analysis = doc["analysis"]
+    assert "error" not in analysis, analysis
+    assert analysis["lint"]["trees"] == ["mxtpu", "tests", "bench.py"]
+    assert analysis["lint"]["findings"] == 0
+    assert analysis["lint"]["wall_s"] > 0
+    assert analysis["audit"]["rc"] == 0
+    assert analysis["audit"]["findings"] == 0
+    assert analysis["audit"]["programs"] >= 6
     # resilience leg (ISSUE 8): injected ckpt io_error retried, injected
     # mid-epoch crash survived by a supervised restart, final params equal
     # to the fault-free baseline
